@@ -1,0 +1,24 @@
+"""hermes_tpu — a TPU-native implementation of the Hermes replication protocol.
+
+Hermes (ASPLOS'20) is a broadcast-invalidation, linearizable, fault-tolerant
+replication protocol for in-memory key-value stores.  This package rebuilds the
+capabilities of the reference repo ``A-Kokolis/Hermes`` from scratch with an
+idiomatic JAX/XLA/Pallas design (see ``SURVEY.md`` for the full blueprint and
+its §0 integrity note: the reference mount was empty when this was written, so
+behavioral citations point at ``BASELINE.json`` / the public protocol paper
+rather than reference file:line).
+
+Architecture (SURVEY.md §7): instead of the reference's per-thread C worker
+loops, the protocol runs as a bulk-synchronous step — all per-key protocol
+logic is data-parallel over a struct-of-arrays key-state table, and the
+INV/ACK/VAL message batches move between replicas as XLA collectives
+(`all_gather` / `all_to_all`) over an ICI mesh, one TPU chip = one Hermes
+replica (BASELINE.json:5, ``transport=tpu_ici``).
+"""
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import types
+
+__version__ = "0.1.0"
+
+__all__ = ["HermesConfig", "types", "__version__"]

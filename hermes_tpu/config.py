@@ -1,0 +1,75 @@
+"""Run configuration for hermes_tpu.
+
+The reference keeps its knobs as compile-time ``#define``s plus run-script
+flags (SURVEY.md §2 "Config" row, §5.6).  The rebuild uses one frozen
+dataclass; anything that changes compiled shapes (replicas, sessions, keys,
+lanes) is static so a config maps 1:1 to a compiled XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    """YCSB-style synthetic workload knobs (SURVEY.md §2 "Workload generator").
+
+    The five acceptance configs (BASELINE.json:7-11) are expressible here:
+    YCSB-A = read_frac .5, rmw_frac 0; YCSB-F = rmw mix; Zipfian hotspot via
+    ``distribution='zipfian'`` with theta 0.99.
+    """
+
+    read_frac: float = 0.5
+    rmw_frac: float = 0.0  # fraction of *update* ops that are RMWs (YCSB-F -> 1.0)
+    distribution: Literal["uniform", "zipfian"] = "uniform"
+    zipf_theta: float = 0.99
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class HermesConfig:
+    """Static shape + protocol configuration.
+
+    One TPU chip (or one simulated shard) is one Hermes replica
+    (BASELINE.json:5).  All shapes are static: ``n_replicas`` sets mesh size,
+    ``n_sessions`` the per-replica client-session count (= max in-flight
+    updates per replica, the reference's session arrays in ``worker.c``
+    [SURVEY.md §1 L5]), ``n_keys`` the KVS size.
+    """
+
+    n_replicas: int = 3
+    n_keys: int = 1 << 16
+    value_words: int = 2  # int32 words per value; word0/word1 hold the unique write id
+    n_sessions: int = 256  # client sessions per replica; lane width of msg tensors
+    replay_slots: int = 64  # concurrent replays per replica (SURVEY.md §3.4)
+    ops_per_session: int = 1024  # pre-generated op-stream length per session
+
+    # Protocol / failure handling (SURVEY.md §5.3).
+    replay_age: int = 16  # steps a key may sit Invalid before the replay scan picks it up
+    lease_steps: int = 8  # host-side membership lease (steps without heartbeat -> suspect)
+
+    workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)
+
+    def __post_init__(self) -> None:
+        if not (1 <= self.n_replicas <= 31):
+            raise ValueError(
+                "n_replicas must be in [1, 31] (live mask is an int32 bitmap and"
+                " (1<<32)-1 overflows int32)"
+            )
+        if self.value_words < 2:
+            raise ValueError("value_words >= 2 (words 0-1 carry the unique write id)")
+        # Unique write ids are (hi=replica, lo=session*G+op) int32 pairs.
+        if self.n_sessions * self.ops_per_session >= 2**31:
+            raise ValueError("n_sessions * ops_per_session must fit int32")
+
+    @property
+    def full_mask(self) -> int:
+        """Bitmap with one bit per configured replica."""
+        return (1 << self.n_replicas) - 1
+
+    @property
+    def n_lanes(self) -> int:
+        """Outbound message lanes per replica: one per session + one per replay slot."""
+        return self.n_sessions + self.replay_slots

@@ -1,0 +1,7 @@
+"""Protocol core: the per-key write state machine, timestamps, and phases.
+
+This is SURVEY.md §1 L3 ("spacetime") rebuilt as data-parallel array code:
+the reference's ``broadcast_inv()/poll_acks()/broadcast_val()`` coordinator
+loop and ``apply_inv()`` follower handler (names per BASELINE.json:5) become
+pure functions over a struct-of-arrays key-state table.
+"""

@@ -1,0 +1,463 @@
+"""The Hermes protocol phases as pure, per-replica JAX functions.
+
+The reference's hot loop (SURVEY.md §3.1, function names per BASELINE.json:5)
+is per-op:
+
+    coordinator: broadcast_inv() -> poll_acks() -> broadcast_val()
+    follower:    apply_inv() / apply_val()
+
+Here the same state machine runs bulk-synchronously: each phase is
+data-parallel over every session / message lane / key at once, and the
+network rounds between phases are collectives supplied by the transport
+backend.  One protocol step is:
+
+    coordinate  -> [INV broadcast]  -> apply_inv  -> [ACK all_to_all]
+                -> collect_acks     -> [VAL broadcast] -> apply_val
+
+so an uncontended write commits in a single step (commit latency = one
+INV/ACK round trip, the protocol's headline property, SURVEY.md §3.1).
+
+Every function here takes per-replica state WITHOUT a leading replica axis;
+replica batching is done outside with vmap (single-device simulation) or
+shard_map (one chip = one replica over the ICI mesh, BASELINE.json:5).
+
+Design notes (SURVEY.md §7 "hard parts"):
+  * Variable-length message batches live in fixed lanes: lane l < S is
+    session l's pending update, lanes S..S+RS are replay slots; ``valid``
+    masks dead lanes.  A pending update re-broadcasts its INV every step
+    until committed — same-ts INVs are idempotent, which makes message loss,
+    duplication, and replica stalls all collapse into the same code path.
+  * Contended keys (Zipfian, BASELINE.json:9): the per-key winner among all
+    INVs of a step is the lexicographic-max timestamp, found with a two-pass
+    scatter-max (ver, then fc among max-ver), not last-write-wins.
+  * RMW aborts (BASELINE.json:8): a pending RMW aborts iff a conflicting
+    higher-ts update supersedes it.  Plain writes carry a higher tie-break
+    flag than RMWs (types.FLAG_*), so concurrent plain writes always beat
+    concurrent RMWs from the same base version and an aborted RMW's value can
+    never become readable anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import state as st
+from hermes_tpu.core import types as t
+from hermes_tpu.core.timestamps import make_fc, ts_eq, ts_gt
+
+I32_MIN = jnp.iinfo(jnp.int32).min
+
+
+def _set(arr, idx, val, mask):
+    """Masked scatter-set: rows where ``mask`` is False are dropped (sentinel
+    out-of-bounds index + mode='drop')."""
+    sent = arr.shape[0]
+    return arr.at[jnp.where(mask, idx, sent)].set(val, mode="drop")
+
+
+def _write_value(cfg: HermesConfig, my_cid, sess_idx, op_idx):
+    """Unique write values, derived on device: words 0/1 are the unique id
+    (lo = session*G + op, hi = replica), remaining words a cheap mix so value
+    payloads are non-trivial.  Uniqueness is what makes the linearizability
+    check tractable (SURVEY.md §4)."""
+    lo = sess_idx * cfg.ops_per_session + op_idx
+    hi = jnp.broadcast_to(my_cid, lo.shape)
+    words = [lo, hi]
+    for j in range(2, cfg.value_words):
+        words.append(lo * jnp.int32(-1640531527) + jnp.int32(j))  # 2654435761 mod 2^32
+    return jnp.stack(words, axis=-1).astype(jnp.int32)
+
+
+class CoordinateOut(NamedTuple):
+    table: st.KeyTable
+    sess: st.Sessions
+    replay: st.ReplaySlots
+    out_inv: st.Invs
+    comp: st.Completions
+
+
+def coordinate(
+    cfg: HermesConfig,
+    ctl: st.Ctl,
+    table: st.KeyTable,
+    sess: st.Sessions,
+    replay: st.ReplaySlots,
+    stream: st.OpStream,
+) -> CoordinateOut:
+    """Session op intake + local reads + update issue + replay scan.
+
+    Covers the reference's worker-loop front half (SURVEY.md §3.1 L5/L6->L3):
+    idle sessions load their next op; reads complete locally iff the key is
+    Valid (Hermes's local-read property, §3.2); updates issue iff the key is
+    Valid — the issuing replica applies the new value locally, moves the key
+    to Write state, and opens an INV lane.  Also runs the replay scan
+    (§3.4): keys Invalid for more than ``replay_age`` steps are snapshotted
+    into replay slots and re-driven with their original timestamp.
+    """
+    S, K, G = cfg.n_sessions, cfg.n_keys, cfg.ops_per_session
+    RS = cfg.replay_slots
+    idx = jnp.arange(S, dtype=jnp.int32)
+
+    # --- 1) op intake -----------------------------------------------------
+    can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~ctl.frozen
+    g = jnp.clip(sess.op_idx, 0, G - 1)
+    new_op = stream.op[idx, g]
+    new_key = stream.key[idx, g]
+    new_val = _write_value(cfg, ctl.my_cid, idx, g)
+
+    is_nop = can_load & (new_op == t.OP_NOP)
+    status = jnp.where(
+        can_load,
+        jnp.where(
+            new_op == t.OP_READ,
+            t.S_READ,
+            jnp.where(new_op == t.OP_NOP, t.S_IDLE, t.S_ISSUE),
+        ),
+        sess.status,
+    )
+    status = jnp.where((status == t.S_IDLE) & (sess.op_idx >= G), t.S_DONE, status)
+    sess = sess._replace(
+        status=status,
+        op=jnp.where(can_load, new_op, sess.op),
+        key=jnp.where(can_load, new_key, sess.key),
+        val=jnp.where(can_load[:, None], new_val, sess.val),
+        invoke_step=jnp.where(can_load, ctl.step, sess.invoke_step),
+        op_idx=jnp.where(is_nop, sess.op_idx + 1, sess.op_idx),
+    )
+
+    # --- 2) local reads ---------------------------------------------------
+    kstate = table.state[sess.key]
+    read_done = (sess.status == t.S_READ) & (kstate == t.VALID) & ~ctl.frozen
+    rd_val = table.val[sess.key]
+    sess = sess._replace(
+        status=jnp.where(read_done, t.S_IDLE, sess.status),
+        op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
+        rd_val=jnp.where(read_done[:, None], rd_val, sess.rd_val),
+    )
+
+    # --- 3) update issue (put / rmw), with local same-key arbitration -----
+    kstate = table.state[sess.key]  # re-read: reads don't change it, but keep exact
+    want = (sess.status == t.S_ISSUE) & (kstate == t.VALID) & ~ctl.frozen
+    arb = _minscatter(K, sess.key, idx, want)
+    win = want & (arb[sess.key] == idx)
+
+    new_ver = table.ver[sess.key] + 1
+    flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
+    new_fc = jnp.broadcast_to(make_fc(flag, ctl.my_cid), (S,)).astype(jnp.int32)
+    old_val = table.val[sess.key]  # RMW read-part observes the pre-issue value
+
+    table = table._replace(
+        state=_set(table.state, sess.key, jnp.full((S,), t.WRITE, jnp.int32), win),
+        ver=_set(table.ver, sess.key, new_ver, win),
+        fc=_set(table.fc, sess.key, new_fc, win),
+        val=_set(table.val, sess.key, sess.val, win),
+        inv_step=_set(table.inv_step, sess.key, jnp.broadcast_to(ctl.step, (S,)), win),
+    )
+    sess = sess._replace(
+        status=jnp.where(win, t.S_INFL, sess.status),
+        ver=jnp.where(win, new_ver, sess.ver),
+        fc=jnp.where(win, new_fc, sess.fc),
+        acks=jnp.where(win, 0, sess.acks),
+        superseded=jnp.where(win, False, sess.superseded),
+        rd_val=jnp.where((win & (sess.op == t.OP_RMW))[:, None], old_val, sess.rd_val),
+    )
+
+    # --- 4) replay scan (SURVEY.md §3.4) ----------------------------------
+    stuck = ((table.state == t.INVALID) | (table.state == t.TRANS)) & (
+        ctl.step - table.inv_step > cfg.replay_age
+    )
+    cand = jnp.nonzero(stuck, size=RS, fill_value=K)[0].astype(jnp.int32)
+    fslot = jnp.nonzero(~replay.active, size=RS, fill_value=RS)[0].astype(jnp.int32)
+    assign = (cand < K) & (fslot < RS) & ~ctl.frozen
+    replay = replay._replace(
+        active=_set(replay.active, fslot, jnp.ones((RS,), jnp.bool_), assign),
+        key=_set(replay.key, fslot, cand, assign),
+        ver=_set(replay.ver, fslot, table.ver[jnp.clip(cand, 0, K - 1)], assign),
+        fc=_set(replay.fc, fslot, table.fc[jnp.clip(cand, 0, K - 1)], assign),
+        val=_set(replay.val, fslot, table.val[jnp.clip(cand, 0, K - 1)], assign),
+        acks=_set(replay.acks, fslot, jnp.zeros((RS,), jnp.int32), assign),
+    )
+    table = table._replace(
+        state=_set(table.state, cand, jnp.full((RS,), t.REPLAY, jnp.int32), assign)
+    )
+
+    # --- 5) outbound INV lanes (sessions ++ replay slots) -----------------
+    infl = sess.status == t.S_INFL
+    out_inv = st.Invs(
+        valid=jnp.concatenate([infl, replay.active]) & ~ctl.frozen,
+        key=jnp.concatenate([sess.key, replay.key]),
+        ver=jnp.concatenate([sess.ver, replay.ver]),
+        fc=jnp.concatenate([sess.fc, replay.fc]),
+        epoch=jnp.broadcast_to(ctl.epoch, (cfg.n_lanes,)).astype(jnp.int32),
+        val=jnp.concatenate([sess.val, replay.val], axis=0),
+        alive=~ctl.frozen,
+    )
+
+    # --- completions (reads + nops) ---------------------------------------
+    code = jnp.where(read_done, t.C_READ, jnp.where(is_nop, t.C_NOP, t.C_NONE))
+    comp = st.Completions(
+        code=code.astype(jnp.int32),
+        key=sess.key,
+        wval=sess.val,
+        rval=sess.rd_val,
+        invoke_step=sess.invoke_step,
+        commit_step=jnp.broadcast_to(ctl.step, (S,)).astype(jnp.int32),
+    )
+    return CoordinateOut(table, sess, replay, out_inv, comp)
+
+
+def _minscatter(size, idx, val, mask):
+    return jnp.full((size,), jnp.iinfo(jnp.int32).max, jnp.int32).at[
+        jnp.where(mask, idx, size)
+    ].min(val, mode="drop")
+
+
+class ApplyInvOut(NamedTuple):
+    table: st.KeyTable
+    sess: st.Sessions
+    meta: st.Meta
+    out_ack: st.Acks
+    comp: st.Completions
+
+
+def apply_inv(
+    cfg: HermesConfig,
+    ctl: st.Ctl,
+    table: st.KeyTable,
+    sess: st.Sessions,
+    meta: st.Meta,
+    in_inv: st.Invs,
+) -> ApplyInvOut:
+    """The follower-side ``apply_inv()`` handler (BASELINE.json:5) over a full
+    (R, L) inbound INV block: if ts_in > ts_local apply value+ts and move the
+    key to Invalid (Trans if a local write was pending), and ALWAYS ack —
+    same-ts duplicates (rebroadcast, replay) are acked without effect, the
+    idempotence the recovery path relies on (SURVEY.md §3.4).
+
+    Also detects supersession of local pending updates: a pending RMW whose
+    key timestamp moved is aborted here (YCSB-F conflict rule,
+    BASELINE.json:8); a pending plain write just marks ``superseded`` and
+    keeps gathering acks (the Trans path).
+    """
+    K, S = cfg.n_keys, cfg.n_sessions
+    R, L = in_inv.valid.shape
+
+    ok = in_inv.valid & (in_inv.epoch == ctl.epoch) & ~ctl.frozen
+    key = in_inv.key.reshape(-1)
+    ver = in_inv.ver.reshape(-1)
+    fc = in_inv.fc.reshape(-1)
+    val = in_inv.val.reshape(R * L, cfg.value_words)
+    okf = ok.reshape(-1)
+
+    # Two-pass lexicographic max over this step's INVs per key (contended-key
+    # conflict resolution, SURVEY.md §7 hard part 4).
+    bver = _maxscatter(K, key, ver, okf)
+    vmax = okf & (ver == bver[jnp.clip(key, 0, K - 1)])
+    bfc = _maxscatter(K, key, fc, vmax)
+    winner = vmax & (fc == bfc[jnp.clip(key, 0, K - 1)])
+
+    beats = winner & ts_gt(ver, fc, table.ver[jnp.clip(key, 0, K - 1)], table.fc[jnp.clip(key, 0, K - 1)])
+    had_pending = (table.state == t.WRITE) | (table.state == t.TRANS)
+    new_state = jnp.where(had_pending[jnp.clip(key, 0, K - 1)], t.TRANS, t.INVALID).astype(jnp.int32)
+
+    table = table._replace(
+        state=_set(table.state, key, new_state, beats),
+        ver=_set(table.ver, key, ver, beats),
+        fc=_set(table.fc, key, fc, beats),
+        val=_set(table.val, key, val, beats),
+        inv_step=_set(table.inv_step, key, jnp.broadcast_to(ctl.step, key.shape), beats),
+    )
+
+    # --- supersession of local pending updates ----------------------------
+    infl = sess.status == t.S_INFL
+    moved = infl & ~ts_eq(sess.ver, sess.fc, table.ver[sess.key], table.fc[sess.key]) & ~ctl.frozen
+    abort = moved & (sess.op == t.OP_RMW)
+    sess = sess._replace(
+        superseded=sess.superseded | (moved & (sess.op == t.OP_WRITE)),
+        status=jnp.where(abort, t.S_IDLE, sess.status),
+        op_idx=jnp.where(abort, sess.op_idx + 1, sess.op_idx),
+    )
+    meta = meta._replace(n_abort=meta.n_abort + jnp.sum(abort, dtype=jnp.int32))
+
+    comp = st.Completions(
+        code=jnp.where(abort, t.C_RMW_ABORT, t.C_NONE).astype(jnp.int32),
+        key=sess.key,
+        wval=sess.val,
+        rval=sess.rd_val,
+        invoke_step=sess.invoke_step,
+        commit_step=jnp.broadcast_to(ctl.step, (S,)).astype(jnp.int32),
+    )
+
+    # --- ACK every valid INV (echo its ts back to its sender's lane) ------
+    out_ack = st.Acks(
+        valid=ok & ~ctl.frozen,
+        key=in_inv.key,
+        ver=in_inv.ver,
+        fc=in_inv.fc,
+        epoch=jnp.broadcast_to(ctl.epoch, (R, L)).astype(jnp.int32),
+    )
+
+    # --- heartbeats (host membership service input, SURVEY.md §5.3) -------
+    meta = meta._replace(
+        last_seen=jnp.where(in_inv.alive & ~ctl.frozen, ctl.step, meta.last_seen)
+    )
+    return ApplyInvOut(table, sess, meta, out_ack, comp)
+
+
+def _maxscatter(size, idx, val, mask):
+    return jnp.full((size,), I32_MIN, jnp.int32).at[
+        jnp.where(mask, idx, size)
+    ].max(val, mode="drop")
+
+
+class CollectAcksOut(NamedTuple):
+    table: st.KeyTable
+    sess: st.Sessions
+    replay: st.ReplaySlots
+    meta: st.Meta
+    out_val: st.Vals
+    comp: st.Completions
+
+
+def collect_acks(
+    cfg: HermesConfig,
+    ctl: st.Ctl,
+    table: st.KeyTable,
+    sess: st.Sessions,
+    replay: st.ReplaySlots,
+    meta: st.Meta,
+    in_ack: st.Acks,
+) -> CollectAcksOut:
+    """The coordinator-side ``poll_acks()`` + commit + ``broadcast_val()``
+    (BASELINE.json:5).  Inbound acks are lane-aligned: in_ack[q, l] is
+    replica q's ack of MY lane l's INV.  A pending update commits when its
+    gathered-ack bitmap covers every live replica — the write's linearization
+    point (SURVEY.md §3.1).  Commits emit lane-aligned VALs.
+
+    Replay lanes commit the same way; a replay slot whose key timestamp moved
+    past the slot's (a newer write took over) is simply released — the newer
+    writer's VAL will validate the key.
+    """
+    S, RS = cfg.n_sessions, cfg.replay_slots
+    R = in_ack.valid.shape[0]
+    full = jnp.int32((1 << R) - 1)
+    bit = (jnp.int32(1) << jnp.arange(R, dtype=jnp.int32))[:, None]
+
+    ok = in_ack.valid & (in_ack.epoch == ctl.epoch) & ~ctl.frozen
+    sess_ack = ok[:, :S] & ts_eq(in_ack.ver[:, :S], in_ack.fc[:, :S], sess.ver[None, :], sess.fc[None, :])
+    rep_ack = ok[:, S:] & ts_eq(in_ack.ver[:, S:], in_ack.fc[:, S:], replay.ver[None, :], replay.fc[None, :])
+
+    infl = sess.status == t.S_INFL
+    acks = sess.acks | jnp.sum(jnp.where(sess_ack, bit, 0), axis=0).astype(jnp.int32)
+    acks = jnp.where(infl, acks, sess.acks)
+    covered = ((acks | ~ctl.live_mask) & full) == full
+    commit = infl & covered & ~ctl.frozen
+
+    # Key goes Valid only if this update still owns the key's timestamp.
+    owns = ts_eq(sess.ver, sess.fc, table.ver[sess.key], table.fc[sess.key])
+    table = table._replace(
+        state=_set(table.state, sess.key, jnp.full((S,), t.VALID, jnp.int32), commit & owns)
+    )
+
+    # --- replay lanes ------------------------------------------------------
+    racks = jnp.where(
+        replay.active,
+        replay.acks | jnp.sum(jnp.where(rep_ack, bit, 0), axis=0).astype(jnp.int32),
+        replay.acks,
+    )
+    rcovered = ((racks | ~ctl.live_mask) & full) == full
+    rowns = ts_eq(replay.ver, replay.fc, table.ver[replay.key], table.fc[replay.key])
+    rcommit = replay.active & rcovered & ~ctl.frozen
+    rsuperseded = replay.active & ~rowns & ~ctl.frozen
+    table = table._replace(
+        state=_set(
+            table.state, replay.key, jnp.full((RS,), t.VALID, jnp.int32), rcommit & rowns
+        )
+    )
+    replay = replay._replace(
+        acks=racks, active=replay.active & ~rcommit & ~rsuperseded
+    )
+
+    # --- outbound VALs -----------------------------------------------------
+    out_val = st.Vals(
+        valid=jnp.concatenate([commit, rcommit & rowns]) & ~ctl.frozen,
+        key=jnp.concatenate([sess.key, replay.key]),
+        ver=jnp.concatenate([sess.ver, replay.ver]),
+        fc=jnp.concatenate([sess.fc, replay.fc]),
+        epoch=jnp.broadcast_to(ctl.epoch, (cfg.n_lanes,)).astype(jnp.int32),
+    )
+
+    # --- session completion + stats ---------------------------------------
+    is_rmw = sess.op == t.OP_RMW
+    code = jnp.where(commit, jnp.where(is_rmw, t.C_RMW, t.C_WRITE), t.C_NONE)
+    comp = st.Completions(
+        code=code.astype(jnp.int32),
+        key=sess.key,
+        wval=sess.val,
+        rval=sess.rd_val,
+        invoke_step=sess.invoke_step,
+        commit_step=jnp.broadcast_to(ctl.step, (S,)).astype(jnp.int32),
+    )
+    lat = jnp.where(commit, ctl.step - sess.invoke_step, 0)
+    nbin = st.LAT_BINS
+    meta = meta._replace(
+        n_write=meta.n_write + jnp.sum(commit & ~is_rmw, dtype=jnp.int32),
+        n_rmw=meta.n_rmw + jnp.sum(commit & is_rmw, dtype=jnp.int32),
+        lat_sum=meta.lat_sum + jnp.sum(lat, dtype=jnp.int32),
+        lat_cnt=meta.lat_cnt + jnp.sum(commit, dtype=jnp.int32),
+        lat_hist=meta.lat_hist.at[jnp.where(commit, jnp.clip(lat, 0, nbin - 1), nbin)].add(
+            1, mode="drop"
+        ),
+    )
+
+    sess = sess._replace(
+        acks=acks,
+        status=jnp.where(commit, t.S_IDLE, sess.status),
+        op_idx=jnp.where(commit, sess.op_idx + 1, sess.op_idx),
+    )
+    return CollectAcksOut(table, sess, replay, meta, out_val, comp)
+
+
+def apply_val(
+    cfg: HermesConfig, ctl: st.Ctl, table: st.KeyTable, in_val: st.Vals
+) -> st.KeyTable:
+    """Follower-side VAL apply (SURVEY.md §3.1 tail): a VAL whose timestamp
+    exactly matches the key's current timestamp validates the key.  Multiple
+    same-key VALs in a step necessarily carry the same ts, so duplicate
+    scatter rows write identical state."""
+    K = cfg.n_keys
+    key = in_val.key.reshape(-1)
+    ok = (
+        in_val.valid.reshape(-1)
+        & (in_val.epoch.reshape(-1) == ctl.epoch)
+        & ~ctl.frozen
+        & ts_eq(
+            in_val.ver.reshape(-1),
+            in_val.fc.reshape(-1),
+            table.ver[jnp.clip(key, 0, K - 1)],
+            table.fc[jnp.clip(key, 0, K - 1)],
+        )
+    )
+    return table._replace(
+        state=_set(table.state, key, jnp.full(key.shape, t.VALID, jnp.int32), ok)
+    )
+
+
+def merge_completions(*comps: st.Completions) -> st.Completions:
+    """At most one completion per session per step (phases complete disjoint
+    session sets); later phases win where they completed something."""
+    out = comps[0]
+    for c in comps[1:]:
+        m = c.code != t.C_NONE
+        out = st.Completions(
+            code=jnp.where(m, c.code, out.code),
+            key=jnp.where(m, c.key, out.key),
+            wval=jnp.where(m[..., None], c.wval, out.wval),
+            rval=jnp.where(m[..., None], c.rval, out.rval),
+            invoke_step=jnp.where(m, c.invoke_step, out.invoke_step),
+            commit_step=jnp.where(m, c.commit_step, out.commit_step),
+        )
+    return out

@@ -1,0 +1,310 @@
+"""State containers: key-state table, sessions, replay slots, message blocks.
+
+Everything is a NamedTuple of fixed-shape int32 arrays (automatic pytrees),
+struct-of-arrays so each column maps to a contiguous HBM buffer — the layout
+BASELINE.json:5 prescribes ("an HBM-resident key-state table of millions of
+in-flight writes").  The reference colocates per-key metadata with the value
+in its MICA-style store (SURVEY.md §1 L2); here each metadata field is its own
+column, which is what the vmapped kernel wants.
+
+Shapes use the config aliases: K = n_keys, S = n_sessions, RS = replay_slots,
+L = n_lanes = S + RS, V = value_words, R = n_replicas, G = ops_per_session.
+All state is per-replica; replica-batched runs add a leading R axis via vmap,
+sharded runs shard the same pytrees over the 'replica' mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from hermes_tpu import config as config_lib
+from hermes_tpu.core import types
+
+
+class KeyTable(NamedTuple):
+    """Per-key replicated KVS state (SURVEY.md §1 L2 + L3 metadata).
+
+    ``state``    (K,)   one of types.VALID/INVALID/WRITE/TRANS/REPLAY
+    ``ver``      (K,)   timestamp version word
+    ``fc``       (K,)   timestamp tie-break word ((flag<<8)|cid)
+    ``val``      (K,V)  value words; words 0-1 are the unique write id
+    ``inv_step`` (K,)   step of the last timestamp change (drives the replay
+                        age test, SURVEY.md §3.4)
+    """
+
+    state: jnp.ndarray
+    ver: jnp.ndarray
+    fc: jnp.ndarray
+    val: jnp.ndarray
+    inv_step: jnp.ndarray
+
+
+class Sessions(NamedTuple):
+    """Per-replica client sessions (reference: session arrays in worker.c,
+    SURVEY.md §1 L5).  One in-flight op per session; the session index is also
+    the outbound message lane for its pending update.
+
+    ``status``   (S,)  types.S_*
+    ``op``       (S,)  current op code (types.OP_*)
+    ``op_idx``   (S,)  next index into the pre-generated op stream
+    ``key``      (S,)  current op's key
+    ``val``      (S,V) value being written (updates)
+    ``ver``/``fc`` (S,) pending update timestamp
+    ``acks``     (S,)  replica-bitmap of gathered ACKs for the pending update
+    ``superseded`` (S,) pending write lost to a higher-ts INV (Trans path)
+    ``rd_val``   (S,V) value observed by a read / RMW read-part
+    ``invoke_step`` (S,) step the current op was loaded (history invocation time)
+    """
+
+    status: jnp.ndarray
+    op: jnp.ndarray
+    op_idx: jnp.ndarray
+    key: jnp.ndarray
+    val: jnp.ndarray
+    ver: jnp.ndarray
+    fc: jnp.ndarray
+    acks: jnp.ndarray
+    superseded: jnp.ndarray
+    rd_val: jnp.ndarray
+    invoke_step: jnp.ndarray
+
+
+class ReplaySlots(NamedTuple):
+    """In-flight replays (SURVEY.md §3.4): a key stuck Invalid past the age
+    threshold is re-driven to Valid by re-broadcasting its last INV with the
+    SAME timestamp and value (idempotent).  Value/ts are snapshotted into the
+    slot so a concurrent higher-ts INV on the key cannot corrupt the replay.
+
+    ``active`` (RS,)  slot in use
+    ``key``    (RS,)
+    ``ver``/``fc`` (RS,) the replayed timestamp
+    ``val``    (RS,V)
+    ``acks``   (RS,)  gathered-ack bitmap
+    """
+
+    active: jnp.ndarray
+    key: jnp.ndarray
+    ver: jnp.ndarray
+    fc: jnp.ndarray
+    val: jnp.ndarray
+    acks: jnp.ndarray
+
+
+class Invs(NamedTuple):
+    """INV message block.  Outbound: (L, ...) one lane per session/replay
+    slot.  Inbound (after broadcast): (R, L, ...).  INVs carry the value —
+    the property that lets any replica finish a dead coordinator's write
+    (SURVEY.md §3.4)."""
+
+    valid: jnp.ndarray  # bool
+    key: jnp.ndarray
+    ver: jnp.ndarray
+    fc: jnp.ndarray
+    epoch: jnp.ndarray
+    val: jnp.ndarray  # (..., V)
+    alive: jnp.ndarray  # () outbound / (R,) inbound heartbeat bit (SURVEY.md §5.3)
+
+
+class Acks(NamedTuple):
+    """ACK block.  Outbound: (R, L) — ack[p, l] answers the INV received from
+    replica p in lane l; routed back by all_to_all.  Inbound: (R, L) where
+    [q, l] is q's ack of MY lane l."""
+
+    valid: jnp.ndarray
+    key: jnp.ndarray
+    ver: jnp.ndarray
+    fc: jnp.ndarray
+    epoch: jnp.ndarray
+
+
+class Vals(NamedTuple):
+    """VAL block, lane-aligned with the sender's INV lanes; broadcast."""
+
+    valid: jnp.ndarray
+    key: jnp.ndarray
+    ver: jnp.ndarray
+    fc: jnp.ndarray
+    epoch: jnp.ndarray
+
+
+class Completions(NamedTuple):
+    """Per-step, per-session completion records — the raw material for the
+    linearizability history (SURVEY.md §4) and the stats counters (§5.5).
+
+    ``code`` (S,) types.C_*; C_NONE when the session completed nothing.
+    ``key``  (S,)
+    ``wval`` (S,V) value written (updates)
+    ``rval`` (S,V) value read (reads / RMW read-part)
+    ``invoke_step``/``commit_step`` (S,)
+    """
+
+    code: jnp.ndarray
+    key: jnp.ndarray
+    wval: jnp.ndarray
+    rval: jnp.ndarray
+    invoke_step: jnp.ndarray
+    commit_step: jnp.ndarray
+
+
+class Ctl(NamedTuple):
+    """Per-replica, per-step control scalars (all int32 unless noted).
+
+    ``step``      global step counter (bulk-synchronous "time"; real-time
+                  order for the linearizability history, SURVEY.md §7 hard
+                  part 1)
+    ``my_cid``    this replica's id (the Lamport tie-break cid)
+    ``epoch``     membership epoch; stale-epoch messages are dropped
+                  (SURVEY.md §1 L4)
+    ``live_mask`` bitmap of live replicas; the ack-quorum test is
+                  (acks | ~live_mask) covers all (BASELINE.json:5)
+    ``frozen``    bool; failure injection: a frozen replica makes no state
+                  transitions and emits nothing (config 4, BASELINE.json:10).
+                  Freezing also models lease self-fencing — a fenced replica
+                  must not serve reads (SURVEY.md §5.3).
+    """
+
+    step: jnp.ndarray
+    my_cid: jnp.ndarray
+    epoch: jnp.ndarray
+    live_mask: jnp.ndarray
+    frozen: jnp.ndarray
+
+
+class Meta(NamedTuple):
+    """Per-replica observability state (SURVEY.md §5.5): heartbeat tracking
+    for the host-side membership service plus committed-op counters and a
+    commit-latency histogram (steps, clipped to the last bin).
+
+    ``last_seen`` (R,) last step a valid heartbeat arrived from each peer
+    ``n_read`` / ``n_write`` / ``n_rmw`` / ``n_abort`` () completed-op counts
+    ``lat_sum`` / ``lat_cnt`` () commit-latency accumulator (update ops)
+    ``lat_hist`` (LAT_BINS,) latency histogram
+    """
+
+    last_seen: jnp.ndarray
+    n_read: jnp.ndarray
+    n_write: jnp.ndarray
+    n_rmw: jnp.ndarray
+    n_abort: jnp.ndarray
+    lat_sum: jnp.ndarray
+    lat_cnt: jnp.ndarray
+    lat_hist: jnp.ndarray
+
+
+LAT_BINS = 64
+
+
+class OpStream(NamedTuple):
+    """Pre-generated per-session op stream (SURVEY.md §1 L6): (S, G) arrays.
+    Write values are derived on device from (replica, session, op_idx), so the
+    stream only stores op codes and keys."""
+
+    op: jnp.ndarray
+    key: jnp.ndarray
+
+
+def init_table(cfg: config_lib.HermesConfig) -> KeyTable:
+    """All keys preloaded Valid at version 0 (reference preloads 1M keys at
+    startup, SURVEY.md §3.5 / BASELINE.json:7).  The initial value id is
+    (hi=-1, lo=key) so the checker can recognize initial reads."""
+    k, v = cfg.n_keys, cfg.value_words
+    val = jnp.zeros((k, v), jnp.int32)
+    val = val.at[:, 0].set(jnp.arange(k, dtype=jnp.int32))
+    val = val.at[:, 1].set(-1)
+    return KeyTable(
+        state=jnp.full((k,), types.VALID, jnp.int32),
+        ver=jnp.zeros((k,), jnp.int32),
+        fc=jnp.zeros((k,), jnp.int32),
+        val=val,
+        inv_step=jnp.zeros((k,), jnp.int32),
+    )
+
+
+def init_sessions(cfg: config_lib.HermesConfig) -> Sessions:
+    s, v = cfg.n_sessions, cfg.value_words
+    return Sessions(
+        status=jnp.full((s,), types.S_IDLE, jnp.int32),
+        op=jnp.zeros((s,), jnp.int32),
+        op_idx=jnp.zeros((s,), jnp.int32),
+        key=jnp.zeros((s,), jnp.int32),
+        val=jnp.zeros((s, v), jnp.int32),
+        ver=jnp.zeros((s,), jnp.int32),
+        fc=jnp.zeros((s,), jnp.int32),
+        acks=jnp.zeros((s,), jnp.int32),
+        superseded=jnp.zeros((s,), jnp.bool_),
+        rd_val=jnp.zeros((s, v), jnp.int32),
+        invoke_step=jnp.zeros((s,), jnp.int32),
+    )
+
+
+def init_replay(cfg: config_lib.HermesConfig) -> ReplaySlots:
+    rs, v = cfg.replay_slots, cfg.value_words
+    return ReplaySlots(
+        active=jnp.zeros((rs,), jnp.bool_),
+        key=jnp.zeros((rs,), jnp.int32),
+        ver=jnp.zeros((rs,), jnp.int32),
+        fc=jnp.zeros((rs,), jnp.int32),
+        val=jnp.zeros((rs, v), jnp.int32),
+        acks=jnp.zeros((rs,), jnp.int32),
+    )
+
+
+def init_meta(cfg: config_lib.HermesConfig) -> Meta:
+    z = jnp.zeros((), jnp.int32)
+    return Meta(
+        last_seen=jnp.zeros((cfg.n_replicas,), jnp.int32),
+        n_read=z,
+        n_write=z,
+        n_rmw=z,
+        n_abort=z,
+        lat_sum=z,
+        lat_cnt=z,
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+class ReplicaState(NamedTuple):
+    """Everything one replica owns: KVS table, client sessions, replay slots,
+    observability.  Batched runs give every leaf a leading R axis (vmap);
+    sharded runs shard the same pytree over the 'replica' mesh axis."""
+
+    table: KeyTable
+    sess: Sessions
+    replay: ReplaySlots
+    meta: Meta
+
+
+def init_replica_state(cfg: config_lib.HermesConfig) -> ReplicaState:
+    return ReplicaState(
+        table=init_table(cfg),
+        sess=init_sessions(cfg),
+        replay=init_replay(cfg),
+        meta=init_meta(cfg),
+    )
+
+
+def empty_invs(cfg: config_lib.HermesConfig, lead=()) -> Invs:
+    l, v = cfg.n_lanes, cfg.value_words
+    return Invs(
+        valid=jnp.zeros(lead + (l,), jnp.bool_),
+        key=jnp.zeros(lead + (l,), jnp.int32),
+        ver=jnp.zeros(lead + (l,), jnp.int32),
+        fc=jnp.zeros(lead + (l,), jnp.int32),
+        epoch=jnp.zeros(lead + (l,), jnp.int32),
+        val=jnp.zeros(lead + (l, v), jnp.int32),
+        alive=jnp.zeros(lead, jnp.bool_),
+    )
+
+
+def empty_acks(cfg: config_lib.HermesConfig, lead=()) -> Acks:
+    l = cfg.n_lanes
+    z = lambda: jnp.zeros(lead + (l,), jnp.int32)
+    return Acks(valid=jnp.zeros(lead + (l,), jnp.bool_), key=z(), ver=z(), fc=z(), epoch=z())
+
+
+def empty_vals(cfg: config_lib.HermesConfig, lead=()) -> Vals:
+    l = cfg.n_lanes
+    z = lambda: jnp.zeros(lead + (l,), jnp.int32)
+    return Vals(valid=jnp.zeros(lead + (l,), jnp.bool_), key=z(), ver=z(), fc=z(), epoch=z())

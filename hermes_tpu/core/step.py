@@ -1,0 +1,196 @@
+"""One bulk-synchronous protocol step, composed from the phases.
+
+The reference's per-op hot loop (SURVEY.md §3.1) becomes:
+
+    coordinate -> [INV broadcast] -> apply_inv -> [ACK route-back]
+               -> collect_acks    -> [VAL broadcast] -> apply_val
+
+The three exchanges are the transport seam (SURVEY.md §1 L1, §5.8).  This
+module provides the two *collective* realizations:
+
+  * ``build_step_batched`` — all R replicas on one device, leading R axis via
+    vmap; exchanges are array ops (broadcast / swapaxes).  This is the
+    single-process multi-replica mode the reference uses for cluster-free
+    testing (SURVEY.md §4, BASELINE.json:7) and the single-chip bench mode.
+  * ``build_step_sharded`` — one replica per device over a
+    ``Mesh(('replica',))``; exchanges are ``lax.all_gather`` (INV/VAL are
+    broadcasts) and ``lax.all_to_all`` (ACKs route back to their INV's
+    sender), riding ICI per BASELINE.json:5 (``transport=tpu_ici``).
+
+The host-mediated transports (deterministic adversarial sim, C++ tcp) reuse
+the same vmapped phases but run the exchange outside jit — see
+hermes_tpu/transport/.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import phases, state as st
+from hermes_tpu.core import types as t
+
+
+class StepCtl(NamedTuple):
+    """Host-supplied per-step control: global step scalar plus per-replica
+    epoch / live-mask / frozen arrays (membership + failure injection,
+    SURVEY.md §5.3)."""
+
+    step: jnp.ndarray  # () int32
+    epoch: jnp.ndarray  # (R,) int32
+    live_mask: jnp.ndarray  # (R,) int32
+    frozen: jnp.ndarray  # (R,) bool
+
+
+def make_ctl(cfg: HermesConfig, step: int) -> StepCtl:
+    r = cfg.n_replicas
+    return StepCtl(
+        step=jnp.int32(step),
+        epoch=jnp.zeros((r,), jnp.int32),
+        live_mask=jnp.full((r,), cfg.full_mask, jnp.int32),
+        frozen=jnp.zeros((r,), jnp.bool_),
+    )
+
+
+def _per_replica_ctl(cfg: HermesConfig, ctl: StepCtl) -> st.Ctl:
+    r = cfg.n_replicas
+    return st.Ctl(
+        step=jnp.broadcast_to(ctl.step, (r,)).astype(jnp.int32),
+        my_cid=jnp.arange(r, dtype=jnp.int32),
+        epoch=ctl.epoch,
+        live_mask=ctl.live_mask,
+        frozen=ctl.frozen,
+    )
+
+
+# --------------------------------------------------------------------------
+# Vmapped phases (shared by the batched step and the host-mediated runtimes)
+# --------------------------------------------------------------------------
+
+
+def vmapped_phases(cfg: HermesConfig):
+    """Phase functions lifted over a leading replica axis."""
+    return dict(
+        coordinate=jax.vmap(functools.partial(phases.coordinate, cfg)),
+        apply_inv=jax.vmap(functools.partial(phases.apply_inv, cfg)),
+        collect_acks=jax.vmap(functools.partial(phases.collect_acks, cfg)),
+        apply_val=jax.vmap(functools.partial(phases.apply_val, cfg)),
+    )
+
+
+def lockstep_bcast(block):
+    """Batched-mode broadcast: per-src outbound (R, ...) -> per-dst inbound
+    (R_dst, R_src, ...)."""
+    r = jax.tree_util.tree_leaves(block)[0].shape[0]
+    return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (r,) + x.shape), block)
+
+
+def lockstep_route_back(block):
+    """Batched-mode ACK routing: out[p][q, l] -> in[q][p, l]."""
+    return jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), block)
+
+
+def _step_core(cfg: HermesConfig, ph, bcast, route_back, rs: st.ReplicaState, stream, ctl):
+    """The step body, parameterized over the exchange primitives.
+
+    ``ph`` are (possibly vmapped) phase fns; ``bcast``/``route_back`` realize
+    the INV/VAL broadcast and ACK route-back on whatever substrate (array
+    ops, ICI collectives, host network)."""
+    pctl = ctl
+    c = ph["coordinate"](pctl, rs.table, rs.sess, rs.replay, stream)
+    in_inv = bcast(c.out_inv)
+    a = ph["apply_inv"](pctl, c.table, c.sess, rs.meta, in_inv)
+    in_ack = route_back(a.out_ack)
+    k = ph["collect_acks"](pctl, a.table, a.sess, c.replay, a.meta, in_ack)
+    in_val = bcast(k.out_val)
+    table = ph["apply_val"](pctl, k.table, in_val)
+
+    comp = phases.merge_completions(c.comp, a.comp, k.comp)
+    meta = k.meta._replace(
+        n_read=k.meta.n_read + jnp.sum(comp.code == t.C_READ, axis=-1, dtype=jnp.int32)
+    )
+    return st.ReplicaState(table, k.sess, k.replay, meta), comp
+
+
+def build_step_batched(cfg: HermesConfig):
+    """Single-device, R-replica lockstep step: jit( (state, stream, ctl) ->
+    (state, completions) ).  All leaves carry a leading R axis."""
+    ph = vmapped_phases(cfg)
+
+    @jax.jit
+    def step(rs: st.ReplicaState, stream: st.OpStream, ctl: StepCtl):
+        pctl = _per_replica_ctl(cfg, ctl)
+        return _step_core(cfg, ph, lockstep_bcast, lockstep_route_back, rs, stream, pctl)
+
+    return step
+
+
+# --------------------------------------------------------------------------
+# Sharded step: one replica per device over Mesh(('replica',))
+# --------------------------------------------------------------------------
+
+
+def build_step_sharded(cfg: HermesConfig, mesh: Mesh):
+    """The ``transport=tpu_ici`` step (BASELINE.json:5): the same phases run
+    per-shard under shard_map; INV/VAL broadcasts are ``all_gather`` and the
+    ACK route-back is ``all_to_all`` over the 'replica' ICI axis."""
+    if mesh.shape["replica"] != cfg.n_replicas:
+        raise ValueError("mesh 'replica' axis size must equal cfg.n_replicas")
+
+    def bcast(block):
+        return jax.tree.map(
+            lambda x: jax.lax.all_gather(x, "replica", axis=0, tiled=False), block
+        )
+
+    def route_back(block):
+        return jax.tree.map(
+            lambda x: jax.lax.all_to_all(x, "replica", split_axis=0, concat_axis=0, tiled=True),
+            block,
+        )
+
+    ph = dict(
+        coordinate=functools.partial(phases.coordinate, cfg),
+        apply_inv=functools.partial(phases.apply_inv, cfg),
+        collect_acks=functools.partial(phases.collect_acks, cfg),
+        apply_val=functools.partial(phases.apply_val, cfg),
+    )
+
+    def shard_body(rs, stream, ctl):
+        # Leaves arrive with a leading local axis of size 1; strip it.
+        rs1 = jax.tree.map(lambda x: x[0], rs)
+        stream1 = jax.tree.map(lambda x: x[0], stream)
+        my = jax.lax.axis_index("replica").astype(jnp.int32)
+        pctl = st.Ctl(
+            step=ctl.step,
+            my_cid=my,
+            epoch=ctl.epoch[0],
+            live_mask=ctl.live_mask[0],
+            frozen=ctl.frozen[0],
+        )
+        out_rs, comp = _step_core(cfg, ph, bcast, route_back, rs1, stream1, pctl)
+        return jax.tree.map(lambda x: x[None], out_rs), jax.tree.map(lambda x: x[None], comp)
+
+    rspec = P("replica")
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(rspec, rspec, StepCtl(step=P(), epoch=rspec, live_mask=rspec, frozen=rspec)),
+        out_specs=(rspec, rspec),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def place_sharded(cfg: HermesConfig, mesh: Mesh, rs: st.ReplicaState, stream: st.OpStream):
+    """Device-place a replica-batched state pytree, sharding the leading R
+    axis over the mesh."""
+    sh = NamedSharding(mesh, P("replica"))
+    return (
+        jax.device_put(rs, sh),
+        jax.device_put(stream, sh),
+    )

@@ -1,0 +1,36 @@
+"""Lamport timestamps.
+
+The reference compares ``ts = (version, cid)`` lexicographically on every
+INV/ACK apply (SURVEY.md §2 "Lamport timestamp comparator"; BASELINE.json:5).
+We keep the timestamp as two int32 columns instead of a packed uint64 —
+64-bit integer ops are emulated on TPU, two int32 compares fuse fine:
+
+- ``ver``: the version number (monotonically increasing per key).
+- ``fc``:  the tie-break word, ``(write_flag << 8) | cid``.  ``write_flag``
+  gives plain writes priority over RMWs from the same base version (see
+  core/types.py FLAG_*), and ``cid`` (coordinator/replica id) makes
+  timestamps from distinct replicas unique.
+
+All helpers are elementwise and jit/vmap/pallas-safe.
+"""
+
+from __future__ import annotations
+
+
+def make_fc(write_flag, cid):
+    """Pack the tie-break word: (flag << 8) | cid."""
+    return (write_flag << 8) | cid
+
+
+def fc_cid(fc):
+    """Extract the coordinator id from the tie-break word."""
+    return fc & 0xFF
+
+
+def ts_gt(ver_a, fc_a, ver_b, fc_b):
+    """Lexicographic (ver, fc) greater-than: a > b."""
+    return (ver_a > ver_b) | ((ver_a == ver_b) & (fc_a > fc_b))
+
+
+def ts_eq(ver_a, fc_a, ver_b, fc_b):
+    return (ver_a == ver_b) & (fc_a == fc_b)

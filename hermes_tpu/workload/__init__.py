@@ -1,0 +1,1 @@
+"""Workload generation (SURVEY.md §1 L6): YCSB-style synthetic op streams."""

@@ -1,0 +1,69 @@
+"""YCSB-style op-stream generation (SURVEY.md §1 L6, §2 "Workload generator").
+
+The reference drives itself with an in-process YCSB-like generator — write
+ratio, key count, uniform/Zipfian(0.99) skew (BASELINE.json:7-9).  Here the
+whole run's op stream is pre-generated host-side into (S, G) int32 arrays per
+replica (the device derives write values on the fly, see
+phases._write_value), so the hot loop never touches the host RNG.
+
+Mixes map to the acceptance configs:
+  * YCSB-A: read_frac=0.5, rmw_frac=0  (config 1)
+  * YCSB-F: rmw_frac=1.0 on the update half (config 2)
+  * Zipfian hotspot: distribution='zipfian', theta=0.99 (config 3)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import state as st
+from hermes_tpu.core import types as t
+
+
+def _zipf_cdf(n: int, theta: float) -> np.ndarray:
+    """CDF of the Zipfian(theta) distribution over ranks 1..n (YCSB's
+    definition: p(rank i) ~ 1/i^theta)."""
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), theta)
+    cdf = np.cumsum(w)
+    return cdf / cdf[-1]
+
+
+def sample_keys(
+    rng: np.random.Generator, cfg: HermesConfig, size: tuple[int, ...]
+) -> np.ndarray:
+    wl = cfg.workload
+    if wl.distribution == "uniform":
+        return rng.integers(0, cfg.n_keys, size=size, dtype=np.int32)
+    if wl.distribution == "zipfian":
+        cdf = _zipf_cdf(cfg.n_keys, wl.zipf_theta)
+        ranks = np.searchsorted(cdf, rng.random(size=size))
+        # Scramble ranks -> keys with a fixed permutation so the hot ranks are
+        # spread over the key space (YCSB's "scrambled zipfian").
+        perm = np.random.default_rng(wl.seed ^ 0x5CA1AB1E).permutation(cfg.n_keys)
+        return perm[ranks].astype(np.int32)
+    raise ValueError(f"unknown distribution {wl.distribution!r}")
+
+
+def make_stream(cfg: HermesConfig, replica: int) -> st.OpStream:
+    """Pre-generate one replica's (S, G) op stream."""
+    wl = cfg.workload
+    rng = np.random.default_rng((wl.seed << 8) ^ replica)
+    shape = (cfg.n_sessions, cfg.ops_per_session)
+    u = rng.random(size=shape)
+    op = np.where(u < wl.read_frac, t.OP_READ, t.OP_WRITE).astype(np.int32)
+    if wl.rmw_frac > 0:
+        is_upd = op == t.OP_WRITE
+        rmw = rng.random(size=shape) < wl.rmw_frac
+        op = np.where(is_upd & rmw, t.OP_RMW, op).astype(np.int32)
+    key = sample_keys(rng, cfg, shape)
+    return st.OpStream(op=op, key=key)
+
+
+def make_streams(cfg: HermesConfig) -> st.OpStream:
+    """All replicas' streams, stacked on a leading R axis."""
+    parts = [make_stream(cfg, r) for r in range(cfg.n_replicas)]
+    return st.OpStream(
+        op=np.stack([p.op for p in parts]),
+        key=np.stack([p.key for p in parts]),
+    )

@@ -1,0 +1,42 @@
+"""Test env: force pure-CPU JAX with a virtual 8-device mesh.
+
+Two things matter here (see SURVEY.md §7 "Local environment"):
+  * The container's sitecustomize registers the `axon` PJRT plugin (the
+    tunneled single TPU chip) in every python process; initializing it can
+    block on the TPU claim.  Tests must never touch it: we force the cpu
+    platform and clear any pre-registered backend set BEFORE first device
+    use (registration already happened at interpreter start; backend *init*
+    is lazy, so this is early enough).
+  * Sharded-step tests need multiple devices: 8 virtual CPU devices via
+    --xla_force_host_platform_device_count (the standard way to exercise
+    Mesh/shard_map code without 8 real chips).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # drop any backend set the axon sitecustomize may have pinned
+    from jax._src import xla_bridge
+
+    _clear = getattr(xla_bridge, "clear_backends", None) or getattr(
+        xla_bridge, "_clear_backends"
+    )
+    _clear()
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu"
+    return devs

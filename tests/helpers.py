@@ -1,0 +1,95 @@
+"""Shared test helpers: tiny configs, control scalars, message-block builders."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import state as st
+from hermes_tpu.core import types as t
+
+
+def tiny_cfg(**kw) -> HermesConfig:
+    base = dict(
+        n_replicas=3,
+        n_keys=64,
+        n_sessions=4,
+        replay_slots=2,
+        ops_per_session=8,
+        replay_age=4,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def ctl_scalars(step=0, cid=0, epoch=0, live_mask=None, frozen=False, cfg=None) -> st.Ctl:
+    if live_mask is None:
+        live_mask = cfg.full_mask if cfg else 0b111
+    return st.Ctl(
+        step=jnp.int32(step),
+        my_cid=jnp.int32(cid),
+        epoch=jnp.int32(epoch),
+        live_mask=jnp.int32(live_mask),
+        frozen=jnp.bool_(frozen),
+    )
+
+
+def empty_stream(cfg: HermesConfig) -> st.OpStream:
+    """All-NOP stream (sessions idle through the run)."""
+    shape = (cfg.n_sessions, cfg.ops_per_session)
+    return st.OpStream(
+        op=jnp.zeros(shape, jnp.int32), key=jnp.zeros(shape, jnp.int32)
+    )
+
+
+def inv_block(cfg: HermesConfig, records, n_senders=None, epoch=0):
+    """Build an inbound (R, L) INV block from [(sender, lane, key, ver, fc,
+    val_words), ...]."""
+    r = n_senders or cfg.n_replicas
+    blk = st.empty_invs(cfg, lead=(r,))
+    valid = np.zeros((r, cfg.n_lanes), bool)
+    key = np.zeros((r, cfg.n_lanes), np.int32)
+    ver = np.zeros((r, cfg.n_lanes), np.int32)
+    fc = np.zeros((r, cfg.n_lanes), np.int32)
+    val = np.zeros((r, cfg.n_lanes, cfg.value_words), np.int32)
+    for s, lane, k, v, f, words in records:
+        valid[s, lane] = True
+        key[s, lane] = k
+        ver[s, lane] = v
+        fc[s, lane] = f
+        val[s, lane, : len(words)] = words
+    return blk._replace(
+        valid=jnp.asarray(valid),
+        key=jnp.asarray(key),
+        ver=jnp.asarray(ver),
+        fc=jnp.asarray(fc),
+        epoch=jnp.full((r, cfg.n_lanes), epoch, jnp.int32),
+        val=jnp.asarray(val),
+        alive=jnp.ones((r,), jnp.bool_),
+    )
+
+
+def ack_block(cfg: HermesConfig, records, n_senders=None, epoch=0):
+    """Inbound (R, L) ACK block from [(sender, lane, key, ver, fc), ...]."""
+    r = n_senders or cfg.n_replicas
+    valid = np.zeros((r, cfg.n_lanes), bool)
+    key = np.zeros((r, cfg.n_lanes), np.int32)
+    ver = np.zeros((r, cfg.n_lanes), np.int32)
+    fc = np.zeros((r, cfg.n_lanes), np.int32)
+    for s, lane, k, v, f in records:
+        valid[s, lane] = True
+        key[s, lane] = k
+        ver[s, lane] = v
+        fc[s, lane] = f
+    return st.Acks(
+        valid=jnp.asarray(valid),
+        key=jnp.asarray(key),
+        ver=jnp.asarray(ver),
+        fc=jnp.asarray(fc),
+        epoch=jnp.full((r, cfg.n_lanes), epoch, jnp.int32),
+    )
+
+
+def get(x):
+    return np.asarray(jax.device_get(x))

@@ -1,0 +1,90 @@
+"""End-to-end lockstep runs of the batched R-replica step (SURVEY.md §4.2-ish
+without adversarial scheduling — that arrives with the sim transport):
+completion accounting and cross-replica convergence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import state as st, step as step_lib
+from hermes_tpu.core import types as t
+from hermes_tpu.workload import ycsb
+
+from helpers import get
+
+
+def run(cfg, n_steps):
+    rs0 = st.init_replica_state(cfg)
+    r = cfg.n_replicas
+    rs = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (r,) + x.shape), rs0)
+    stream = jax.tree.map(jnp.asarray, ycsb.make_streams(cfg))
+    step = step_lib.build_step_batched(cfg)
+    for s in range(n_steps):
+        rs, comp = step(rs, stream, step_lib.make_ctl(cfg, s))
+    return rs
+
+
+def assert_converged(cfg, rs):
+    """After the workload drains, every replica must hold an identical,
+    fully-Valid table (broadcast invalidation converges; SURVEY.md §3.1)."""
+    state = get(rs.table.state)
+    assert (state == t.VALID).all(), np.bincount(state.ravel(), minlength=5)
+    for col in ("ver", "fc", "val"):
+        arr = get(getattr(rs.table, col))
+        for r in range(1, cfg.n_replicas):
+            np.testing.assert_array_equal(arr[0], arr[r], err_msg=col)
+
+
+@pytest.mark.parametrize("mix", ["a", "f", "zipf"])
+def test_workload_drains_and_converges(mix):
+    wl = {
+        "a": WorkloadConfig(read_frac=0.5, seed=2),
+        "f": WorkloadConfig(read_frac=0.5, rmw_frac=1.0, seed=3),
+        "zipf": WorkloadConfig(read_frac=0.5, distribution="zipfian", zipf_theta=0.99, seed=4),
+    }[mix]
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=128, n_sessions=8, replay_slots=4, ops_per_session=16,
+        workload=wl,
+    )
+    rs = run(cfg, 80)
+    sess_status = get(rs.sess.status)
+    assert (sess_status == t.S_DONE).all(), np.bincount(sess_status.ravel())
+    assert_converged(cfg, rs)
+    meta = rs.meta
+    total_ops = cfg.n_replicas * cfg.n_sessions * cfg.ops_per_session
+    done = int(
+        get(meta.n_read).sum()
+        + get(meta.n_write).sum()
+        + get(meta.n_rmw).sum()
+        + get(meta.n_abort).sum()
+    )
+    assert done == total_ops
+    if mix == "f":
+        assert int(get(meta.n_rmw).sum()) > 0
+
+
+def test_five_replicas_converge():
+    cfg = HermesConfig(
+        n_replicas=5, n_keys=64, n_sessions=4, replay_slots=2, ops_per_session=8,
+        workload=WorkloadConfig(read_frac=0.2, seed=5),
+    )
+    rs = run(cfg, 60)
+    assert_converged(cfg, rs)
+
+
+def test_uncontended_write_commits_same_step():
+    """Hermes's headline: commit latency = one INV/ACK round trip — in the
+    lockstep schedule that is the same step it was issued (SURVEY.md §3.1)."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=1024, n_sessions=2, replay_slots=2, ops_per_session=4,
+        workload=WorkloadConfig(read_frac=0.0, seed=7),
+    )
+    rs = run(cfg, 30)
+    meta = rs.meta
+    # every committed update took <= 1 step issue->commit (step of load ==
+    # step of commit under no contention; contended ones may take longer)
+    hist = get(meta.lat_hist).sum(axis=0)
+    assert hist[2:].sum() <= hist.sum() * 0.2
+    assert hist[0] > 0

@@ -1,0 +1,298 @@
+"""State-machine unit tests (SURVEY.md §4.1): transition rules, ack-bitmap
+commit predicate, RMW abort rule, same-ts idempotence — the invariants the
+replay path (SURVEY.md §3.4) and the YCSB-F conflict path (BASELINE.json:8)
+rely on."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from hermes_tpu.core import phases, state as st
+from hermes_tpu.core import types as t
+from hermes_tpu.core.timestamps import make_fc
+
+from helpers import ack_block, ctl_scalars, empty_stream, get, inv_block, tiny_cfg
+
+
+def fresh(cfg):
+    rs = st.init_replica_state(cfg)
+    return rs.table, rs.sess, rs.replay, rs.meta
+
+
+def test_apply_inv_applies_higher_ts_and_acks():
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    fc = int(make_fc(t.FLAG_WRITE, 1))
+    inv = inv_block(cfg, [(1, 0, 5, 1, fc, [42, 7])])
+    out = phases.apply_inv(cfg, ctl_scalars(cfg=cfg), table, sess, meta, inv)
+    assert get(out.table.state)[5] == t.INVALID
+    assert get(out.table.ver)[5] == 1 and get(out.table.fc)[5] == fc
+    assert get(out.table.val)[5, 0] == 42
+    # always-ack: the ack echoes the INV's ts back on the same (sender, lane)
+    assert bool(get(out.out_ack.valid)[1, 0])
+    assert get(out.out_ack.ver)[1, 0] == 1 and get(out.out_ack.fc)[1, 0] == fc
+    # untouched keys stay Valid
+    assert get(out.table.state)[6] == t.VALID
+
+
+def test_apply_inv_same_ts_idempotent_but_acked():
+    """Replay safety (SURVEY.md §3.4): re-INV with the same ts changes
+    nothing but is still acked."""
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    fc = int(make_fc(t.FLAG_WRITE, 1))
+    inv = inv_block(cfg, [(1, 0, 5, 1, fc, [42, 7])])
+    ctl = ctl_scalars(cfg=cfg)
+    out1 = phases.apply_inv(cfg, ctl, table, sess, meta, inv)
+    out2 = phases.apply_inv(cfg, ctl, out1.table, sess, out1.meta, inv)
+    for a, b in zip(out1.table, out2.table):
+        np.testing.assert_array_equal(get(a), get(b))
+    assert bool(get(out2.out_ack.valid)[1, 0])
+
+
+def test_apply_inv_stale_ts_ignored_but_acked():
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    hi = int(make_fc(t.FLAG_WRITE, 2))
+    lo = int(make_fc(t.FLAG_WRITE, 0))
+    ctl = ctl_scalars(cfg=cfg)
+    out = phases.apply_inv(
+        cfg, ctl, table, sess, meta, inv_block(cfg, [(2, 0, 5, 3, hi, [99, 1])])
+    )
+    out2 = phases.apply_inv(
+        cfg, ctl, out.table, sess, out.meta, inv_block(cfg, [(0, 1, 5, 1, lo, [11, 2])])
+    )
+    assert get(out2.table.ver)[5] == 3 and get(out2.table.val)[5, 0] == 99
+    assert bool(get(out2.out_ack.valid)[0, 1])  # stale INV still acked
+
+
+def test_apply_inv_batch_contention_max_ts_wins():
+    """Contended key, one step (SURVEY.md §7 hard part 4): segmented max by
+    (ver, fc), not last-write-wins."""
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    recs = [
+        (0, 0, 9, 1, int(make_fc(t.FLAG_WRITE, 0)), [100, 0]),
+        (2, 0, 9, 2, int(make_fc(t.FLAG_RMW, 2)), [300, 0]),
+        (1, 0, 9, 2, int(make_fc(t.FLAG_WRITE, 1)), [200, 0]),
+    ]
+    out = phases.apply_inv(cfg, ctl_scalars(cfg=cfg), table, sess, meta, inv_block(cfg, recs))
+    # ver 2 beats ver 1; at ver 2 the plain write's flag beats the RMW's
+    assert get(out.table.ver)[9] == 2
+    assert get(out.table.fc)[9] == int(make_fc(t.FLAG_WRITE, 1))
+    assert get(out.table.val)[9, 0] == 200
+    # every INV still acked
+    assert get(out.out_ack.valid)[[0, 1, 2], [0, 0, 0]].all()
+
+
+def test_ack_bitmap_quorum_commit():
+    """poll_acks (BASELINE.json:5): commit iff acks cover every live replica;
+    partial acks accumulate across steps."""
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    fc = int(make_fc(t.FLAG_WRITE, 0))
+    key, ver = 7, 1
+    sess = sess._replace(
+        status=sess.status.at[0].set(t.S_INFL),
+        op=sess.op.at[0].set(t.OP_WRITE),
+        key=sess.key.at[0].set(key),
+        ver=sess.ver.at[0].set(ver),
+        fc=sess.fc.at[0].set(fc),
+    )
+    table = table._replace(
+        state=table.state.at[key].set(t.WRITE),
+        ver=table.ver.at[key].set(ver),
+        fc=table.fc.at[key].set(fc),
+    )
+    ctl = ctl_scalars(cfg=cfg)
+    # acks from replicas 0 and 1 only -> no commit (live = 0b111)
+    out = phases.collect_acks(
+        cfg, ctl, table, sess, replay, meta,
+        ack_block(cfg, [(0, 0, key, ver, fc), (1, 0, key, ver, fc)]),
+    )
+    assert get(out.sess.status)[0] == t.S_INFL
+    assert get(out.sess.acks)[0] == 0b011
+    assert not bool(get(out.out_val.valid)[0])
+    # replica 2's ack arrives later -> commit, VAL out, key Valid
+    out2 = phases.collect_acks(
+        cfg, ctl, out.table, out.sess, out.replay, out.meta,
+        ack_block(cfg, [(2, 0, key, ver, fc)]),
+    )
+    assert get(out2.sess.status)[0] == t.S_IDLE
+    assert get(out2.comp.code)[0] == t.C_WRITE
+    assert bool(get(out2.out_val.valid)[0])
+    assert get(out2.table.state)[key] == t.VALID
+
+
+def test_commit_quorum_shrinks_with_live_mask():
+    """Membership removal unblocks pending writes (SURVEY.md §3.4): with
+    replica 2 removed from the live mask, acks {0,1} suffice."""
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    fc = int(make_fc(t.FLAG_WRITE, 0))
+    key, ver = 7, 1
+    sess = sess._replace(
+        status=sess.status.at[0].set(t.S_INFL),
+        op=sess.op.at[0].set(t.OP_WRITE),
+        key=sess.key.at[0].set(key),
+        ver=sess.ver.at[0].set(ver),
+        fc=sess.fc.at[0].set(fc),
+    )
+    table = table._replace(
+        state=table.state.at[key].set(t.WRITE),
+        ver=table.ver.at[key].set(ver),
+        fc=table.fc.at[key].set(fc),
+    )
+    ctl = ctl_scalars(cfg=cfg, live_mask=0b011)
+    out = phases.collect_acks(
+        cfg, ctl, table, sess, replay, meta,
+        ack_block(cfg, [(0, 0, key, ver, fc), (1, 0, key, ver, fc)]),
+    )
+    assert get(out.sess.status)[0] == t.S_IDLE
+    assert get(out.comp.code)[0] == t.C_WRITE
+
+
+def test_rmw_abort_on_conflicting_write():
+    """YCSB-F conflict rule (BASELINE.json:8, SURVEY.md §3.3): a pending RMW
+    aborts when a conflicting higher-ts update supersedes it; the write-flag
+    tie-break makes any concurrent plain write higher-ts."""
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    key = 3
+    rfc = int(make_fc(t.FLAG_RMW, 0))
+    sess = sess._replace(
+        status=sess.status.at[0].set(t.S_INFL),
+        op=sess.op.at[0].set(t.OP_RMW),
+        key=sess.key.at[0].set(key),
+        ver=sess.ver.at[0].set(1),
+        fc=sess.fc.at[0].set(rfc),
+    )
+    table = table._replace(
+        state=table.state.at[key].set(t.WRITE),
+        ver=table.ver.at[key].set(1),
+        fc=table.fc.at[key].set(rfc),
+    )
+    wfc = int(make_fc(t.FLAG_WRITE, 1))  # same base version, write flag -> higher ts
+    out = phases.apply_inv(
+        cfg, ctl_scalars(cfg=cfg), table, sess, meta,
+        inv_block(cfg, [(1, 0, key, 1, wfc, [55, 0])]),
+    )
+    assert get(out.comp.code)[0] == t.C_RMW_ABORT
+    assert get(out.sess.status)[0] == t.S_IDLE
+    assert get(out.meta.n_abort) == 1
+    # the conflicting write owns the key now
+    assert get(out.table.fc)[key] == wfc and get(out.table.val)[key, 0] == 55
+
+
+def test_plain_write_superseded_not_aborted():
+    """Concurrent plain writes both commit, ordered by ts (SURVEY.md §3.3):
+    the loser keeps gathering acks with ``superseded`` set, and on commit the
+    key is NOT forced Valid."""
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    key = 3
+    myfc = int(make_fc(t.FLAG_WRITE, 0))
+    sess = sess._replace(
+        status=sess.status.at[0].set(t.S_INFL),
+        op=sess.op.at[0].set(t.OP_WRITE),
+        key=sess.key.at[0].set(key),
+        ver=sess.ver.at[0].set(1),
+        fc=sess.fc.at[0].set(myfc),
+    )
+    table = table._replace(
+        state=table.state.at[key].set(t.WRITE),
+        ver=table.ver.at[key].set(1),
+        fc=table.fc.at[key].set(myfc),
+    )
+    hifc = int(make_fc(t.FLAG_WRITE, 2))
+    ctl = ctl_scalars(cfg=cfg)
+    out = phases.apply_inv(
+        cfg, ctl, table, sess, meta, inv_block(cfg, [(2, 0, key, 1, hifc, [77, 0])])
+    )
+    assert get(out.sess.status)[0] == t.S_INFL  # not aborted
+    assert bool(get(out.sess.superseded)[0])
+    assert get(out.table.state)[key] == t.TRANS
+    # full acks arrive -> commit completes the session but leaves the key
+    # awaiting the winner's VAL
+    out2 = phases.collect_acks(
+        cfg, ctl, out.table, out.sess, replay, out.meta,
+        ack_block(cfg, [(r, 0, key, 1, myfc) for r in range(3)]),
+    )
+    assert get(out2.comp.code)[0] == t.C_WRITE
+    assert get(out2.table.state)[key] == t.TRANS  # still invalid-like
+    # winner's VAL validates
+    val = st.Vals(
+        valid=jnp.zeros((3, cfg.n_lanes), bool).at[2, 0].set(True),
+        key=jnp.zeros((3, cfg.n_lanes), jnp.int32).at[2, 0].set(key),
+        ver=jnp.zeros((3, cfg.n_lanes), jnp.int32).at[2, 0].set(1),
+        fc=jnp.zeros((3, cfg.n_lanes), jnp.int32).at[2, 0].set(hifc),
+        epoch=jnp.zeros((3, cfg.n_lanes), jnp.int32),
+    )
+    table3 = phases.apply_val(cfg, ctl, out2.table, val)
+    assert get(table3.state)[key] == t.VALID
+    assert get(table3.val)[key, 0] == 77
+
+
+def test_apply_val_requires_exact_ts():
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    ctl = ctl_scalars(cfg=cfg)
+    fc = int(make_fc(t.FLAG_WRITE, 1))
+    inv = inv_block(cfg, [(1, 0, 5, 2, fc, [42, 7])])
+    table = phases.apply_inv(cfg, ctl, table, sess, meta, inv).table
+    stale = st.Vals(
+        valid=jnp.zeros((3, cfg.n_lanes), bool).at[1, 0].set(True),
+        key=jnp.zeros((3, cfg.n_lanes), jnp.int32).at[1, 0].set(5),
+        ver=jnp.ones((3, cfg.n_lanes), jnp.int32),  # ver 1 != table's 2
+        fc=jnp.full((3, cfg.n_lanes), fc, jnp.int32),
+        epoch=jnp.zeros((3, cfg.n_lanes), jnp.int32),
+    )
+    t2 = phases.apply_val(cfg, ctl, table, stale)
+    assert get(t2.state)[5] == t.INVALID  # stale VAL ignored
+    good = stale._replace(ver=jnp.full((3, cfg.n_lanes), 2, jnp.int32))
+    t3 = phases.apply_val(cfg, ctl, t2, good)
+    assert get(t3.state)[5] == t.VALID
+
+
+def test_replay_scan_picks_stuck_keys():
+    """SURVEY.md §3.4: a key Invalid past replay_age is snapshotted into a
+    replay slot and re-broadcast with the SAME ts."""
+    cfg = tiny_cfg(replay_age=4)
+    table, sess, replay, meta = fresh(cfg)
+    fc = int(make_fc(t.FLAG_WRITE, 1))
+    inv = inv_block(cfg, [(1, 0, 5, 1, fc, [42, 7])])
+    ctl0 = ctl_scalars(step=0, cfg=cfg)
+    table = phases.apply_inv(cfg, ctl0, table, sess, meta, inv).table
+    # young: no replay yet
+    out = phases.coordinate(cfg, ctl_scalars(step=3, cfg=cfg), table, sess, replay, empty_stream(cfg))
+    assert not get(out.replay.active).any()
+    # old: replayed with the same ts+value
+    out = phases.coordinate(cfg, ctl_scalars(step=10, cfg=cfg), table, sess, replay, empty_stream(cfg))
+    assert bool(get(out.replay.active)[0])
+    assert get(out.replay.key)[0] == 5
+    assert get(out.replay.ver)[0] == 1 and get(out.replay.fc)[0] == fc
+    assert get(out.replay.val)[0, 0] == 42
+    assert get(out.table.state)[5] == t.REPLAY
+    lane = cfg.n_sessions  # first replay lane
+    assert bool(get(out.out_inv.valid)[lane])
+    assert get(out.out_inv.ver)[lane] == 1 and get(out.out_inv.key)[lane] == 5
+
+
+def test_frozen_replica_does_nothing():
+    """Failure injection (config 4, BASELINE.json:10): a frozen replica makes
+    no transitions and emits nothing."""
+    cfg = tiny_cfg()
+    table, sess, replay, meta = fresh(cfg)
+    stream = empty_stream(cfg)._replace(
+        op=jnp.full((cfg.n_sessions, cfg.ops_per_session), t.OP_WRITE, jnp.int32)
+    )
+    ctl = ctl_scalars(cfg=cfg, frozen=True)
+    out = phases.coordinate(cfg, ctl, table, sess, replay, stream)
+    assert not get(out.out_inv.valid).any()
+    assert not bool(get(out.out_inv.alive))
+    assert (get(out.sess.status) == t.S_IDLE).all()
+    fc = int(make_fc(t.FLAG_WRITE, 1))
+    out2 = phases.apply_inv(
+        cfg, ctl, table, sess, meta, inv_block(cfg, [(1, 0, 5, 1, fc, [42, 7])])
+    )
+    assert get(out2.table.state)[5] == t.VALID  # not applied
+    assert not get(out2.out_ack.valid).any()

@@ -1,0 +1,62 @@
+"""Unit tests: Lamport timestamp total order (SURVEY.md §4.1).
+
+The reference compares ts = (version, cid) lexicographically on every apply
+(SURVEY.md §2 "Lamport timestamp comparator"); our encoding adds the
+write-over-RMW tie-break flag in the fc word (core/types.py FLAG_*).
+"""
+
+import itertools
+
+import numpy as np
+
+from hermes_tpu.core import types as t
+from hermes_tpu.core.timestamps import fc_cid, make_fc, ts_eq, ts_gt
+
+
+def all_ts(n_ver=3, cids=(0, 1, 2)):
+    out = []
+    for ver in range(n_ver):
+        for flag in (t.FLAG_RMW, t.FLAG_WRITE):
+            for cid in cids:
+                out.append((ver, int(make_fc(flag, cid))))
+    return out
+
+
+def test_total_order():
+    ts = all_ts()
+    for a, b in itertools.product(ts, ts):
+        gt = bool(ts_gt(np.int32(a[0]), np.int32(a[1]), np.int32(b[0]), np.int32(b[1])))
+        lt = bool(ts_gt(np.int32(b[0]), np.int32(b[1]), np.int32(a[0]), np.int32(a[1])))
+        eq = bool(ts_eq(np.int32(a[0]), np.int32(a[1]), np.int32(b[0]), np.int32(b[1])))
+        assert gt + lt + eq == 1, (a, b)  # trichotomy
+    # transitivity on the sorted order
+    key = lambda x: (x[0], x[1])
+    s = sorted(ts, key=key)
+    for i in range(len(s) - 1):
+        assert ts_gt(
+            np.int32(s[i + 1][0]), np.int32(s[i + 1][1]), np.int32(s[i][0]), np.int32(s[i][1])
+        )
+
+
+def test_version_dominates_tiebreak():
+    # A higher version always wins regardless of flag/cid.
+    hi = (2, int(make_fc(t.FLAG_RMW, 0)))
+    lo = (1, int(make_fc(t.FLAG_WRITE, 7)))
+    assert ts_gt(np.int32(hi[0]), np.int32(hi[1]), np.int32(lo[0]), np.int32(lo[1]))
+
+
+def test_write_beats_rmw_same_version():
+    """The safety-critical tie-break (core/types.py): a plain write from any
+    replica beats a concurrent RMW from any replica at the same base version,
+    so an aborted RMW's timestamp can never dominate a surviving update."""
+    for wcid in range(8):
+        for rcid in range(8):
+            w = int(make_fc(t.FLAG_WRITE, wcid))
+            r = int(make_fc(t.FLAG_RMW, rcid))
+            assert ts_gt(np.int32(1), np.int32(w), np.int32(1), np.int32(r))
+
+
+def test_cid_roundtrip():
+    for flag in (t.FLAG_RMW, t.FLAG_WRITE):
+        for cid in range(32):
+            assert int(fc_cid(make_fc(flag, cid))) == cid
