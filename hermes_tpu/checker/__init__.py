@@ -1,0 +1,6 @@
+"""Correctness gate: history recording + linearizability checking.
+
+BASELINE.json:2 makes "linearizability pass" part of the acceptance metric;
+SURVEY.md §4 sets the strategy: unique-valued writes, per-key histories with
+real-time intervals derived from step indices, Wing&Gong-style search.
+"""

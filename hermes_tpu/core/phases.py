@@ -203,6 +203,8 @@ def coordinate(
         key=sess.key,
         wval=sess.val,
         rval=sess.rd_val,
+        ver=sess.ver,
+        fc=sess.fc,
         invoke_step=sess.invoke_step,
         commit_step=jnp.broadcast_to(ctl.step, (S,)).astype(jnp.int32),
     )
@@ -287,16 +289,25 @@ def apply_inv(
         key=sess.key,
         wval=sess.val,
         rval=sess.rd_val,
+        ver=sess.ver,
+        fc=sess.fc,
         invoke_step=sess.invoke_step,
         commit_step=jnp.broadcast_to(ctl.step, (S,)).astype(jnp.int32),
     )
 
     # --- ACK every valid INV (echo its ts back to its sender's lane) ------
+    # The conflict flag: ok iff the INV's ts is the key's max after this
+    # step's applies (losers/stale INVs get ok=False).  RMW coordinators
+    # abort on a False ack (collect_acks); plain writes ignore it.
+    ack_ok = ts_eq(
+        ver, fc, table.ver[jnp.clip(key, 0, K - 1)], table.fc[jnp.clip(key, 0, K - 1)]
+    ).reshape(R, L)
     out_ack = st.Acks(
         valid=ok & ~ctl.frozen,
         key=in_inv.key,
         ver=in_inv.ver,
         fc=in_inv.fc,
+        ok=ack_ok,
         epoch=jnp.broadcast_to(ctl.epoch, (R, L)).astype(jnp.int32),
     )
 
@@ -346,15 +357,32 @@ def collect_acks(
     full = jnp.int32((1 << R) - 1)
     bit = (jnp.int32(1) << jnp.arange(R, dtype=jnp.int32))[:, None]
 
+    # An ack counts only if it answers THIS pending update: lane alignment
+    # plus (key, ts) equality — ts alone is not unique across keys (e.g.
+    # every first write by replica c has ts (1, c)), and a delayed/duplicated
+    # ack from an earlier same-lane update must not satisfy a later quorum.
     ok = in_ack.valid & (in_ack.epoch == ctl.epoch) & ~ctl.frozen
-    sess_ack = ok[:, :S] & ts_eq(in_ack.ver[:, :S], in_ack.fc[:, :S], sess.ver[None, :], sess.fc[None, :])
-    rep_ack = ok[:, S:] & ts_eq(in_ack.ver[:, S:], in_ack.fc[:, S:], replay.ver[None, :], replay.fc[None, :])
+    sess_ack = (
+        ok[:, :S]
+        & (in_ack.key[:, :S] == sess.key[None, :])
+        & ts_eq(in_ack.ver[:, :S], in_ack.fc[:, :S], sess.ver[None, :], sess.fc[None, :])
+    )
+    rep_ack = (
+        ok[:, S:]
+        & (in_ack.key[:, S:] == replay.key[None, :])
+        & ts_eq(in_ack.ver[:, S:], in_ack.fc[:, S:], replay.ver[None, :], replay.fc[None, :])
+    )
 
     infl = sess.status == t.S_INFL
     acks = sess.acks | jnp.sum(jnp.where(sess_ack, bit, 0), axis=0).astype(jnp.int32)
     acks = jnp.where(infl, acks, sess.acks)
     covered = ((acks | ~ctl.live_mask) & full) == full
-    commit = infl & covered & ~ctl.frozen
+    # Conflict-nack: any matching ack with ok=False means some replica holds
+    # a higher ts for this key — a pending RMW aborts (before it could
+    # commit; nacks and full coverage in the same step resolve to abort).
+    nacked = jnp.any(sess_ack & ~in_ack.ok[:, :S], axis=0)
+    abort = infl & nacked & (sess.op == t.OP_RMW) & ~ctl.frozen
+    commit = infl & covered & ~ctl.frozen & ~abort
 
     # Key goes Valid only if this update still owns the key's timestamp.
     owns = ts_eq(sess.ver, sess.fc, table.ver[sess.key], table.fc[sess.key])
@@ -392,12 +420,18 @@ def collect_acks(
 
     # --- session completion + stats ---------------------------------------
     is_rmw = sess.op == t.OP_RMW
-    code = jnp.where(commit, jnp.where(is_rmw, t.C_RMW, t.C_WRITE), t.C_NONE)
+    code = jnp.where(
+        abort,
+        t.C_RMW_ABORT,
+        jnp.where(commit, jnp.where(is_rmw, t.C_RMW, t.C_WRITE), t.C_NONE),
+    )
     comp = st.Completions(
         code=code.astype(jnp.int32),
         key=sess.key,
         wval=sess.val,
         rval=sess.rd_val,
+        ver=sess.ver,
+        fc=sess.fc,
         invoke_step=sess.invoke_step,
         commit_step=jnp.broadcast_to(ctl.step, (S,)).astype(jnp.int32),
     )
@@ -406,6 +440,7 @@ def collect_acks(
     meta = meta._replace(
         n_write=meta.n_write + jnp.sum(commit & ~is_rmw, dtype=jnp.int32),
         n_rmw=meta.n_rmw + jnp.sum(commit & is_rmw, dtype=jnp.int32),
+        n_abort=meta.n_abort + jnp.sum(abort, dtype=jnp.int32),
         lat_sum=meta.lat_sum + jnp.sum(lat, dtype=jnp.int32),
         lat_cnt=meta.lat_cnt + jnp.sum(commit, dtype=jnp.int32),
         lat_hist=meta.lat_hist.at[jnp.where(commit, jnp.clip(lat, 0, nbin - 1), nbin)].add(
@@ -413,10 +448,11 @@ def collect_acks(
         ),
     )
 
+    done = commit | abort
     sess = sess._replace(
         acks=acks,
-        status=jnp.where(commit, t.S_IDLE, sess.status),
-        op_idx=jnp.where(commit, sess.op_idx + 1, sess.op_idx),
+        status=jnp.where(done, t.S_IDLE, sess.status),
+        op_idx=jnp.where(done, sess.op_idx + 1, sess.op_idx),
     )
     return CollectAcksOut(table, sess, replay, meta, out_val, comp)
 
@@ -457,6 +493,8 @@ def merge_completions(*comps: st.Completions) -> st.Completions:
             key=jnp.where(m, c.key, out.key),
             wval=jnp.where(m[..., None], c.wval, out.wval),
             rval=jnp.where(m[..., None], c.rval, out.rval),
+            ver=jnp.where(m, c.ver, out.ver),
+            fc=jnp.where(m, c.fc, out.fc),
             invoke_step=jnp.where(m, c.invoke_step, out.invoke_step),
             commit_step=jnp.where(m, c.commit_step, out.commit_step),
         )

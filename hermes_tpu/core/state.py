@@ -110,12 +110,20 @@ class Invs(NamedTuple):
 class Acks(NamedTuple):
     """ACK block.  Outbound: (R, L) — ack[p, l] answers the INV received from
     replica p in lane l; routed back by all_to_all.  Inbound: (R, L) where
-    [q, l] is q's ack of MY lane l."""
+    [q, l] is q's ack of MY lane l.
+
+    ``ok`` is the conflict flag: True iff the acked INV's ts is (still) the
+    key's maximum at the follower after this step's applies.  RMW
+    coordinators abort on any ok=False ack — that is how a conflicting
+    higher-ts update that has not yet reached the RMW's coordinator is
+    detected before commit (YCSB-F conflict rule, BASELINE.json:8); plain
+    writes ignore the flag (they commit regardless and order by ts)."""
 
     valid: jnp.ndarray
     key: jnp.ndarray
     ver: jnp.ndarray
     fc: jnp.ndarray
+    ok: jnp.ndarray
     epoch: jnp.ndarray
 
 
@@ -137,6 +145,8 @@ class Completions(NamedTuple):
     ``key``  (S,)
     ``wval`` (S,V) value written (updates)
     ``rval`` (S,V) value read (reads / RMW read-part)
+    ``ver``/``fc`` (S,) the update's protocol timestamp — the checker uses it
+    as a linearization witness (checker/linearizability.py)
     ``invoke_step``/``commit_step`` (S,)
     """
 
@@ -144,6 +154,8 @@ class Completions(NamedTuple):
     key: jnp.ndarray
     wval: jnp.ndarray
     rval: jnp.ndarray
+    ver: jnp.ndarray
+    fc: jnp.ndarray
     invoke_step: jnp.ndarray
     commit_step: jnp.ndarray
 
@@ -301,7 +313,14 @@ def empty_invs(cfg: config_lib.HermesConfig, lead=()) -> Invs:
 def empty_acks(cfg: config_lib.HermesConfig, lead=()) -> Acks:
     l = cfg.n_lanes
     z = lambda: jnp.zeros(lead + (l,), jnp.int32)
-    return Acks(valid=jnp.zeros(lead + (l,), jnp.bool_), key=z(), ver=z(), fc=z(), epoch=z())
+    return Acks(
+        valid=jnp.zeros(lead + (l,), jnp.bool_),
+        key=z(),
+        ver=z(),
+        fc=z(),
+        ok=jnp.zeros(lead + (l,), jnp.bool_),
+        epoch=z(),
+    )
 
 
 def empty_vals(cfg: config_lib.HermesConfig, lead=()) -> Vals:

@@ -95,19 +95,22 @@ def lockstep_route_back(block):
     return jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), block)
 
 
-def _step_core(cfg: HermesConfig, ph, bcast, route_back, rs: st.ReplicaState, stream, ctl):
+def _step_core(cfg: HermesConfig, ph, exchange_inv, exchange_ack, exchange_val,
+               rs: st.ReplicaState, stream, ctl):
     """The step body, parameterized over the exchange primitives.
 
-    ``ph`` are (possibly vmapped) phase fns; ``bcast``/``route_back`` realize
-    the INV/VAL broadcast and ACK route-back on whatever substrate (array
-    ops, ICI collectives, host network)."""
+    ``ph`` are (possibly vmapped) phase fns; the three exchange callables
+    realize the INV/VAL broadcast and ACK route-back on whatever substrate
+    (array ops, ICI collectives, host network).  Every backend — fused jit
+    (batched/tpu_ici) and host-mediated (sim/tcp) — runs THIS body, so the
+    protocol cannot diverge between them."""
     pctl = ctl
     c = ph["coordinate"](pctl, rs.table, rs.sess, rs.replay, stream)
-    in_inv = bcast(c.out_inv)
+    in_inv = exchange_inv(c.out_inv)
     a = ph["apply_inv"](pctl, c.table, c.sess, rs.meta, in_inv)
-    in_ack = route_back(a.out_ack)
+    in_ack = exchange_ack(a.out_ack)
     k = ph["collect_acks"](pctl, a.table, a.sess, c.replay, a.meta, in_ack)
-    in_val = bcast(k.out_val)
+    in_val = exchange_val(k.out_val)
     table = ph["apply_val"](pctl, k.table, in_val)
 
     comp = phases.merge_completions(c.comp, a.comp, k.comp)
@@ -125,7 +128,9 @@ def build_step_batched(cfg: HermesConfig):
     @jax.jit
     def step(rs: st.ReplicaState, stream: st.OpStream, ctl: StepCtl):
         pctl = _per_replica_ctl(cfg, ctl)
-        return _step_core(cfg, ph, lockstep_bcast, lockstep_route_back, rs, stream, pctl)
+        return _step_core(
+            cfg, ph, lockstep_bcast, lockstep_route_back, lockstep_bcast, rs, stream, pctl
+        )
 
     return step
 
@@ -172,7 +177,7 @@ def build_step_sharded(cfg: HermesConfig, mesh: Mesh):
             live_mask=ctl.live_mask[0],
             frozen=ctl.frozen[0],
         )
-        out_rs, comp = _step_core(cfg, ph, bcast, route_back, rs1, stream1, pctl)
+        out_rs, comp = _step_core(cfg, ph, bcast, route_back, bcast, rs1, stream1, pctl)
         return jax.tree.map(lambda x: x[None], out_rs), jax.tree.map(lambda x: x[None], comp)
 
     rspec = P("replica")

@@ -1,0 +1,213 @@
+"""Run driver: step loop, membership service hooks, history recording.
+
+This is the rebuild of the reference's L0/L4/L7 host side (SURVEY.md §1):
+``main()``+worker-loop becomes a host loop over compiled steps; the
+membership service (epoch + live bitmap + lease bookkeeping, SURVEY.md §5.3)
+lives here on the host, exactly where Hermes puts it (an external service,
+not the data plane); stats are read off the device Meta counters.
+
+Backends:
+  * ``batched``  — R replicas on one device, fused jit step (test/bench mode,
+                   the reference's single-process multi-replica pattern,
+                   BASELINE.json:7)
+  * ``sharded``  — one replica per mesh device, fused jit step with ICI
+                   collectives (transport=tpu_ici, BASELINE.json:5)
+  * ``sim``      — host-mediated exchanges through a SimTransport (or any
+                   HostTransport): deterministic adversarial scheduling
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hermes_tpu.checker.history import HistoryRecorder
+from hermes_tpu.checker import linearizability as lin
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import state as st, step as step_lib
+from hermes_tpu.core import types as t
+from hermes_tpu.workload import ycsb
+
+
+class Runtime:
+    def __init__(
+        self,
+        cfg: HermesConfig,
+        backend: str = "batched",
+        mesh=None,
+        transport=None,
+        record: bool = False,
+        stream: Optional[st.OpStream] = None,
+    ):
+        self.cfg = cfg
+        self.backend = backend
+        r = cfg.n_replicas
+
+        rs0 = st.init_replica_state(cfg)
+        self.rs = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (r,) + x.shape), rs0)
+        raw = stream if stream is not None else ycsb.make_streams(cfg)
+        self.stream = jax.tree.map(jnp.asarray, raw)
+
+        self.step_idx = 0
+        self.epoch = np.zeros((r,), np.int32)
+        self.live = np.full((r,), cfg.full_mask, np.int32)
+        self.frozen = np.zeros((r,), bool)
+
+        self.recorder = HistoryRecorder(cfg) if record else None
+
+        if backend == "batched":
+            self._fused = step_lib.build_step_batched(cfg)
+        elif backend == "sharded":
+            if mesh is None:
+                raise ValueError("sharded backend needs a mesh")
+            self._fused = step_lib.build_step_sharded(cfg, mesh)
+            self.rs, self.stream = step_lib.place_sharded(cfg, mesh, self.rs, self.stream)
+        elif backend == "sim":
+            from hermes_tpu.transport.sim import SimTransport
+
+            self._fused = None
+            self.transport = transport if transport is not None else SimTransport(r)
+            ph = step_lib.vmapped_phases(cfg)
+            self._ph = {k: jax.jit(v) for k, v in ph.items()}
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+
+    # -- control -----------------------------------------------------------
+
+    def _ctl(self) -> step_lib.StepCtl:
+        return step_lib.StepCtl(
+            step=jnp.int32(self.step_idx),
+            epoch=jnp.asarray(self.epoch),
+            live_mask=jnp.asarray(self.live),
+            frozen=jnp.asarray(self.frozen),
+        )
+
+    def freeze(self, replica: int) -> None:
+        """Failure injection: replica stops processing and emitting
+        (config 4, BASELINE.json:10)."""
+        self.frozen[replica] = True
+
+    def thaw(self, replica: int) -> None:
+        self.frozen[replica] = False
+
+    def set_live(self, mask: int) -> None:
+        """Membership change: new live bitmap, epoch bump everywhere (stale
+        epoch messages are dropped on receipt)."""
+        self.live[:] = mask
+        self.epoch += 1
+
+    def remove(self, replica: int) -> None:
+        self.set_live(int(self.live[0]) & ~(1 << replica))
+
+    def join(self, replica: int, from_replica: int) -> None:
+        """Reconfiguration join (config 5, BASELINE.json:11): state transfer
+        from a live replica, then admit.  Keys the donor holds in
+        WRITE/TRANS/REPLAY (its own pending coordination) enter the joiner as
+        INVALID — the joiner has no session/replay slot for them; the live
+        coordinator's VAL (or the replay scan) validates them."""
+        tbl = self.rs.table
+        donor_state = tbl.state[from_replica]
+        j_state = jnp.where(
+            (donor_state == t.WRITE) | (donor_state == t.TRANS) | (donor_state == t.REPLAY),
+            t.INVALID,
+            donor_state,
+        )
+        new_tbl = st.KeyTable(
+            state=tbl.state.at[replica].set(j_state),
+            ver=tbl.ver.at[replica].set(tbl.ver[from_replica]),
+            fc=tbl.fc.at[replica].set(tbl.fc[from_replica]),
+            val=tbl.val.at[replica].set(tbl.val[from_replica]),
+            inv_step=tbl.inv_step.at[replica].set(jnp.int32(self.step_idx)),
+        )
+        self.rs = self.rs._replace(table=new_tbl)
+        self.frozen[replica] = False
+        self.set_live(int(self.live[0]) | (1 << replica))
+
+    # -- stepping ----------------------------------------------------------
+
+    def step_once(self) -> None:
+        ctl = self._ctl()
+        if self._fused is not None:
+            self.rs, comp = self._fused(self.rs, self.stream, ctl)
+        else:
+            self.rs, comp = self._host_step(ctl)
+        if self.recorder is not None:
+            self.recorder.record_step(jax.device_get(comp))
+        self.step_idx += 1
+
+    def _host_step(self, ctl: step_lib.StepCtl):
+        """One step through step._step_core with host-mediated exchanges
+        (sim/tcp transports) — the same body the fused backends run."""
+        cfg = self.cfg
+        pctl = step_lib._per_replica_ctl(cfg, ctl)
+        step = self.step_idx
+
+        def ex(fn):
+            return lambda blk: _to_jnp(fn(jax.device_get(blk), step))
+
+        return step_lib._step_core(
+            cfg,
+            self._ph,
+            ex(self.transport.exchange_inv),
+            ex(self.transport.exchange_ack),
+            ex(self.transport.exchange_val),
+            self.rs,
+            self.stream,
+            pctl,
+        )
+
+    def run(self, n_steps: int) -> None:
+        for _ in range(n_steps):
+            self.step_once()
+
+    def drain(self, max_steps: int = 10_000) -> bool:
+        """Step until every session finished its stream and the network is
+        empty; returns False if max_steps elapsed first."""
+        for _ in range(max_steps):
+            status = np.asarray(jax.device_get(self.rs.sess.status))
+            live0 = int(self.live[0])
+            done = np.array(
+                [
+                    (status[r] == t.S_DONE).all() or not (live0 >> r) & 1 or self.frozen[r]
+                    for r in range(self.cfg.n_replicas)
+                ]
+            ).all()
+            pending = getattr(self, "transport", None)
+            net_empty = pending.pending() == 0 if pending is not None else True
+            if done and net_empty:
+                return True
+            self.step_once()
+        return False
+
+    # -- observability -----------------------------------------------------
+
+    def counters(self) -> dict:
+        m = jax.device_get(self.rs.meta)
+        return dict(
+            n_read=np.asarray(m.n_read).sum(),
+            n_write=np.asarray(m.n_write).sum(),
+            n_rmw=np.asarray(m.n_rmw).sum(),
+            n_abort=np.asarray(m.n_abort).sum(),
+            lat_sum=np.asarray(m.lat_sum).sum(),
+            lat_cnt=np.asarray(m.lat_cnt).sum(),
+            lat_hist=np.asarray(m.lat_hist).sum(axis=0),
+        )
+
+    def history_ops(self):
+        assert self.recorder is not None, "construct Runtime(record=True)"
+        return self.recorder.finalize(jax.device_get(self.rs.sess))
+
+    def check(self, max_keys: Optional[int] = None) -> lin.Verdict:
+        """Finalize the history and run the linearizability gate
+        (BASELINE.json:2)."""
+        ops = self.history_ops()
+        if max_keys is not None:
+            ops = lin.sample_keys(ops, max_keys=max_keys)
+        return lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)
+
+
+def _to_jnp(block):
+    return jax.tree.map(jnp.asarray, block)

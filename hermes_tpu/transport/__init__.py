@@ -1,0 +1,17 @@
+"""Net-transport plugin layer (SURVEY.md §1 L1, §5.8).
+
+The reference moves INV/ACK/VAL batches through a transport plugin interface
+with `rdma` and `tcp` backends; BASELINE.json:5 adds `tpu_ici` as the target.
+The rebuild's seam is the *exchange* of fixed-shape message blocks once per
+phase boundary:
+
+  * ``tpu_ici``  — collectives inside one jit step (core/step.py sharded)
+  * ``batched``  — array ops inside one jit step, R replicas on one device
+  * ``sim``      — host-mediated, deterministic + adversarial (this package)
+  * ``tcp``      — host-mediated over real sockets via the C++ core (M5)
+  * ``rdma``     — interface stub (no NIC in scope; SURVEY.md §2)
+"""
+
+from hermes_tpu.transport.base import HostTransport, LockstepHostTransport
+
+__all__ = ["HostTransport", "LockstepHostTransport"]

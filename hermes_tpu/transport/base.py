@@ -1,0 +1,59 @@
+"""Host-mediated transport interface.
+
+The in-jit backends (batched / tpu_ici) fuse the exchange into the compiled
+step (core/step.py).  Host-mediated backends implement this interface
+instead: the runtime calls ``exchange_{inv,ack,val}`` between phase
+invocations, passing outbound blocks with a leading source-replica axis and
+receiving inbound blocks with leading (dst, src) axes.
+
+Blocks are numpy pytrees (state.Invs / Acks / Vals):
+
+  * INV/VAL outbound: per-src ``(R, L, ...)`` is NOT the shape — outbound is
+    ``(R_src, L, ...)`` one lane-block per source (broadcast semantics: the
+    same block goes to every destination).
+  * ACK outbound: ``(R_src, R_dst, L, ...)`` — acks are point-to-point,
+    row p of src q answers the INVs q received from p and is routed back to p.
+  * Inbound (all kinds): ``(R_dst, R_src, L, ...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import numpy as np
+
+
+class HostTransport(Protocol):
+    def exchange_inv(self, out_inv, step: int): ...
+
+    def exchange_ack(self, out_ack, step: int): ...
+
+    def exchange_val(self, out_val, step: int): ...
+
+
+class LockstepHostTransport:
+    """Zero-delay host exchange — semantically identical to the in-jit
+    batched backend; the degenerate case of the sim transport."""
+
+    def exchange_inv(self, out_inv, step: int):
+        r = np.asarray(out_inv.valid).shape[0]
+        return out_inv._replace(
+            **{
+                f: np.broadcast_to(np.asarray(v)[None], (r,) + np.asarray(v).shape)
+                for f, v in out_inv._asdict().items()
+            }
+        )
+
+    def exchange_ack(self, out_ack, step: int):
+        return out_ack._replace(
+            **{f: np.swapaxes(np.asarray(v), 0, 1) for f, v in out_ack._asdict().items()}
+        )
+
+    def exchange_val(self, out_val, step: int):
+        r = np.asarray(out_val.valid).shape[0]
+        return out_val._replace(
+            **{
+                f: np.broadcast_to(np.asarray(v)[None], (r,) + np.asarray(v).shape)
+                for f, v in out_val._asdict().items()
+            }
+        )

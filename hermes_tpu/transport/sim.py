@@ -1,0 +1,129 @@
+"""Deterministic adversarial simulation transport (SURVEY.md §4.2).
+
+The reference validates multi-node behavior in a single process
+(BASELINE.json:7); upstream relied on asserts + operational validation.  The
+rebuild goes further: this transport gives a *schedule-controlled* network —
+per-(kind, src, dst, step) delay / drop / duplication — so protocol races
+(delayed INVs, lost VALs, reordered ACK/VAL, replica stalls) are explored
+deterministically and every run is gated by the linearizability checker.
+
+Semantics: each directed edge carries one FIFO channel per message kind.  A
+send enqueues zero or more copies (drop = zero, dup = two) with delivery
+steps; every block due by the current step is delivered, merged in FIFO
+order (later valid lanes overlay earlier ones — lane l always carries the
+same session/slot's current pending record, so the overlay is the natural
+"latest packet wins" of a real network).  Same-step delivery reproduces the
+lockstep schedule exactly.
+
+The protocol tolerates all of this by design: pending updates re-broadcast
+their INV every step (idempotent same-ts), ACKs accumulate in the bitmap,
+lost VALs are recovered by the replay scan (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# schedule(kind, src, dst, step) -> list of delivery steps for this send.
+# [step] = lockstep; [] = drop; [step+3] = delay; [step, step+2] = duplicate.
+Schedule = Callable[[str, int, int, int], Sequence[int]]
+
+
+def lockstep_schedule(kind: str, src: int, dst: int, step: int) -> Sequence[int]:
+    return [step]
+
+
+class SimTransport:
+    """Host-side adversarial network between vmapped protocol phases."""
+
+    def __init__(self, n_replicas: int, schedule: Schedule = lockstep_schedule):
+        self.r = n_replicas
+        self.schedule = schedule
+        # (kind, src, dst) -> deque of (deliver_step, block-dict of numpy arrays)
+        self.chan: Dict[Tuple[str, int, int], collections.deque] = collections.defaultdict(
+            collections.deque
+        )
+
+    # -- helpers -----------------------------------------------------------
+
+    def _send(self, kind: str, src: int, dst: int, step: int, block: dict) -> None:
+        for when in self.schedule(kind, src, dst, step):
+            assert when >= step, "cannot deliver into the past"
+            self.chan[(kind, src, dst)].append((when, block))
+
+    def _recv(self, kind: str, src: int, dst: int, step: int):
+        """Pop and merge every block due by ``step`` (FIFO; later valid lanes
+        overlay earlier)."""
+        q = self.chan[(kind, src, dst)]
+        merged = None
+        while q and q[0][0] <= step:
+            blk = q.popleft()[1]
+            if merged is None:
+                merged = dict(blk)
+                continue
+            v = blk["valid"]
+            for f, arr in blk.items():
+                if f == "alive":
+                    merged[f] = merged[f] | arr
+                elif f == "valid":
+                    continue
+                elif arr.ndim > v.ndim:  # value words (L, V)
+                    merged[f] = np.where(v[..., None], arr, merged[f])
+                else:
+                    merged[f] = np.where(v, arr, merged[f])
+            merged["valid"] = merged["valid"] | v
+        return merged
+
+    def _exchange_bcast(self, kind: str, out, step: int):
+        """INV/VAL: outbound (R_src, L, ...) broadcast to every dst; inbound
+        (R_dst, R_src, L, ...)."""
+        fields = {f: np.asarray(v) for f, v in out._asdict().items()}
+        r = self.r
+        for src in range(r):
+            block = {f: v[src] for f, v in fields.items()}
+            for dst in range(r):
+                self._send(kind, src, dst, step, block)
+        inb = {
+            f: np.zeros((r,) + v.shape, v.dtype) for f, v in fields.items()
+        }
+        for dst in range(r):
+            for src in range(r):
+                got = self._recv(kind, src, dst, step)
+                if got is None:
+                    continue
+                for f in inb:
+                    inb[f][dst, src] = got[f]
+        return out._replace(**inb)
+
+    def exchange_inv(self, out_inv, step: int):
+        return self._exchange_bcast("inv", out_inv, step)
+
+    def exchange_val(self, out_val, step: int):
+        return self._exchange_bcast("val", out_val, step)
+
+    def exchange_ack(self, out_ack, step: int):
+        """ACK: outbound (R_src, R_dst, L, ...): row p of source q answers
+        the INVs q received from p.  Inbound (R_dst, R_src, L, ...)."""
+        fields = {f: np.asarray(v) for f, v in out_ack._asdict().items()}
+        r = self.r
+        for src in range(r):
+            for dst in range(r):
+                block = {f: v[src, dst] for f, v in fields.items()}
+                self._send("ack", src, dst, step, block)
+        inb = {
+            f: np.zeros((r, r) + v.shape[2:], v.dtype) for f, v in fields.items()
+        }
+        for dst in range(r):
+            for src in range(r):
+                got = self._recv("ack", src, dst, step)
+                if got is None:
+                    continue
+                for f in inb:
+                    inb[f][dst, src] = got[f]
+        return out_ack._replace(**inb)
+
+    def pending(self) -> int:
+        return sum(len(q) for q in self.chan.values())

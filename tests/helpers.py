@@ -71,22 +71,26 @@ def inv_block(cfg: HermesConfig, records, n_senders=None, epoch=0):
 
 
 def ack_block(cfg: HermesConfig, records, n_senders=None, epoch=0):
-    """Inbound (R, L) ACK block from [(sender, lane, key, ver, fc), ...]."""
+    """Inbound (R, L) ACK block from [(sender, lane, key, ver, fc[, ok]), ...]."""
     r = n_senders or cfg.n_replicas
     valid = np.zeros((r, cfg.n_lanes), bool)
     key = np.zeros((r, cfg.n_lanes), np.int32)
     ver = np.zeros((r, cfg.n_lanes), np.int32)
     fc = np.zeros((r, cfg.n_lanes), np.int32)
-    for s, lane, k, v, f in records:
+    ok = np.zeros((r, cfg.n_lanes), bool)
+    for rec in records:
+        s, lane, k, v, f = rec[:5]
         valid[s, lane] = True
         key[s, lane] = k
         ver[s, lane] = v
         fc[s, lane] = f
+        ok[s, lane] = rec[5] if len(rec) > 5 else True
     return st.Acks(
         valid=jnp.asarray(valid),
         key=jnp.asarray(key),
         ver=jnp.asarray(ver),
         fc=jnp.asarray(fc),
+        ok=jnp.asarray(ok),
         epoch=jnp.full((r, cfg.n_lanes), epoch, jnp.int32),
     )
 

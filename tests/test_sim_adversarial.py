@@ -1,0 +1,157 @@
+"""Adversarial-schedule simulation (SURVEY.md §4.2): message delay, loss,
+duplication, replica stall + membership change + rejoin — every run gated by
+the linearizability checker.  This is the deterministic race exploration the
+reference never had (SURVEY.md §5.2)."""
+
+import hashlib
+
+import numpy as np
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import types as t
+from hermes_tpu.runtime import Runtime
+from hermes_tpu.transport.sim import SimTransport
+
+from helpers import get
+
+
+def h(*args) -> int:
+    return int.from_bytes(hashlib.blake2b(repr(args).encode(), digest_size=4).digest(), "little")
+
+
+def chaotic_schedule(seed, p_drop=0.15, p_dup=0.1, max_delay=3, until=10_000):
+    """Deterministic pseudo-random drop/dup/delay per (kind, src, dst, step);
+    clean after ``until`` so runs can drain."""
+
+    def sched(kind, src, dst, step):
+        if step >= until or src == dst:  # keep self-delivery clean
+            return [step]
+        x = h(seed, kind, src, dst, step)
+        if x % 1000 < p_drop * 1000:
+            return []
+        d = (x // 7) % (max_delay + 1)
+        out = [step + d]
+        if (x // 1000) % 1000 < p_dup * 1000:
+            out.append(step + (x // 31) % (max_delay + 1))
+        return out
+
+    return sched
+
+
+def cfg_small(seed, rmw_frac=0.5, **kw):
+    # rmw_frac > 0 by default: the RMW conflict path MUST be exercised under
+    # adversarial schedules (a delayed conflicting INV once hid a lost-update
+    # bug that lockstep runs could never trigger — see
+    # test_rmw_delayed_conflict_aborts).
+    base = dict(
+        n_replicas=3, n_keys=64, n_sessions=4, replay_slots=8, ops_per_session=12,
+        replay_age=6,
+        workload=WorkloadConfig(read_frac=0.5, rmw_frac=rmw_frac, seed=seed),
+    )
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def run_checked(cfg, schedule, max_steps=600):
+    rt = Runtime(
+        cfg, backend="sim", record=True,
+        transport=SimTransport(cfg.n_replicas, schedule),
+    )
+    assert rt.drain(max_steps), "did not drain"
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+    return rt
+
+
+def test_chaos_drop_dup_delay():
+    for seed in range(3):
+        rt = run_checked(cfg_small(30 + seed), chaotic_schedule(seed, until=300))
+        c = rt.counters()
+        assert c["n_write"] > 0
+
+
+def test_val_blackout_replay_recovers():
+    """Drop ALL VALs for a window: keys stick Invalid at followers until the
+    replay scan re-drives them (SURVEY.md §3.4).  The checker must still
+    pass and the run must drain."""
+
+    def sched(kind, src, dst, step):
+        if kind == "val" and step < 30 and src != dst:
+            return []
+        return [step]
+
+    cfg = cfg_small(40, replay_age=5)
+    rt = run_checked(cfg, sched)
+    # replay must actually have fired (some key went Invalid past the age)
+    # - witnessed indirectly: run drained with VALs destroyed for 30 steps
+
+
+def test_inv_starvation_one_direction():
+    """INVs from replica 0 to replica 2 delayed heavily: commits by 0 stall
+    (need 2's ack) but eventually land; linearizability holds."""
+
+    def sched(kind, src, dst, step):
+        if kind == "inv" and src == 0 and dst == 2 and step < 40:
+            return [step + 5]
+        return [step]
+
+    run_checked(cfg_small(41), sched)
+
+
+def test_rmw_delayed_conflict_aborts():
+    """Regression (conflict-nack acks): two RMWs on the same key from the
+    same base version, with the higher-ts INV delayed past the lower RMW's
+    would-be commit.  Without the ok-flag on ACKs both committed reading the
+    same old value (lost update); with it the lower-ts RMW aborts on the
+    nack from the conflicting coordinator."""
+    import numpy as np
+    from hermes_tpu.core import state as st_mod, types as tt
+
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=8, n_sessions=1, replay_slots=2, ops_per_session=1,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+    # replicas 0 and 1 both RMW key 0; replica 2 idle
+    op = np.zeros((3, 1, 1), np.int32)
+    op[0, 0, 0] = tt.OP_RMW
+    op[1, 0, 0] = tt.OP_RMW
+    key = np.zeros((3, 1, 1), np.int32)
+    stream = st_mod.OpStream(op=op, key=key)
+
+    def sched(kind, src, dst, step):
+        if kind == "inv" and src == 1 and dst == 0 and step < 3:
+            return [step + 2]  # hide the higher-ts INV from replica 0
+        return [step]
+
+    rt = Runtime(cfg, backend="sim", record=True,
+                 transport=SimTransport(3, sched), stream=stream)
+    assert rt.drain(100)
+    c = rt.counters()
+    assert int(c["n_rmw"]) == 1 and int(c["n_abort"]) == 1, c
+    v = rt.check()
+    assert v.ok, (v.failures, v.undecided)
+
+
+def test_stall_remove_rejoin_checked():
+    """Config 4+5 shaped (BASELINE.json:10-11): replica stalls mid-workload,
+    lease expiry removes it (quorum shrinks, writes unblock), then it rejoins
+    with state transfer; the whole history must linearize."""
+    cfg = cfg_small(42, n_replicas=4, ops_per_session=20, replay_age=5)
+    rt = Runtime(cfg, backend="sim", record=True, transport=SimTransport(4))
+    rt.run(5)
+    rt.freeze(2)
+    rt.run(cfg.lease_steps)  # stalled but still in membership: writes block
+    rt.remove(2)  # lease expired -> removed; quorum = {0,1,3}
+    rt.run(30)
+    rt.join(2, from_replica=0)  # state transfer + readmit
+    assert rt.drain(600)
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+    # converged: all replicas identical and Valid
+    state = get(rt.rs.table.state)
+    assert (state == t.VALID).all()
+    ver = get(rt.rs.table.ver)
+    val = get(rt.rs.table.val)
+    for r in range(1, 4):
+        np.testing.assert_array_equal(ver[0], ver[r])
+        np.testing.assert_array_equal(val[0], val[r])
