@@ -1,0 +1,99 @@
+"""Benchmark: committed writes/sec of the Hermes protocol step.
+
+Target (BASELINE.json:5): >=10M committed writes/sec aggregate on a v5e-8
+(8 replicas, 1 chip = 1 replica).  This environment exposes ONE v5e chip, so
+the bench runs the 8-replica configuration batched on that chip — every
+replica's kernel work AND all 8x8 message traffic execute on the single
+chip, which lower-bounds the per-chip work of the real 8-chip mesh (the real
+mesh splits this work 8 ways and pays ICI instead of on-chip copies).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
+vs_baseline = value / 1e7 (the north-star aggregate target).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.core import state as st, step as step_lib
+    from hermes_tpu.workload import ycsb
+
+    warmup, measure = 10, 100
+    cfg = HermesConfig(
+        n_replicas=8,
+        n_keys=1 << 20,
+        value_words=8,  # 32B values, the reference's typical small-value shape
+        n_sessions=4096,
+        replay_slots=256,
+        ops_per_session=warmup + measure + 8,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),  # YCSB-A mix; metric counts writes
+    )
+
+    r = cfg.n_replicas
+    rs = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (r,) + x.shape), st.init_replica_state(cfg)
+    )
+    rs = jax.device_put(rs)
+    stream = jax.device_put(jax.tree.map(jnp.asarray, ycsb.make_streams(cfg)))
+
+    step = step_lib.build_step_batched(cfg, donate=True)
+
+    def counters(x):
+        m = jax.device_get(x.meta)
+        return int(m.n_write.sum() + m.n_rmw.sum())
+
+    for s in range(warmup):
+        rs, _ = step(rs, stream, step_lib.make_ctl(cfg, s))
+    jax.block_until_ready(rs)
+    c0 = counters(rs)
+    lat0 = jax.device_get(rs.meta.lat_hist).sum(axis=0)
+
+    t0 = time.perf_counter()
+    for s in range(warmup, warmup + measure):
+        rs, _ = step(rs, stream, step_lib.make_ctl(cfg, s))
+    jax.block_until_ready(rs)
+    t1 = time.perf_counter()
+
+    commits = counters(rs) - c0
+    wall = t1 - t0
+    wps = commits / wall
+
+    # p50 commit latency in steps -> microseconds via measured step time
+    from hermes_tpu.stats import percentile_from_hist
+
+    hist = jax.device_get(rs.meta.lat_hist).sum(axis=0) - lat0
+    p50_steps = percentile_from_hist(hist, 0.5)
+    step_us = wall / measure * 1e6
+
+    meta = {
+        "commits": commits,
+        "steps": measure,
+        "wall_s": round(wall, 4),
+        "step_us": round(step_us, 1),
+        "p50_commit_steps": p50_steps,
+        "p50_commit_us_est": round((p50_steps + 1) * step_us, 1),
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+        "replicas_on_chip": cfg.n_replicas,
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "committed_writes_per_sec",
+                "value": round(wps, 1),
+                "unit": "writes/s",
+                "vs_baseline": round(wps / 1e7, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""CLI (SURVEY.md §1 L7): run a replicated-KVS workload from the command line.
+
+The reference configures via compile-time macros + run-script flags; the
+rebuild exposes the same knobs as flags over the frozen config dataclass.
+
+    python -m hermes_tpu --replicas 8 --keys $((1<<20)) --sessions 1024 \
+        --steps 200 --backend batched --workload a --check
+
+Backends: batched (one device), sharded (one replica per device), sim
+(host-mediated deterministic).  ``--check`` records the op history and runs
+the linearizability gate at the end (sampled via --check-keys).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="hermes_tpu", description=__doc__)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--keys", type=int, default=1 << 16)
+    ap.add_argument("--value-words", type=int, default=2)
+    ap.add_argument("--sessions", type=int, default=256)
+    ap.add_argument("--replay-slots", type=int, default=64)
+    ap.add_argument("--ops-per-session", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=0, help="0 = run until drained")
+    ap.add_argument("--backend", choices=["batched", "sharded", "sim"], default="batched")
+    ap.add_argument(
+        "--workload", choices=["a", "b", "c", "f"], default="a",
+        help="YCSB mix: a=50/50, b=95/5, c=read-only, f=50/50 with RMW updates",
+    )
+    ap.add_argument("--distribution", choices=["uniform", "zipfian"], default="uniform")
+    ap.add_argument("--zipf-theta", type=float, default=0.99)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--check", action="store_true", help="record history + linearizability gate")
+    ap.add_argument("--check-keys", type=int, default=512, help="sampled keys for the gate")
+    ap.add_argument("--report-every", type=int, default=0, help="steps between stat lines")
+    ap.add_argument("--metrics-jsonl", type=str, default=None)
+    return ap
+
+
+MIXES = {
+    "a": dict(read_frac=0.5, rmw_frac=0.0),
+    "b": dict(read_frac=0.95, rmw_frac=0.0),
+    "c": dict(read_frac=1.0, rmw_frac=0.0),
+    "f": dict(read_frac=0.5, rmw_frac=1.0),
+}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from hermes_tpu import stats as stats_lib
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.runtime import Runtime
+
+    cfg = HermesConfig(
+        n_replicas=args.replicas,
+        n_keys=args.keys,
+        value_words=args.value_words,
+        n_sessions=args.sessions,
+        replay_slots=args.replay_slots,
+        ops_per_session=args.ops_per_session,
+        workload=WorkloadConfig(
+            distribution=args.distribution,
+            zipf_theta=args.zipf_theta,
+            seed=args.seed,
+            **MIXES[args.workload],
+        ),
+    )
+
+    mesh = None
+    if args.backend == "sharded":
+        import jax
+        from jax.sharding import Mesh
+
+        devs = jax.devices()[: cfg.n_replicas]
+        if len(devs) < cfg.n_replicas:
+            print(f"need {cfg.n_replicas} devices, have {len(devs)}", file=sys.stderr)
+            return 2
+        mesh = Mesh(np.array(devs), ("replica",))
+
+    rt = Runtime(cfg, backend=args.backend, mesh=mesh, record=args.check)
+    logger = None
+    if args.metrics_jsonl:
+        logger = stats_lib.JsonlLogger(open(args.metrics_jsonl, "w"))
+
+    t0 = time.perf_counter()
+    if args.steps > 0:
+        for s in range(args.steps):
+            rt.step_once()
+            if args.report_every and (s + 1) % args.report_every == 0:
+                rec = stats_lib.summarize(rt.rs.meta, time.perf_counter() - t0, s + 1)
+                print(rec, file=sys.stderr)
+                if logger:
+                    logger.log(rec)
+    else:
+        ok = rt.drain()
+        if not ok:
+            print("WARNING: did not drain", file=sys.stderr)
+    wall = time.perf_counter() - t0
+
+    rec = stats_lib.summarize(rt.rs.meta, wall, rt.step_idx)
+    print(rec)
+    if logger:
+        logger.log(rec)
+
+    if args.check:
+        v = rt.check(max_keys=args.check_keys)
+        print(f"linearizability: {'PASS' if v.ok else 'FAIL'} ({v.keys_checked} keys)")
+        if not v.ok:
+            for f in v.failures[:5]:
+                print("  ", f.reason[:200])
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

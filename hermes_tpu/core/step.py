@@ -120,19 +120,20 @@ def _step_core(cfg: HermesConfig, ph, exchange_inv, exchange_ack, exchange_val,
     return st.ReplicaState(table, k.sess, k.replay, meta), comp
 
 
-def build_step_batched(cfg: HermesConfig):
+def build_step_batched(cfg: HermesConfig, donate: bool = False):
     """Single-device, R-replica lockstep step: jit( (state, stream, ctl) ->
-    (state, completions) ).  All leaves carry a leading R axis."""
+    (state, completions) ).  All leaves carry a leading R axis.  With
+    ``donate`` the state buffers are donated (bench mode: avoids a full copy
+    of the key-state table per step)."""
     ph = vmapped_phases(cfg)
 
-    @jax.jit
     def step(rs: st.ReplicaState, stream: st.OpStream, ctl: StepCtl):
         pctl = _per_replica_ctl(cfg, ctl)
         return _step_core(
             cfg, ph, lockstep_bcast, lockstep_route_back, lockstep_bcast, rs, stream, pctl
         )
 
-    return step
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,183 @@
+"""Multi-process replica runner over the TCP transport (SURVEY.md §2, M5).
+
+One OS process = one Hermes replica (the reference's deployment shape: one
+process per machine).  The protocol phases are the SAME per-replica
+functions the in-process backends run — only the exchange substrate differs
+(TcpMesh block exchange instead of collectives), which is the whole point of
+the transport plugin seam.
+
+Usage (one process per rank, same command on each host):
+
+    python -m hermes_tpu.distributed --rank R --n-ranks N [--steps S]
+        [--base-port P] [--hosts ip0,ip1,...] [--out out_rank_R.npz]
+
+Each rank writes its completion history + final table to ``--out``;
+``combine_and_check(paths)`` merges them and runs the linearizability gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import pickle
+
+import numpy as np
+
+
+def run_replica(
+    cfg,
+    rank: int,
+    n_ranks: int,
+    steps: int,
+    base_port: int = 29500,
+    hosts: str | None = None,
+    out_path: str | None = None,
+):
+    import jax
+    import jax.numpy as jnp
+
+    from hermes_tpu.checker.history import HistoryRecorder
+    from hermes_tpu.core import phases, state as st
+    from hermes_tpu.transport import codec
+    from hermes_tpu.transport.tcp import TcpMesh
+    from hermes_tpu.workload import ycsb
+
+    mesh = TcpMesh(rank, n_ranks, hosts=hosts, base_port=base_port)
+    rs = st.init_replica_state(cfg)
+    stream = jax.tree.map(jnp.asarray, ycsb.make_stream(cfg, rank))
+    recorder = HistoryRecorder(cfg)
+
+    ph = {
+        "coordinate": jax.jit(functools.partial(phases.coordinate, cfg)),
+        "apply_inv": jax.jit(functools.partial(phases.apply_inv, cfg)),
+        "collect_acks": jax.jit(functools.partial(phases.collect_acks, cfg)),
+        "apply_val": jax.jit(functools.partial(phases.apply_val, cfg)),
+    }
+
+    inv_t = st.empty_invs(cfg)
+    ack_row_t = jax.tree.map(lambda x: x[0], st.empty_acks(cfg, lead=(n_ranks,)))
+    val_t = st.empty_vals(cfg)
+
+    def bcast(kind_template, block):
+        """Broadcast: same serialized block to every peer."""
+        b = codec.pack(jax.device_get(block))
+        inb = mesh.exchange(np.tile(b[None], (n_ranks, 1)))
+        return codec.stack([codec.unpack(kind_template, inb[r]) for r in range(n_ranks)])
+
+    def route_ack(block):
+        """Acks: row p of my (R, L) block goes to rank p."""
+        blk = jax.device_get(block)
+        rows = [codec.pack(jax.tree.map(lambda x: np.asarray(x)[p], blk)) for p in range(n_ranks)]
+        inb = mesh.exchange(np.stack(rows))
+        return codec.stack([codec.unpack(ack_row_t, inb[r]) for r in range(n_ranks)])
+
+    from hermes_tpu.core import step as step_lib
+
+    to_j = lambda b: jax.tree.map(jnp.asarray, b)
+
+    for step in range(steps):
+        ctl = st.Ctl(
+            step=jnp.int32(step),
+            my_cid=jnp.int32(rank),
+            epoch=jnp.int32(0),
+            live_mask=jnp.int32(cfg.full_mask),
+            frozen=jnp.bool_(False),
+        )
+        # the shared step body (core/step._step_core) with TCP exchanges
+        rs, comp = step_lib._step_core(
+            cfg,
+            ph,
+            lambda blk: to_j(bcast(inv_t, blk)),
+            lambda blk: to_j(route_ack(blk)),
+            lambda blk: to_j(bcast(val_t, blk)),
+            rs,
+            stream,
+            ctl,
+        )
+        comp_np = jax.device_get(comp)
+        recorder.record_step(jax.tree.map(lambda x: np.asarray(x)[None], comp_np))
+
+    sess_np = jax.device_get(rs.sess)
+    ops = recorder.finalize(jax.tree.map(lambda x: np.asarray(x)[None], sess_np))
+    # stamp the true replica id (recorder saw a leading axis of size 1)
+    import dataclasses
+
+    ops = [dataclasses.replace(o, replica=rank) for o in ops]
+    result = dict(
+        rank=rank,
+        ops=ops,
+        aborted=recorder.aborted_uids,
+        table_state=np.asarray(jax.device_get(rs.table.state)),
+        table_ver=np.asarray(jax.device_get(rs.table.ver)),
+        table_fc=np.asarray(jax.device_get(rs.table.fc)),
+        table_val=np.asarray(jax.device_get(rs.table.val)),
+        sess_status=np.asarray(jax.device_get(rs.sess.status)),
+        counters=dict(
+            n_read=int(jax.device_get(rs.meta.n_read)),
+            n_write=int(jax.device_get(rs.meta.n_write)),
+            n_rmw=int(jax.device_get(rs.meta.n_rmw)),
+            n_abort=int(jax.device_get(rs.meta.n_abort)),
+        ),
+    )
+    if out_path:
+        with open(out_path, "wb") as f:
+            pickle.dump(result, f)
+    mesh.close()
+    return result
+
+
+def combine_and_check(paths):
+    """Merge per-rank results and run the linearizability gate."""
+    from hermes_tpu.checker import linearizability as lin
+
+    results = []
+    for p in paths:
+        with open(p, "rb") as f:
+            results.append(pickle.load(f))
+    ops = [o for r in results for o in r["ops"]]
+    aborted = set().union(*[r["aborted"] for r in results])
+    verdict = lin.check_history(ops, aborted_uids=aborted)
+    return verdict, results
+
+
+def _main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--n-ranks", type=int, required=True)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--base-port", type=int, default=29500)
+    ap.add_argument("--hosts", type=str, default=None)
+    ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--n-keys", type=int, default=256)
+    ap.add_argument("--n-sessions", type=int, default=8)
+    ap.add_argument("--ops-per-session", type=int, default=24)
+    ap.add_argument("--read-frac", type=float, default=0.5)
+    ap.add_argument("--rmw-frac", type=float, default=0.2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    cfg = HermesConfig(
+        n_replicas=args.n_ranks,
+        n_keys=args.n_keys,
+        n_sessions=args.n_sessions,
+        ops_per_session=args.ops_per_session,
+        workload=WorkloadConfig(
+            read_frac=args.read_frac, rmw_frac=args.rmw_frac, seed=args.seed
+        ),
+    )
+    run_replica(
+        cfg,
+        args.rank,
+        args.n_ranks,
+        args.steps,
+        base_port=args.base_port,
+        hosts=args.hosts,
+        out_path=args.out,
+    )
+
+
+if __name__ == "__main__":
+    _main()

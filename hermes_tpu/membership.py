@@ -1,0 +1,77 @@
+"""Host-side membership service (SURVEY.md §1 L4, §5.3).
+
+Hermes delegates membership to an external lease-based service: replicas
+hold leases; a replica that stops heartbeating is suspected, removed from
+the live set with an epoch bump, and pending writes re-evaluate their ack
+quorum against the shrunken mask (unblocking them); a removed replica must
+not serve reads (it self-fences — in this rebuild a frozen/fenced replica
+makes no transitions at all, core/state.Ctl).
+
+The rebuild keeps the service on the host, exactly where the reference
+keeps it (outside the data plane).  Detection input is in-band: every INV
+block carries an ``alive`` heartbeat bit; each replica records
+``meta.last_seen[peer]`` (core/phases.apply_inv) and the service reads
+those clocks off the device every ``poll_interval`` steps.
+
+Suspicion rule: replica r is suspected when NO live peer has heard from it
+for more than ``lease_steps`` steps.  Using the max over live observers
+keeps one partitioned observer from ejecting a healthy replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from hermes_tpu.config import HermesConfig
+
+
+@dataclasses.dataclass
+class MembershipEvent:
+    step: int
+    kind: str  # 'remove' | 'join'
+    replica: int
+    live_mask: int
+
+
+class MembershipService:
+    """Polls heartbeat clocks and drives remove (and scripted join) through
+    a Runtime.  Attach with ``Runtime.attach_membership`` or call ``poll``
+    manually between steps."""
+
+    def __init__(self, cfg: HermesConfig, poll_interval: int = 1):
+        self.cfg = cfg
+        self.poll_interval = poll_interval
+        self.events: List[MembershipEvent] = []
+
+    def poll(self, rt) -> Optional[MembershipEvent]:
+        if rt.step_idx % self.poll_interval != 0:
+            return None
+        live = int(rt.live[0])
+        last_seen = np.asarray(jax.device_get(rt.rs.meta.last_seen))  # (R_obs, R_src)
+        evt = None
+        for r in range(self.cfg.n_replicas):
+            if not (live >> r) & 1:
+                continue
+            observers = [
+                i
+                for i in range(self.cfg.n_replicas)
+                if i != r and (live >> i) & 1 and not rt.frozen[i]
+            ]
+            if not observers:
+                continue
+            freshest = max(int(last_seen[i, r]) for i in observers)
+            if rt.step_idx - freshest > self.cfg.lease_steps:
+                rt.remove(r)
+                live = int(rt.live[0])
+                evt = MembershipEvent(rt.step_idx, "remove", r, live)
+                self.events.append(evt)
+        return evt
+
+    def note_join(self, rt, replica: int) -> None:
+        self.events.append(
+            MembershipEvent(rt.step_idx, "join", replica, int(rt.live[0]))
+        )

@@ -57,6 +57,7 @@ class Runtime:
         self.frozen = np.zeros((r,), bool)
 
         self.recorder = HistoryRecorder(cfg) if record else None
+        self.membership = None  # optional MembershipService (attach_membership)
 
         if backend == "batched":
             self._fused = step_lib.build_step_batched(cfg)
@@ -100,6 +101,12 @@ class Runtime:
         self.epoch += 1
 
     def remove(self, replica: int) -> None:
+        """Remove from membership AND fence: a removed replica must stop
+        serving reads immediately (its keys can go stale the moment the
+        quorum shrinks past it) — the lease self-fencing rule (SURVEY.md
+        §5.3).  Freezing is how a fenced replica is modeled; join() unfences
+        after state transfer."""
+        self.frozen[replica] = True
         self.set_live(int(self.live[0]) & ~(1 << replica))
 
     def join(self, replica: int, from_replica: int) -> None:
@@ -125,8 +132,15 @@ class Runtime:
         self.rs = self.rs._replace(table=new_tbl)
         self.frozen[replica] = False
         self.set_live(int(self.live[0]) | (1 << replica))
+        if self.membership is not None:
+            self.membership.note_join(self, replica)
 
     # -- stepping ----------------------------------------------------------
+
+    def attach_membership(self, service) -> None:
+        """Enable automatic lease-based failure detection: the service polls
+        heartbeat clocks after every step (membership.MembershipService)."""
+        self.membership = service
 
     def step_once(self) -> None:
         ctl = self._ctl()
@@ -137,6 +151,8 @@ class Runtime:
         if self.recorder is not None:
             self.recorder.record_step(jax.device_get(comp))
         self.step_idx += 1
+        if self.membership is not None:
+            self.membership.poll(self)
 
     def _host_step(self, ctl: step_lib.StepCtl):
         """One step through step._step_core with host-mediated exchanges

@@ -1,0 +1,68 @@
+"""Stats / telemetry (SURVEY.md §1 L7, §5.5).
+
+The reference aggregates cache-line-padded per-thread counters in a stats
+thread that prints ops/s and latency percentiles.  Here the counters are the
+device-side Meta columns (summed per step at zero cost); the host reads them
+off-device at reporting interval and derives throughput and the commit-latency
+distribution (in protocol steps, convertible to wall time via the measured
+step duration).  ``JsonlLogger`` writes one JSON object per interval, the
+rebuild's machine-readable metrics log."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, Optional
+
+import jax
+import numpy as np
+
+
+def percentile_from_hist(hist: np.ndarray, q: float) -> int:
+    """q in [0,1]; histogram bins are latency-in-steps (last bin = clip)."""
+    cum = hist.cumsum()
+    if cum[-1] == 0:
+        return -1
+    return int((cum >= q * cum[-1]).argmax())
+
+
+def summarize(meta, wall_s: Optional[float] = None, steps: Optional[int] = None) -> dict:
+    m = jax.device_get(meta)
+    hist = np.asarray(m.lat_hist)
+    if hist.ndim > 1:
+        hist = hist.sum(axis=0)
+    commits = int(np.asarray(m.n_write).sum() + np.asarray(m.n_rmw).sum())
+    out = dict(
+        n_read=int(np.asarray(m.n_read).sum()),
+        n_write=int(np.asarray(m.n_write).sum()),
+        n_rmw=int(np.asarray(m.n_rmw).sum()),
+        n_abort=int(np.asarray(m.n_abort).sum()),
+        commits=commits,
+        p50_commit_steps=percentile_from_hist(hist, 0.5),
+        p99_commit_steps=percentile_from_hist(hist, 0.99),
+        mean_commit_steps=(
+            float(np.asarray(m.lat_sum).sum()) / max(1, int(np.asarray(m.lat_cnt).sum()))
+        ),
+    )
+    if wall_s:
+        out["wall_s"] = round(wall_s, 4)
+        out["writes_per_sec"] = round(commits / wall_s, 1)
+        out["ops_per_sec"] = round((commits + out["n_read"]) / wall_s, 1)
+    if steps:
+        out["steps"] = steps
+        if wall_s:
+            out["step_us"] = round(wall_s / steps * 1e6, 1)
+    return out
+
+
+class JsonlLogger:
+    """Interval metrics to a JSONL stream (one object per report)."""
+
+    def __init__(self, fp: IO[str]):
+        self.fp = fp
+        self.t0 = time.perf_counter()
+
+    def log(self, record: dict) -> None:
+        record = dict(record, t=round(time.perf_counter() - self.t0, 4))
+        self.fp.write(json.dumps(record) + "\n")
+        self.fp.flush()

@@ -1,0 +1,41 @@
+"""Fixed-size wire codec for message blocks (tcp transport).
+
+Blocks are NamedTuples of fixed-shape arrays (state.Invs/Acks/Vals), so a
+block serializes to a fixed byte length: fields concatenated in definition
+order, raveled, raw little-endian bytes (bool = 1 byte, int32 = 4).  Both
+ends derive the layout from the same config, the way the reference's
+fixed-format wire structs do (SURVEY.md §1 L1)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def block_nbytes(template) -> int:
+    return sum(np.asarray(f).nbytes for f in template)
+
+
+def pack(block) -> np.ndarray:
+    """Serialize a block to a 1-D uint8 array."""
+    parts = [np.ascontiguousarray(np.asarray(f)).view(np.uint8).ravel() for f in block]
+    return np.concatenate(parts) if parts else np.zeros(0, np.uint8)
+
+
+def unpack(template, buf: np.ndarray):
+    """Deserialize ``buf`` (uint8, block_nbytes(template) long) into a block
+    shaped like ``template``."""
+    out = []
+    off = 0
+    for f in template:
+        f = np.asarray(f)
+        n = f.nbytes
+        out.append(buf[off : off + n].view(f.dtype).reshape(f.shape))
+        off += n
+    assert off == buf.nbytes, "wire size mismatch"
+    return type(template)(*out)
+
+
+def stack(blocks):
+    """Stack per-source blocks into an inbound block with leading R axis."""
+    first = blocks[0]
+    return type(first)(*[np.stack([np.asarray(b[i]) for b in blocks]) for i in range(len(first))])

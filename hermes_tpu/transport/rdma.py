@@ -1,0 +1,29 @@
+"""RDMA transport stub (SURVEY.md §2 "Net-transport: rdma").
+
+The reference's primary transport is RDMA (ibverbs UD sends with inlining,
+doorbell batching, credit flow control, and a memcached-style bootstrap for
+QP exchange).  This environment has no RDMA NIC, so per the survey the
+plugin *interface* ships with an explicit stub: the constructor documents
+exactly what a real implementation must provide, and fails loudly rather
+than silently degrading to something slower.
+
+A real backend would implement the same surface as transport.tcp.TcpMesh —
+``exchange(out_slices: (R, B) uint8) -> (R, B) uint8`` with per-edge FIFO
+delivery — on ibverbs: one UD QP per process, INV/ACK/VAL records inlined
+into sends (IBV_SEND_INLINE for <= ~188B), doorbell-batched posts per step,
+and a credit counter per peer for flow control.
+"""
+
+from __future__ import annotations
+
+
+class RdmaMesh:
+    """Interface-compatible stand-in for an ibverbs transport."""
+
+    def __init__(self, my_rank: int, n_ranks: int, hosts: str | None = None, **kw):
+        raise NotImplementedError(
+            "transport=rdma requires an RDMA NIC and an ibverbs build; this "
+            "environment has neither.  Use transport=tcp (same wire contract "
+            "over sockets) or transport=tpu_ici (ICI collectives).  See this "
+            "module's docstring for the implementation contract."
+        )

@@ -1,0 +1,91 @@
+"""TCP transport binding (SURVEY.md §2 "Net-transport: tcp").
+
+ctypes binding of the C++ full-mesh exchanger (native/tcp_transport.cpp).
+One process = one Hermes replica; ``TcpMesh.exchange`` moves one fixed-size
+block per peer per call with per-edge FIFO + reliability (TCP), i.e. the
+lockstep schedule of the sim transport realized over real sockets.  Used by
+hermes_tpu.distributed for multi-process runs; proves the transport plugin
+seam is real native code, not a Python stand-in.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_SO = _NATIVE_DIR / "libhermes_tcp.so"
+_SRC = _NATIVE_DIR / "tcp_transport.cpp"
+
+
+def _ensure_built() -> pathlib.Path:
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO
+    # Atomic build: compile to a unique temp path, rename into place — many
+    # replica processes may race here on a fresh checkout, and a rank must
+    # never dlopen a half-written .so.
+    tmp = _SO.with_suffix(f".so.tmp.{os.getpid()}")
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", str(tmp), str(_SRC), "-pthread"],
+        check=True,
+        cwd=str(_NATIVE_DIR),
+    )
+    os.replace(tmp, _SO)
+    return _SO
+
+
+class TcpMesh:
+    """Full-mesh, step-synchronous block exchange between replica processes."""
+
+    def __init__(self, my_rank: int, n_ranks: int, hosts: str | None = None, base_port: int = 29500):
+        lib = ctypes.CDLL(str(_ensure_built()))
+        lib.ht_create.restype = ctypes.c_void_p
+        lib.ht_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.ht_exchange.restype = ctypes.c_int
+        lib.ht_exchange.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.ht_destroy.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self.my_rank = my_rank
+        self.n_ranks = n_ranks
+        hosts = hosts or ",".join(["127.0.0.1"] * n_ranks)
+        self._h = lib.ht_create(my_rank, n_ranks, hosts.encode(), base_port)
+        if not self._h:
+            raise RuntimeError(
+                f"tcp mesh setup failed (rank {my_rank}/{n_ranks}, base_port {base_port})"
+            )
+
+    def exchange(self, out_slices: np.ndarray) -> np.ndarray:
+        """out_slices: (R, B) uint8, slice r to rank r.  Returns (R, B) with
+        slice r received from rank r (self slice copied through)."""
+        out = np.ascontiguousarray(out_slices, dtype=np.uint8)
+        assert out.shape[0] == self.n_ranks
+        inb = np.empty_like(out)
+        rc = self._lib.ht_exchange(
+            self._h,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_uint64(out.shape[1]),
+            inb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if rc != 0:
+            raise RuntimeError("tcp exchange failed (peer closed?)")
+        return inb
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ht_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass

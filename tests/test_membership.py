@@ -1,0 +1,95 @@
+"""Membership service tests (SURVEY.md §5.3; configs 4-5, BASELINE.json:10-11):
+automatic lease-based failure detection from in-band heartbeats, quorum
+unblocking, scripted rejoin — all under the linearizability gate."""
+
+import numpy as np
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import types as t
+from hermes_tpu.membership import MembershipService
+from hermes_tpu.runtime import Runtime
+from hermes_tpu.transport.sim import SimTransport
+
+from helpers import get
+
+
+def make_rt(seed=50, n_replicas=4, **kw):
+    base = dict(
+        n_replicas=n_replicas, n_keys=64, n_sessions=4, replay_slots=8,
+        ops_per_session=20, replay_age=5, lease_steps=6,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.2, seed=seed),
+    )
+    base.update(kw)
+    cfg = HermesConfig(**base)
+    rt = Runtime(cfg, backend="sim", record=True, transport=SimTransport(n_replicas))
+    rt.attach_membership(MembershipService(cfg))
+    return cfg, rt
+
+
+def test_auto_detect_removes_stalled_replica():
+    """Config 4 (BASELINE.json:10): stall a replica mid-workload; the service
+    must suspect it after the lease and remove it, unblocking writes."""
+    cfg, rt = make_rt()
+    rt.run(5)
+    rt.freeze(3)
+    rt.run(cfg.lease_steps + 3)
+    assert rt.membership.events, "no membership event fired"
+    evt = rt.membership.events[0]
+    assert evt.kind == "remove" and evt.replica == 3
+    assert not (int(rt.live[0]) >> 3) & 1
+    # the surviving trio drains and the history linearizes
+    assert rt.drain(500)
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+
+
+def test_auto_detect_then_rejoin_converges():
+    """Config 5 (BASELINE.json:11): remove via lease expiry, then scripted
+    join with state transfer; full convergence + checker."""
+    cfg, rt = make_rt(seed=51)
+    rt.run(4)
+    rt.freeze(2)
+    rt.run(cfg.lease_steps + 3)
+    assert any(e.kind == "remove" and e.replica == 2 for e in rt.membership.events)
+    rt.run(10)
+    rt.join(2, from_replica=0)
+    assert any(e.kind == "join" for e in rt.membership.events)
+    assert rt.drain(500)
+    assert rt.check().ok
+    state = get(rt.rs.table.state)
+    assert (state == t.VALID).all()
+    ver = get(rt.rs.table.ver)
+    for r in range(1, cfg.n_replicas):
+        np.testing.assert_array_equal(ver[0], ver[r])
+
+
+def test_false_suspicion_fences_partitioned_replica():
+    """Regression: a replica that is merely PARTITIONED (messages dropped,
+    process alive) must be fenced when the service removes it — otherwise it
+    would keep serving stale reads after the quorum shrinks past it."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=64, n_sessions=4, replay_slots=8, ops_per_session=30,
+        replay_age=5, lease_steps=6,
+        workload=WorkloadConfig(read_frac=0.6, rmw_frac=0.0, seed=53),
+    )
+
+    def partition_2(kind, src, dst, step):
+        if (src == 2 or dst == 2) and src != dst and 5 <= step:
+            return []  # drop everything to/from replica 2 (it stays unfrozen!)
+        return [step]
+
+    rt = Runtime(cfg, backend="sim", record=True, transport=SimTransport(3, partition_2))
+    rt.attach_membership(MembershipService(cfg))
+    rt.run(5 + cfg.lease_steps + 3)
+    assert any(e.kind == "remove" and e.replica == 2 for e in rt.membership.events)
+    assert rt.frozen[2], "removed replica must be fenced (no stale reads)"
+    assert rt.drain(500)
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+
+
+def test_healthy_cluster_never_ejects():
+    cfg, rt = make_rt(seed=52)
+    rt.run(3 * cfg.lease_steps)
+    assert not rt.membership.events
+    assert int(rt.live[0]) == cfg.full_mask
