@@ -50,6 +50,12 @@ class HermesConfig:
     replay_age: int = 16  # steps a key may sit Invalid before the replay scan picks it up
     lease_steps: int = 8  # host-side membership lease (steps without heartbeat -> suspect)
 
+    # Bench mode (SURVEY.md §7 M6): sessions cycle their op stream forever
+    # instead of going DONE after ops_per_session ops, so a small pre-generated
+    # stream drives an arbitrarily long run.  Write uids stay unique until the
+    # total per-session op count reaches 2^31 / n_sessions.
+    wrap_stream: bool = False
+
     workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)
 
     def __post_init__(self) -> None:

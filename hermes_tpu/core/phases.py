@@ -60,10 +60,11 @@ def _set(arr, idx, val, mask):
 
 def _write_value(cfg: HermesConfig, my_cid, sess_idx, op_idx):
     """Unique write values, derived on device: words 0/1 are the unique id
-    (lo = session*G + op, hi = replica), remaining words a cheap mix so value
-    payloads are non-trivial.  Uniqueness is what makes the linearizability
-    check tractable (SURVEY.md §4)."""
-    lo = sess_idx * cfg.ops_per_session + op_idx
+    (lo = op_idx*S + session, hi = replica), remaining words a cheap mix so
+    value payloads are non-trivial.  Uniqueness is what makes the
+    linearizability check tractable (SURVEY.md §4); this formula stays unique
+    under ``wrap_stream`` too, where op_idx grows past ops_per_session."""
+    lo = op_idx * cfg.n_sessions + sess_idx
     hi = jnp.broadcast_to(my_cid, lo.shape)
     words = [lo, hi]
     for j in range(2, cfg.value_words):
@@ -102,11 +103,15 @@ def coordinate(
     idx = jnp.arange(S, dtype=jnp.int32)
 
     # --- 1) op intake -----------------------------------------------------
-    can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~ctl.frozen
-    g = jnp.clip(sess.op_idx, 0, G - 1)
+    if cfg.wrap_stream:
+        can_load = (sess.status == t.S_IDLE) & ~ctl.frozen
+        g = sess.op_idx % G
+    else:
+        can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~ctl.frozen
+        g = jnp.clip(sess.op_idx, 0, G - 1)
     new_op = stream.op[idx, g]
     new_key = stream.key[idx, g]
-    new_val = _write_value(cfg, ctl.my_cid, idx, g)
+    new_val = _write_value(cfg, ctl.my_cid, idx, sess.op_idx)
 
     is_nop = can_load & (new_op == t.OP_NOP)
     status = jnp.where(
@@ -118,7 +123,8 @@ def coordinate(
         ),
         sess.status,
     )
-    status = jnp.where((status == t.S_IDLE) & (sess.op_idx >= G), t.S_DONE, status)
+    if not cfg.wrap_stream:
+        status = jnp.where((status == t.S_IDLE) & (sess.op_idx >= G), t.S_DONE, status)
     sess = sess._replace(
         status=status,
         op=jnp.where(can_load, new_op, sess.op),

@@ -73,14 +73,20 @@ def _per_replica_ctl(cfg: HermesConfig, ctl: StepCtl) -> st.Ctl:
 # --------------------------------------------------------------------------
 
 
+def phase_fns(cfg: HermesConfig):
+    """The four protocol phases bound to a config — the single source for
+    every backend (vmapped, sharded, jitted host-mediated)."""
+    return dict(
+        coordinate=functools.partial(phases.coordinate, cfg),
+        apply_inv=functools.partial(phases.apply_inv, cfg),
+        collect_acks=functools.partial(phases.collect_acks, cfg),
+        apply_val=functools.partial(phases.apply_val, cfg),
+    )
+
+
 def vmapped_phases(cfg: HermesConfig):
     """Phase functions lifted over a leading replica axis."""
-    return dict(
-        coordinate=jax.vmap(functools.partial(phases.coordinate, cfg)),
-        apply_inv=jax.vmap(functools.partial(phases.apply_inv, cfg)),
-        collect_acks=jax.vmap(functools.partial(phases.collect_acks, cfg)),
-        apply_val=jax.vmap(functools.partial(phases.apply_val, cfg)),
-    )
+    return {k: jax.vmap(v) for k, v in phase_fns(cfg).items()}
 
 
 def lockstep_bcast(block):
@@ -136,17 +142,40 @@ def build_step_batched(cfg: HermesConfig, donate: bool = False):
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def build_step_scan(cfg: HermesConfig, rounds: int, donate: bool = True):
+    """``rounds`` protocol rounds in ONE dispatch via ``lax.scan`` (SURVEY.md
+    §7 M6).  The per-step builder pays a host->device round trip per protocol
+    round — over the tunneled PJRT link that dominates everything — so the
+    bench path folds the host loop into the compiled program.  Membership
+    (epoch / live_mask / frozen) is constant within a chunk; ``ctl.step`` is
+    the chunk's first round index.  Completions are consumed into the meta
+    counters only (checked runs use ``build_step_batched``); returns the
+    post-chunk state."""
+    ph = vmapped_phases(cfg)
+
+    def chunk(rs: st.ReplicaState, stream: st.OpStream, ctl: StepCtl):
+        def body(carry, off):
+            pctl = _per_replica_ctl(cfg, ctl._replace(step=ctl.step + off))
+            nxt, _comp = _step_core(
+                cfg, ph, lockstep_bcast, lockstep_route_back, lockstep_bcast,
+                carry, stream, pctl,
+            )
+            return nxt, None
+        rs, _ = jax.lax.scan(body, rs, jnp.arange(rounds, dtype=jnp.int32))
+        return rs
+
+    return jax.jit(chunk, donate_argnums=(0,) if donate else ())
+
+
 # --------------------------------------------------------------------------
 # Sharded step: one replica per device over Mesh(('replica',))
 # --------------------------------------------------------------------------
 
 
-def build_step_sharded(cfg: HermesConfig, mesh: Mesh):
-    """The ``transport=tpu_ici`` step (BASELINE.json:5): the same phases run
-    per-shard under shard_map; INV/VAL broadcasts are ``all_gather`` and the
-    ACK route-back is ``all_to_all`` over the 'replica' ICI axis."""
-    if mesh.shape["replica"] != cfg.n_replicas:
-        raise ValueError("mesh 'replica' axis size must equal cfg.n_replicas")
+def _ici_exchanges():
+    """The tpu_ici transport collectives (BASELINE.json:5): INV/VAL broadcasts
+    are ``all_gather``, the ACK route-back is ``all_to_all``, both over the
+    'replica' mesh axis (ICI on a real slice)."""
 
     def bcast(block):
         return jax.tree.map(
@@ -159,12 +188,17 @@ def build_step_sharded(cfg: HermesConfig, mesh: Mesh):
             block,
         )
 
-    ph = dict(
-        coordinate=functools.partial(phases.coordinate, cfg),
-        apply_inv=functools.partial(phases.apply_inv, cfg),
-        collect_acks=functools.partial(phases.collect_acks, cfg),
-        apply_val=functools.partial(phases.apply_val, cfg),
-    )
+    return bcast, route_back
+
+
+def build_step_sharded(cfg: HermesConfig, mesh: Mesh):
+    """The ``transport=tpu_ici`` step (BASELINE.json:5): the same phases run
+    per-shard under shard_map; INV/VAL broadcasts are ``all_gather`` and the
+    ACK route-back is ``all_to_all`` over the 'replica' ICI axis."""
+    if mesh.shape["replica"] != cfg.n_replicas:
+        raise ValueError("mesh 'replica' axis size must equal cfg.n_replicas")
+    bcast, route_back = _ici_exchanges()
+    ph = phase_fns(cfg)
 
     def shard_body(rs, stream, ctl):
         # Leaves arrive with a leading local axis of size 1; strip it.
@@ -190,6 +224,46 @@ def build_step_sharded(cfg: HermesConfig, mesh: Mesh):
         check_vma=False,
     )
     return jax.jit(sharded)
+
+
+def build_step_sharded_scan(cfg: HermesConfig, mesh: Mesh, rounds: int, donate: bool = True):
+    """``rounds`` tpu_ici protocol rounds in one dispatch: the ``lax.scan``
+    lives INSIDE shard_map, so each round's all_gather/all_to_all rides ICI
+    back-to-back with no host involvement between rounds (SURVEY.md §7 M6).
+    Same chunk semantics as ``build_step_scan``."""
+    if mesh.shape["replica"] != cfg.n_replicas:
+        raise ValueError("mesh 'replica' axis size must equal cfg.n_replicas")
+    bcast, route_back = _ici_exchanges()
+    ph = phase_fns(cfg)
+
+    def shard_body(rs, stream, ctl):
+        rs1 = jax.tree.map(lambda x: x[0], rs)
+        stream1 = jax.tree.map(lambda x: x[0], stream)
+        my = jax.lax.axis_index("replica").astype(jnp.int32)
+
+        def body(carry, off):
+            pctl = st.Ctl(
+                step=ctl.step + off,
+                my_cid=my,
+                epoch=ctl.epoch[0],
+                live_mask=ctl.live_mask[0],
+                frozen=ctl.frozen[0],
+            )
+            nxt, _comp = _step_core(cfg, ph, bcast, route_back, bcast, carry, stream1, pctl)
+            return nxt, None
+
+        rs1, _ = jax.lax.scan(body, rs1, jnp.arange(rounds, dtype=jnp.int32))
+        return jax.tree.map(lambda x: x[None], rs1)
+
+    rspec = P("replica")
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(rspec, rspec, StepCtl(step=P(), epoch=rspec, live_mask=rspec, frozen=rspec)),
+        out_specs=rspec,
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def place_sharded(cfg: HermesConfig, mesh: Mesh, rs: st.ReplicaState, stream: st.OpStream):

@@ -18,7 +18,6 @@ Each rank writes its completion history + final table to ``--out``;
 from __future__ import annotations
 
 import argparse
-import functools
 import os
 import pickle
 
@@ -38,7 +37,7 @@ def run_replica(
     import jax.numpy as jnp
 
     from hermes_tpu.checker.history import HistoryRecorder
-    from hermes_tpu.core import phases, state as st
+    from hermes_tpu.core import state as st, step as step_lib
     from hermes_tpu.transport import codec
     from hermes_tpu.transport.tcp import TcpMesh
     from hermes_tpu.workload import ycsb
@@ -48,12 +47,7 @@ def run_replica(
     stream = jax.tree.map(jnp.asarray, ycsb.make_stream(cfg, rank))
     recorder = HistoryRecorder(cfg)
 
-    ph = {
-        "coordinate": jax.jit(functools.partial(phases.coordinate, cfg)),
-        "apply_inv": jax.jit(functools.partial(phases.apply_inv, cfg)),
-        "collect_acks": jax.jit(functools.partial(phases.collect_acks, cfg)),
-        "apply_val": jax.jit(functools.partial(phases.apply_val, cfg)),
-    }
+    ph = {k: jax.jit(v) for k, v in step_lib.phase_fns(cfg).items()}
 
     inv_t = st.empty_invs(cfg)
     ack_row_t = jax.tree.map(lambda x: x[0], st.empty_acks(cfg, lead=(n_ranks,)))
@@ -71,8 +65,6 @@ def run_replica(
         rows = [codec.pack(jax.tree.map(lambda x: np.asarray(x)[p], blk)) for p in range(n_ranks)]
         inb = mesh.exchange(np.stack(rows))
         return codec.stack([codec.unpack(ack_row_t, inb[r]) for r in range(n_ranks)])
-
-    from hermes_tpu.core import step as step_lib
 
     to_j = lambda b: jax.tree.map(jnp.asarray, b)
 

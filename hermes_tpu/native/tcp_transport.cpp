@@ -23,6 +23,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -66,6 +67,27 @@ bool recv_all(int fd, uint8_t* buf, size_t n) {
     ssize_t r = ::recv(fd, buf, n, 0);
     if (r <= 0) {
       if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    buf += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// recv_all with a deadline: a peer that is alive but wedged (stopped,
+// GIL-stuck) never closes its socket, so a bare recv() would block every
+// other rank forever.  Steady state gets the same bounded-wait discipline as
+// the ht_create accept/dial path.
+bool recv_all_timeout(int fd, uint8_t* buf, size_t n, int timeout_ms) {
+  while (n > 0) {
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, timeout_ms);
+    if (pr < 0 && errno == EINTR) continue;
+    if (pr <= 0) return false;  // timeout or poll error
+    ssize_t r = ::recv(fd, buf, n, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR || errno == EAGAIN)) continue;
       return false;
     }
     buf += r;
@@ -190,23 +212,25 @@ int ht_exchange(void* handle, const uint8_t* out, uint64_t block_size, uint8_t* 
   auto* m = static_cast<Mesh*>(handle);
   std::vector<std::thread> senders;
   senders.reserve(m->n_ranks);
-  bool send_ok = true;
+  std::atomic<bool> send_ok{true};
   for (int r = 0; r < m->n_ranks; ++r) {
     if (r == m->my_rank) {
       std::memcpy(in + r * block_size, out + r * block_size, block_size);
       continue;
     }
     senders.emplace_back([m, r, out, block_size, &send_ok]() {
-      if (!send_all(m->fds[r], out + r * block_size, block_size)) send_ok = false;
+      if (!send_all(m->fds[r], out + r * block_size, block_size))
+        send_ok.store(false, std::memory_order_relaxed);
     });
   }
   bool recv_ok = true;
   for (int r = 0; r < m->n_ranks; ++r) {
     if (r == m->my_rank) continue;
-    if (!recv_all(m->fds[r], in + r * block_size, block_size)) recv_ok = false;
+    if (!recv_all_timeout(m->fds[r], in + r * block_size, block_size, 60 * 1000))
+      recv_ok = false;
   }
   for (auto& t : senders) t.join();
-  return (send_ok && recv_ok) ? 0 : -1;
+  return (send_ok.load(std::memory_order_relaxed) && recv_ok) ? 0 : -1;
 }
 
 void ht_destroy(void* handle) {

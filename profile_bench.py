@@ -1,0 +1,40 @@
+"""Bisect the bench-loop slowdown (dev tool)."""
+import time
+import jax, jax.numpy as jnp
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import state as st, step as step_lib
+from hermes_tpu.workload import ycsb
+
+
+def make(donate):
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=4096,
+        replay_slots=256, ops_per_session=128,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+    r = cfg.n_replicas
+    rs = jax.device_put(jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (r,) + x.shape), st.init_replica_state(cfg)))
+    stream = jax.device_put(jax.tree.map(jnp.asarray, ycsb.make_streams(cfg)))
+    return cfg, rs, stream, step_lib.build_step_batched(cfg, donate=donate)
+
+
+def loop(tag, donate, fresh_ctl, n=30):
+    cfg, rs, stream, step = make(donate)
+    ctl0 = step_lib.make_ctl(cfg, 0)
+    for s in range(5):
+        rs, _ = step(rs, stream, step_lib.make_ctl(cfg, s) if fresh_ctl else ctl0)
+    jax.block_until_ready(rs)
+    t0 = time.perf_counter()
+    for s in range(5, 5 + n):
+        rs, _ = step(rs, stream, step_lib.make_ctl(cfg, s) if fresh_ctl else ctl0)
+    jax.block_until_ready(rs)
+    print(f"{tag:40s}: {(time.perf_counter() - t0) / n * 1e3:8.2f} ms/step")
+
+
+if __name__ == "__main__":
+    loop("donate=False fresh_ctl=False", False, False)
+    loop("donate=False fresh_ctl=True", False, True)
+    loop("donate=True  fresh_ctl=False", True, False)
+    loop("donate=True  fresh_ctl=True", True, True)

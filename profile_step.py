@@ -1,0 +1,63 @@
+"""Phase-level profiling of the batched step (dev tool, not shipped API)."""
+import functools, time, sys
+import jax, jax.numpy as jnp
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import state as st, step as step_lib, phases
+from hermes_tpu.workload import ycsb
+
+
+def timeit(f, *args, n=20):
+    o = f(*args)
+    jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        o = f(*args)
+    jax.block_until_ready(o)
+    return (time.perf_counter() - t0) / n * 1e3  # ms
+
+
+def main(K=1 << 20, S=4096):
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=K, value_words=8, n_sessions=S, replay_slots=256,
+        ops_per_session=128, workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+    r = cfg.n_replicas
+    rs = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (r,) + x.shape),
+                      st.init_replica_state(cfg))
+    rs = jax.device_put(rs)
+    stream = jax.device_put(jax.tree.map(jnp.asarray, ycsb.make_streams(cfg)))
+    ctl = step_lib.make_ctl(cfg, 0)
+    pctl = step_lib._per_replica_ctl(cfg, ctl)
+    ph = step_lib.vmapped_phases(cfg)
+
+    full = jax.jit(lambda rs, stream, ctl: step_lib._step_core(
+        cfg, ph, step_lib.lockstep_bcast, step_lib.lockstep_route_back,
+        step_lib.lockstep_bcast, rs, stream, step_lib._per_replica_ctl(cfg, ctl)))
+    print(f"K={K} S={S}  full step: {timeit(full, rs, stream, ctl):8.2f} ms")
+
+    c = jax.jit(lambda: ph["coordinate"](pctl, rs.table, rs.sess, rs.replay, stream))()
+    jax.block_until_ready(c)
+    print(f"  coordinate : {timeit(jax.jit(lambda rs, stream: ph['coordinate'](pctl, rs.table, rs.sess, rs.replay, stream)), rs, stream):8.2f} ms")
+
+    in_inv = step_lib.lockstep_bcast(c.out_inv)
+    f_ai = jax.jit(lambda table, sess, meta, in_inv: ph["apply_inv"](pctl, table, sess, meta, in_inv))
+    a = f_ai(c.table, c.sess, rs.meta, in_inv)
+    jax.block_until_ready(a)
+    print(f"  apply_inv  : {timeit(f_ai, c.table, c.sess, rs.meta, in_inv):8.2f} ms")
+
+    in_ack = step_lib.lockstep_route_back(a.out_ack)
+    f_ca = jax.jit(lambda table, sess, replay, meta, in_ack: ph["collect_acks"](pctl, table, sess, replay, meta, in_ack))
+    k = f_ca(a.table, a.sess, c.replay, a.meta, in_ack)
+    jax.block_until_ready(k)
+    print(f"  collect_ack: {timeit(f_ca, a.table, a.sess, c.replay, a.meta, in_ack):8.2f} ms")
+
+    in_val = step_lib.lockstep_bcast(k.out_val)
+    f_av = jax.jit(lambda table, in_val: ph["apply_val"](pctl, table, in_val))
+    print(f"  apply_val  : {timeit(f_av, k.table, in_val):8.2f} ms")
+
+
+if __name__ == "__main__":
+    K = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    S = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    main(K, S)
