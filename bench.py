@@ -1,16 +1,24 @@
-"""Benchmark: committed writes/sec of the Hermes protocol step.
+"""Benchmark: committed writes/sec of the Hermes protocol round.
 
 Target (BASELINE.json:5): >=10M committed writes/sec aggregate on a v5e-8
 (8 replicas, 1 chip = 1 replica).  This environment exposes ONE v5e chip, so
 the bench runs the 8-replica configuration batched on that chip — every
-replica's kernel work AND all 8x8 message traffic execute on the single
-chip, which lower-bounds the per-chip work of the real 8-chip mesh (the real
-mesh splits this work 8 ways and pays ICI instead of on-chip copies).
+replica's protocol work AND all 8x8 message traffic execute on the single
+chip.  A real 8-chip mesh splits this work 8 ways (each chip applies each
+write once instead of this chip applying it 8 times) and pays ICI instead of
+on-chip copies, so the single-chip number lower-bounds the real-mesh
+aggregate.
 
-The chip is reached through a tunneled PJRT link whose round-trip latency is
-large and variable, so the measured loop is scan-chunked (SURVEY.md §7 M6):
-``build_step_scan`` runs ROUNDS protocol rounds per dispatch and the host
-touches the device a handful of times total.
+Runs the TPU-optimized round (core/faststep.py: packed-ts scatter-max
+conflict resolution, lane compaction, cond-gated replay scan), scan-chunked
+so one dispatch executes ROUNDS protocol rounds (SURVEY.md §7 M6).
+
+Measurement protocol for this runtime (measured, see faststep.py header):
+execution through the tunneled PJRT link is DEFERRED until the first
+device-to-host readback — ``block_until_ready`` alone does not execute the
+queued work — and after that first readback the session runs synchronously.
+The first counter readback below therefore both drains the warmup chunk and
+switches to honest timing for the measured loop.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
 vs_baseline = value / 1e7 (the north-star aggregate target).
@@ -23,75 +31,75 @@ import time
 import jax
 import jax.numpy as jnp
 
-ROUNDS = 100  # protocol rounds per dispatch
-CHUNKS = 5  # measured dispatches
-WARMUP_CHUNKS = 2
+ROUNDS = 50  # protocol rounds per dispatch
+CHUNKS = 4  # measured dispatches
+WARMUP_CHUNKS = 1
 
 
 def main() -> None:
     from hermes_tpu.config import HermesConfig, WorkloadConfig
-    from hermes_tpu.core import state as st, step as step_lib
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.stats import percentile_from_hist
     from hermes_tpu.workload import ycsb
 
     cfg = HermesConfig(
         n_replicas=8,
-        n_keys=1 << 20,
+        n_keys=1 << 20,  # 1M keys (BASELINE.json:7)
         value_words=8,  # 32B values, the reference's typical small-value shape
-        n_sessions=4096,
+        n_sessions=16384,  # in-flight ops per replica (tuned on-chip)
         replay_slots=256,
         ops_per_session=256,
-        wrap_stream=True,  # stream cycles; uids stay unique (config.py)
-        workload=WorkloadConfig(read_frac=0.5, seed=0),  # YCSB-A mix; metric counts writes
+        wrap_stream=True,  # stream cycles; write uids stay unique (config.py)
+        lane_budget_cfg=8192,
+        rebroadcast_every=4,
+        replay_scan_every=32,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),  # YCSB-A; metric counts writes
     )
 
-    r = cfg.n_replicas
-    rs = jax.tree.map(
-        lambda x: jnp.broadcast_to(x[None], (r,) + x.shape), st.init_replica_state(cfg)
-    )
-    rs = jax.device_put(rs)
+    fs = jax.device_put(fst.init_fast_state(cfg))
     stream = jax.device_put(jax.tree.map(jnp.asarray, ycsb.make_streams(cfg)))
-
-    chunk = step_lib.build_step_scan(cfg, ROUNDS, donate=True)
+    chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
 
     def counters(x):
         m = jax.device_get(x.meta)
         return int(m.n_write.sum() + m.n_rmw.sum())
 
     for c in range(WARMUP_CHUNKS):
-        rs = chunk(rs, stream, step_lib.make_ctl(cfg, c * ROUNDS))
-    jax.block_until_ready(rs)
-    c0 = counters(rs)
-    lat0 = jax.device_get(rs.meta.lat_hist).sum(axis=0)
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * ROUNDS))
+    jax.block_until_ready(fs)
+    c0 = counters(fs)  # drains warmup; switches the link to synchronous mode
+    lat0 = jax.device_get(fs.meta.lat_hist).sum(axis=0)
 
     t0 = time.perf_counter()
     for c in range(WARMUP_CHUNKS, WARMUP_CHUNKS + CHUNKS):
-        rs = chunk(rs, stream, step_lib.make_ctl(cfg, c * ROUNDS))
-    jax.block_until_ready(rs)
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * ROUNDS))
+    jax.block_until_ready(fs)
     t1 = time.perf_counter()
 
     measure = CHUNKS * ROUNDS
-    commits = counters(rs) - c0
+    commits = counters(fs) - c0
     wall = t1 - t0
     wps = commits / wall
 
-    # p50 commit latency in steps -> microseconds via measured step time
-    from hermes_tpu.stats import percentile_from_hist
-
-    hist = jax.device_get(rs.meta.lat_hist).sum(axis=0) - lat0
-    p50_steps = percentile_from_hist(hist, 0.5)
+    # p50 commit latency in protocol rounds -> microseconds via measured
+    # round time (commit latency = 1 round for an uncontended write)
+    hist = jax.device_get(fs.meta.lat_hist).sum(axis=0) - lat0
+    p50_rounds = percentile_from_hist(hist, 0.5)
     step_us = wall / measure * 1e6
 
     meta = {
         "commits": commits,
-        "steps": measure,
+        "rounds": measure,
         "wall_s": round(wall, 4),
-        "step_us": round(step_us, 1),
-        "p50_commit_steps": p50_steps,
-        "p50_commit_us_est": round((p50_steps + 1) * step_us, 1),
+        "round_us": round(step_us, 1),
+        "p50_commit_rounds": p50_rounds,
+        "p50_commit_us_est": round((p50_rounds + 1) * step_us, 1),
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
         "replicas_on_chip": cfg.n_replicas,
         "rounds_per_dispatch": ROUNDS,
+        "n_sessions": cfg.n_sessions,
+        "lane_budget": cfg.lane_budget,
     }
     print(json.dumps(meta), file=sys.stderr)
     print(

@@ -9,7 +9,7 @@ lanes) is static so a config maps 1:1 to a compiled XLA program.
 from __future__ import annotations
 
 import dataclasses
-from typing import Literal
+from typing import Literal, Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +56,19 @@ class HermesConfig:
     # total per-session op count reaches 2^31 / n_sessions.
     wrap_stream: bool = False
 
+    # --- faststep knobs (core/faststep.py) --------------------------------
+    # Outbound INV/VAL lanes compact to this budget per round (None = no
+    # compaction, every lane gets a slot).  Overflowing lanes wait a round —
+    # safe, since same-ts re-broadcast is idempotent (SURVEY.md §7 hard
+    # part 2).
+    lane_budget_cfg: Optional[int] = None
+    # An unacked in-flight lane re-broadcasts its INV every this many rounds
+    # (fresh issues always broadcast).
+    rebroadcast_every: int = 4
+    # The full-table stuck-key replay scan (SURVEY.md §3.4) runs every this
+    # many rounds (it only matters after failures/drops).
+    replay_scan_every: int = 8
+
     workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)
 
     def __post_init__(self) -> None:
@@ -79,3 +92,25 @@ class HermesConfig:
     def n_lanes(self) -> int:
         """Outbound message lanes per replica: one per session + one per replay slot."""
         return self.n_sessions + self.replay_slots
+
+    @property
+    def lane_budget(self) -> int:
+        """Resolved faststep compaction budget (slots per outbound block)."""
+        if self.lane_budget_cfg is not None:
+            return min(self.lane_budget_cfg, self.n_lanes)
+        return self.n_lanes
+
+    @property
+    def max_key_versions(self) -> int:
+        """faststep's packed-ts limit: versions one key can take before the
+        int32 sign bit corrupts the Lamport compare (core/faststep.py)."""
+        return 1 << (31 - 10 - 1)
+
+    @property
+    def arb_slots(self) -> int:
+        """Hash-slot count for same-replica same-key issue arbitration
+        (faststep): power of two, >= 4x sessions, capped at 64Ki."""
+        hs = 1
+        while hs < min(4 * self.n_sessions, 1 << 16):
+            hs <<= 1
+        return hs

@@ -1,0 +1,777 @@
+"""TPU-optimized Hermes protocol round ("faststep").
+
+Same protocol as core/phases.py (the readable reference semantics:
+coordinate -> INV -> apply_inv -> ACK -> collect_acks -> VAL -> apply_val,
+function roles per BASELINE.json:5), re-engineered for the measured cost
+model of the target TPU runtime:
+
+  * every XLA fusion/kernel launch costs ~1.4 ms through the tunneled PJRT
+    runtime, so the round is built from the FEWEST possible ops;
+  * scatters cost ~4 ns/word and gathers ~0.5 ns/word regardless of table
+    size, so message volume (not key count) is the data cost;
+  * dense K-sized passes are cheap in bandwidth but each op pays the launch
+    tax, so the common path touches the key-state table ONLY through
+    gathers/scatters — no full-table passes outside the (gated) replay scan.
+
+The key engineering moves, mapped to the reference:
+
+  1. **Packed Lamport timestamp** ``pts = (ver << PTS_FC_BITS) | fc`` with
+     ``fc = (flag << 8) | cid`` (core/timestamps.py).  Lexicographic
+     (ver, fc) compare == integer compare on pts, so the reference's
+     per-key conflict resolution (max-timestamp wins, SURVEY.md §7 hard
+     part 4) becomes a single ``scatter-max`` into the table — the batch
+     winner, the stale-INV drop, and the idempotent same-ts re-apply all
+     fall out of one atomic max op.  Packing limit: a key supports
+     2^(31-PTS_FC_BITS-1) = ~1M versions before the sign bit corrupts the
+     compare (HermesConfig.max_key_versions); runs long enough to rotate a
+     single key a million times must use the reference phases path.
+  2. **Packed state+age** ``sst = (last_change_step << 3) | state``: the
+     per-key state machine word and the replay age (SURVEY.md §3.4) travel
+     in one scatter.
+  3. **Lane compaction with rebroadcast backoff**: outbound INV lanes
+     (sessions + replay slots, SURVEY.md §1 L1 "batching") compact to a
+     fixed budget C per round, rotating priority so no lane starves; lanes
+     already waiting on acks re-broadcast only every ``rebroadcast_every``
+     rounds.  Overflowing lanes simply wait a round — re-broadcast of the
+     same-ts INV is idempotent, so backpressure is free (SURVEY.md §7 hard
+     part 2).
+  4. **Replay scan gating**: the full-table stuck-key scan runs under
+     ``lax.cond`` every ``replay_scan_every`` rounds (it only matters after
+     failures; BASELINE.json:10).
+  5. **No vmap**: the body is written with an explicit leading replica axis
+     and flat global scatter/gather indices, so the same code runs batched
+     (R replicas on one chip, the reference's single-process test mode,
+     BASELINE.json:7) and under shard_map (1 replica per chip over the
+     'replica' ICI mesh axis — transport=tpu_ici, BASELINE.json:5).
+
+RMW conflicts (YCSB-F, BASELINE.json:8) are detected purely through the
+ACK ``ok`` flag: every replica acks every INV, with ok=False iff the INV's
+ts is no longer the key's maximum after this round's applies.  A pending
+RMW aborts on any nack; plain writes ignore nacks and commit by ts order.
+The coordinator receives its own ACK too (the broadcast includes self), so
+local supersession needs no separate detection pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import state as st
+from hermes_tpu.core import types as t
+
+PTS_FC_BITS = 10  # fc = (flag << 8) | cid fits 10 bits (flag 2b, cid 8b)
+FC_MASK = (1 << PTS_FC_BITS) - 1
+I32_MIN = jnp.iinfo(jnp.int32).min
+
+
+def pack_pts(ver, fc):
+    return (ver << PTS_FC_BITS) | fc
+
+
+def pts_ver(pts):
+    return pts >> PTS_FC_BITS
+
+
+def pts_fc(pts):
+    return pts & FC_MASK
+
+
+def pack_sst(step, state):
+    return (step << 3) | state
+
+
+def sst_state(sst):
+    return sst & 7
+
+
+def sst_step(sst):
+    return sst >> 3
+
+
+# --------------------------------------------------------------------------
+# State containers (leading axis = replicas-on-this-shard: R batched, 1 sharded)
+# --------------------------------------------------------------------------
+
+
+class FastTable(NamedTuple):
+    """Key-state table as three HBM-resident columns (BASELINE.json:5):
+    ``pts`` the packed Lamport ts, ``sst`` the packed (age_step, state),
+    ``val`` the value words.  Columns stay separate 1-D-per-replica arrays —
+    interleaving them measured slower on TPU (strided scatter indices plus
+    relayout copies beat the saved gather)."""
+
+    pts: jnp.ndarray  # (R, K)
+    sst: jnp.ndarray  # (R, K)
+    val: jnp.ndarray  # (R, K, V)
+
+
+class FastSess(NamedTuple):
+    """Client sessions (reference worker.c session arrays, SURVEY.md §1 L5)."""
+
+    status: jnp.ndarray  # (R, S)
+    op: jnp.ndarray
+    op_idx: jnp.ndarray
+    key: jnp.ndarray
+    val: jnp.ndarray  # (R, S, V)
+    pts: jnp.ndarray  # packed pending-update ts
+    acks: jnp.ndarray  # gathered-ack replica bitmap
+    rd_val: jnp.ndarray  # (R, S, V)
+    invoke_step: jnp.ndarray
+
+
+class FastReplay(NamedTuple):
+    """Replay slots (SURVEY.md §3.4): snapshot of a stuck key's last INV."""
+
+    active: jnp.ndarray  # (R, RS) bool
+    key: jnp.ndarray
+    pts: jnp.ndarray
+    val: jnp.ndarray  # (R, RS, V)
+    acks: jnp.ndarray
+
+
+class FastInv(NamedTuple):
+    """Compacted INV block.  Outbound (R, C, ...); inbound (R, Rsrc, C, ...).
+    ``epoch``/``alive`` are per-block scalars (a replica's whole batch shares
+    one epoch — SURVEY.md §1 L4)."""
+
+    valid: jnp.ndarray
+    key: jnp.ndarray
+    pts: jnp.ndarray
+    val: jnp.ndarray  # (..., C, V)
+    epoch: jnp.ndarray  # (R,) / (R, Rsrc)
+    alive: jnp.ndarray
+
+
+class FastAck(NamedTuple):
+    """ACK block, slot-aligned with the acked INV block.  ``pkf`` packs
+    (key << 2) | (ok << 1) | valid into one word — the echoed key plus the
+    conflict flag (ok=False: the INV lost to a higher ts — the RMW nack);
+    ``pts`` echoes the acked timestamp.  The echo guarantees a delayed or
+    stale ack can never mis-credit a different pending update."""
+
+    pkf: jnp.ndarray  # (R, Rdst, C) outbound / (R, Rsrc, C) inbound
+    pts: jnp.ndarray
+    epoch: jnp.ndarray  # (R,) / (R, Rsrc)
+
+
+class FastVal(NamedTuple):
+    valid: jnp.ndarray  # (R, C) / (R, Rsrc, C)
+    key: jnp.ndarray
+    pts: jnp.ndarray
+    epoch: jnp.ndarray
+
+
+class FastState(NamedTuple):
+    table: FastTable
+    sess: FastSess
+    replay: FastReplay
+    meta: st.Meta  # reuse the observability container (leading R axis)
+
+
+def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
+    """Fresh replicated state: all keys Valid at version 0 with the
+    recognizable initial value (lo=key, hi=-1) (state.init_table)."""
+    r = cfg.n_replicas if n_local is None else n_local
+    k, s, rs, v = cfg.n_keys, cfg.n_sessions, cfg.replay_slots, cfg.value_words
+    val = jnp.zeros((r, k, v), jnp.int32)
+    val = val.at[:, :, 0].set(jnp.arange(k, dtype=jnp.int32)[None])
+    val = val.at[:, :, 1].set(-1)
+    z = lambda *sh: jnp.zeros(sh, jnp.int32)
+    meta = st.Meta(
+        last_seen=z(r, cfg.n_replicas),
+        n_read=z(r),
+        n_write=z(r),
+        n_rmw=z(r),
+        n_abort=z(r),
+        lat_sum=z(r),
+        lat_cnt=z(r),
+        lat_hist=z(r, st.LAT_BINS),
+    )
+    return FastState(
+        table=FastTable(pts=z(r, k), sst=z(r, k), val=val),
+        sess=FastSess(
+            status=z(r, s), op=z(r, s), op_idx=z(r, s), key=z(r, s),
+            val=z(r, s, v), pts=z(r, s), acks=z(r, s),
+            rd_val=z(r, s, v), invoke_step=z(r, s),
+        ),
+        replay=FastReplay(
+            active=jnp.zeros((r, rs), jnp.bool_), key=z(r, rs), pts=z(r, rs),
+            val=z(r, rs, v), acks=z(r, rs),
+        ),
+        meta=meta,
+    )
+
+
+# --------------------------------------------------------------------------
+# Flat-index gather/scatter helpers (leading replica axis folded in)
+# --------------------------------------------------------------------------
+
+
+def _ridx(key):
+    """(R, 1) replica-index column for 2-D table indexing.  Gathers and
+    scatters index the tables in their NATIVE (R, K[, V]) shapes — flattening
+    to (R*K,) first forces XLA to materialize a relayout copy of the whole
+    table every round (measured: ~256 MB/round on the bench config)."""
+    return jnp.arange(key.shape[0], dtype=jnp.int32)[:, None]
+
+
+def _fgather(col, key):
+    """Gather col (R, K) at per-replica keys (R, X) -> (R, X)."""
+    return col[_ridx(key), key]
+
+
+def _fgather_rows(col, key):
+    """Gather rows of col (R, K, V) at keys (R, X) -> (R, X, V)."""
+    return col[_ridx(key), key]
+
+
+def _drop_key(col, key, mask):
+    """Masked rows get an out-of-bounds key; mode='drop' discards them."""
+    return jnp.where(mask, key, col.shape[1])
+
+
+def _fscatter(col, key, val, mask):
+    """Masked set-scatter into col (R, K[, V]): rows with mask False are
+    dropped (value rows broadcast over the trailing V axis)."""
+    return col.at[_ridx(key), _drop_key(col, key, mask)].set(val, mode="drop")
+
+
+_fscatter_rows = _fscatter
+
+
+def _fscatter_max(col, key, val, mask):
+    """Masked max-scatter — the Lamport conflict resolution (max timestamp
+    wins) as one atomic op on the packed-ts column."""
+    return col.at[_ridx(key), _drop_key(col, key, mask)].max(val, mode="drop")
+
+
+# --------------------------------------------------------------------------
+# The round
+# --------------------------------------------------------------------------
+
+
+class FastCtl(NamedTuple):
+    """Per-round control: unbatched step scalar (drives the cond-gated
+    replay scan) + per-replica membership/failure rows (SURVEY.md §5.3)."""
+
+    step: jnp.ndarray  # () int32 — NOT batched
+    my_cid: jnp.ndarray  # (R,)
+    epoch: jnp.ndarray  # (R,)
+    live_mask: jnp.ndarray  # (R,)
+    frozen: jnp.ndarray  # (R,) bool
+
+
+def _write_value(cfg: HermesConfig, my_cid, op_idx):
+    """Unique write values (checker witness): words 0/1 = (lo, hi) uid,
+    identical formula to phases._write_value."""
+    r, s = op_idx.shape
+    sess_idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    lo = op_idx * cfg.n_sessions + sess_idx
+    hi = jnp.broadcast_to(my_cid[:, None], lo.shape)
+    words = [lo, hi]
+    for j in range(2, cfg.value_words):
+        words.append(lo * jnp.int32(-1640531527) + jnp.int32(j))
+    return jnp.stack(words, axis=-1).astype(jnp.int32)
+
+
+def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
+    """Intake + local reads + update issue (reference worker-loop front half,
+    SURVEY.md §3.1) + the replay scan (cond-gated) + outbound INV build."""
+    R, S = fs.sess.status.shape
+    K, G, RS = cfg.n_keys, cfg.ops_per_session, cfg.replay_slots
+    V = cfg.value_words
+    table, sess, replay = fs.table, fs.sess, fs.replay
+    frozen = ctl.frozen[:, None]
+    step = ctl.step
+
+    # --- intake -----------------------------------------------------------
+    if cfg.wrap_stream:
+        can_load = (sess.status == t.S_IDLE) & ~frozen
+        g = sess.op_idx % G
+    else:
+        can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~frozen
+        g = jnp.clip(sess.op_idx, 0, G - 1)
+    new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
+    new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
+    new_val = _write_value(cfg, ctl.my_cid, sess.op_idx)
+    is_nop = can_load & (new_op == t.OP_NOP)
+    status = jnp.where(
+        can_load,
+        jnp.where(new_op == t.OP_READ, t.S_READ,
+                  jnp.where(new_op == t.OP_NOP, t.S_IDLE, t.S_ISSUE)),
+        sess.status,
+    )
+    if not cfg.wrap_stream:
+        status = jnp.where((status == t.S_IDLE) & (sess.op_idx >= G), t.S_DONE, status)
+    sess = sess._replace(
+        status=status,
+        op=jnp.where(can_load, new_op, sess.op),
+        key=jnp.where(can_load, new_key, sess.key),
+        val=jnp.where(can_load[..., None], new_val, sess.val),
+        invoke_step=jnp.where(can_load, step, sess.invoke_step),
+        op_idx=jnp.where(is_nop, sess.op_idx + 1, sess.op_idx),
+    )
+
+    # --- reads + issue -----------------------------------------------------
+    k_pts = _fgather(table.pts, sess.key)
+    k_sst = _fgather(table.sst, sess.key)
+    k_valid = sst_state(k_sst) == t.VALID
+
+    read_done = (sess.status == t.S_READ) & k_valid & ~frozen
+    rd_val = _fgather_rows(table.val, sess.key)
+    sess = sess._replace(
+        status=jnp.where(read_done, t.S_IDLE, sess.status),
+        op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
+        rd_val=jnp.where(read_done[..., None], rd_val, sess.rd_val),
+    )
+
+    # Same-key same-replica issue arbitration via a small hash-slot race:
+    # colliding sessions (same slot) defer to the lowest index; a false
+    # collision (different keys, same slot) only delays an issue one round.
+    want = (sess.status == t.S_ISSUE) & k_valid & ~frozen
+    HS = cfg.arb_slots
+    h = sess.key & (HS - 1)
+    idxs = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (R, S))
+    arb = jnp.full((R, HS), jnp.iinfo(jnp.int32).max, jnp.int32)
+    arb = arb.at[_ridx(h), jnp.where(want, h, HS)].min(idxs, mode="drop")
+    win = want & (arb[_ridx(h), h] == idxs)
+
+    flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
+    fc = (flag << 8) | ctl.my_cid[:, None]
+    new_pts = pack_pts(pts_ver(k_pts) + 1, fc)
+    old_val = rd_val  # RMW read-part observes the pre-issue value
+
+    # Local apply, minimal form: only the packed ts advances here (so a
+    # same-key issue next round proposes a strictly higher version even if
+    # this lane's broadcast is budget-deferred); state+value land via the
+    # self-INV in _apply_inv (the broadcast includes self), which treats any
+    # current-max INV as (re)writable — idempotent for re-broadcasts.
+    table = table._replace(
+        pts=_fscatter_max(table.pts, sess.key, new_pts, win),
+    )
+    is_rmw_issue = win & (sess.op == t.OP_RMW)
+    sess = sess._replace(
+        status=jnp.where(win, t.S_INFL, sess.status),
+        pts=jnp.where(win, new_pts, sess.pts),
+        acks=jnp.where(win, 0, sess.acks),
+        rd_val=jnp.where(is_rmw_issue[..., None], old_val, sess.rd_val),
+    )
+
+    # --- replay scan, cond-gated (SURVEY.md §3.4; only matters after
+    # failures, so it runs every replay_scan_every rounds) ------------------
+    def do_scan(args):
+        table, replay = args
+        age = step - sst_step(table.sst)
+        state = sst_state(table.sst)
+        stuck = ((state == t.INVALID) | (state == t.TRANS)) & (age > cfg.replay_age)
+        kiota = jnp.arange(K, dtype=jnp.int32)[None, :]
+        score = jnp.where(stuck & ~frozen[:, :1], -kiota, I32_MIN)
+        top, _ = jax.lax.top_k(score, RS)
+        cand = -top  # (R, RS); invalid entries have score I32_MIN -> huge cand
+        cand_ok = top != I32_MIN
+        cand = jnp.where(cand_ok, cand, 0)
+        # i-th candidate -> i-th free slot (sorted free-slot order)
+        free_rank = jnp.cumsum((~replay.active).astype(jnp.int32), axis=1) - 1
+        # for each slot: which candidate it takes = rank among free slots
+        take = jnp.where(~replay.active, free_rank, RS)
+        take_ok = (take < RS) & jnp.take_along_axis(
+            jnp.pad(cand_ok, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1
+        )
+        ck = jnp.take_along_axis(jnp.pad(cand, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1)
+        c_pts = _fgather(table.pts, ck)
+        new_replay = FastReplay(
+            active=jnp.where(take_ok, True, replay.active),
+            key=jnp.where(take_ok, ck, replay.key),
+            pts=jnp.where(take_ok, c_pts, replay.pts),
+            val=jnp.where(take_ok[..., None], _fgather_rows(table.val, ck), replay.val),
+            acks=jnp.where(take_ok, 0, replay.acks),
+        )
+        new_sst = _fscatter(
+            table.sst, ck,
+            pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32)), take_ok,
+        )
+        return table._replace(sst=new_sst), new_replay
+
+    table, replay = jax.lax.cond(
+        step % cfg.replay_scan_every == 0,
+        do_scan,
+        lambda args: args,
+        (table, replay),
+    )
+
+    # --- outbound INV compaction (SURVEY.md §7 hard part 2) ---------------
+    # Lanes: sessions 0..S-1, replay slots S..L-1.  Eligible lanes: fresh
+    # issues always; waiting lanes every rebroadcast_every rounds; replay
+    # slots always.  Priority rotates with the step so no lane starves.
+    L, C = cfg.n_lanes, cfg.lane_budget
+    infl = sess.status == t.S_INFL
+    fresh = win
+    waiting = infl & ~fresh
+    backoff_ok = (step - sess.invoke_step) % cfg.rebroadcast_every == 0
+    sess_elig = (fresh | (waiting & backoff_ok)) & ~frozen
+    lane_elig = jnp.concatenate([sess_elig, replay.active & ~frozen], axis=1)
+    lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
+    rot = (lane_idx + step * 127) % L  # rotating tie-break
+    prio = jnp.where(lane_elig, rot, L + rot)
+    _, perm = jax.lax.sort((prio, lane_idx), dimension=1, num_keys=1, is_stable=True)
+    slot_lane = perm[:, :C]  # (R, C) lane id occupying each slot
+
+    pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
+    pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
+    pend_val = jnp.concatenate([sess.val, replay.val], axis=1)
+    taken = jnp.take_along_axis(lane_elig, slot_lane, axis=1)
+    out_inv = FastInv(
+        valid=taken,
+        key=jnp.take_along_axis(pend_key, slot_lane, axis=1),
+        pts=jnp.take_along_axis(pend_pts, slot_lane, axis=1),
+        val=jnp.take_along_axis(
+            pend_val, slot_lane[..., None], axis=1
+        ),
+        epoch=ctl.epoch,
+        alive=~ctl.frozen,
+    )
+
+    fs = fs._replace(table=table, sess=sess, replay=replay)
+    return fs, out_inv, slot_lane, read_done
+
+
+def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
+    """Follower-side ``apply_inv()`` (BASELINE.json:5) over the inbound
+    (R, Rsrc, C) block: per-key winner + stale-drop + idempotent re-apply all
+    via one scatter-max on the packed ts; ALWAYS ack with the ok conflict
+    flag.  The coordinator's own block is included (self-ack)."""
+    table = fs.table
+    R, Rs, C = in_inv.valid.shape
+    step = ctl.step
+
+    ok = in_inv.valid & (in_inv.epoch == ctl.epoch[:, None])[..., None] & ~ctl.frozen[:, None, None]
+    key = in_inv.key.reshape(R, Rs * C)
+    pts = in_inv.pts.reshape(R, Rs * C)
+    okf = ok.reshape(R, Rs * C)
+
+    pre_pts = _fgather(table.pts, key)
+    pre_sst = _fgather(table.sst, key)
+    pts_col = _fscatter_max(table.pts, key, pts, okf)
+    post_pts = _fgather(pts_col, key)
+
+    # An INV holding the key's (new) maximum ts (re)writes state+value:
+    # strictly-newer INVs invalidate; the coordinator's own INV (state+value
+    # deferred at issue, see _coordinate) moves its key to Write; a same-ts
+    # re-broadcast re-applies identical content (same ts => same write =>
+    # same value) and keeps the key's current state — all idempotent
+    # (SURVEY.md §3.4).
+    winner = okf & (pts == post_pts)
+    fresh_win = winner & (pts > pre_pts)
+    had_pending = (sst_state(pre_sst) == t.WRITE) | (sst_state(pre_sst) == t.TRANS)
+    src_self = (
+        ctl.my_cid[:, None] == jnp.arange(Rs, dtype=jnp.int32)[None, :]
+    )[..., None]  # (R, Rs, 1): the block axis-1 order is replica id
+    is_self = jnp.broadcast_to(src_self, (R, Rs, C)).reshape(R, Rs * C)
+    new_state = jnp.where(
+        fresh_win,
+        jnp.where(had_pending, t.TRANS, t.INVALID),
+        jnp.where(is_self, t.WRITE, sst_state(pre_sst)),
+    ).astype(jnp.int32)
+    table = table._replace(
+        pts=pts_col,
+        sst=_fscatter(table.sst, key, pack_sst(step, new_state), winner),
+        val=_fscatter_rows(table.val, key, in_inv.val.reshape(R, Rs * C, -1), winner),
+    )
+
+    ack_ok = pts == post_pts
+    pkf = ((in_inv.key << 2) | (ack_ok.reshape(R, Rs, C).astype(jnp.int32) << 1)
+           | ok.astype(jnp.int32))
+    out_ack = FastAck(pkf=pkf, pts=in_inv.pts, epoch=ctl.epoch)
+
+    meta = fs.meta._replace(
+        last_seen=jnp.where(in_inv.alive & ~ctl.frozen[:, None], step, fs.meta.last_seen)
+    )
+    return fs._replace(table=table, meta=meta), out_ack
+
+
+def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
+                  in_ack: FastAck, slot_lane, read_done):
+    """Coordinator-side ``poll_acks()`` + commit + VAL build
+    (BASELINE.json:5).  Inbound acks are slot-aligned; the slot->lane map of
+    THIS round's compaction plus the (key, pts) echo route them to pending
+    lanes.  Commit = ack bitmap covers live_mask (the linearization point,
+    SURVEY.md §3.1); RMW aborts on any nack (ok=False)."""
+    table, sess, replay, meta = fs.table, fs.sess, fs.replay, fs.meta
+    R, Rs, C = in_ack.pkf.shape
+    S, RS, L = cfg.n_sessions, cfg.replay_slots, cfg.n_lanes
+    step = ctl.step
+    frozen = ctl.frozen[:, None]
+
+    # lane -> slot map (L,): inverse of slot_lane, C where lane has no slot
+    lane_slot = jnp.full((R, L), C, jnp.int32).at[_ridx(slot_lane), slot_lane].set(
+        jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None], (R, C))
+    )
+
+    pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
+    pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
+
+    # Expand slot-aligned acks to lanes: in_ack[r, q, lane_slot[r, l]]
+    sl = jnp.minimum(lane_slot, C - 1)[:, None, :]  # (R, 1, L)
+    has_slot = (lane_slot < C)[:, None, :]
+    apkf = jnp.take_along_axis(in_ack.pkf, sl, axis=2)
+    apts = jnp.take_along_axis(in_ack.pts, sl, axis=2)
+    epoch_ok = (in_ack.epoch == ctl.epoch[:, None])[..., None]
+    matched = (
+        has_slot & ((apkf & 1) == 1) & epoch_ok & ~frozen[..., None]
+        & ((apkf >> 2) == pend_key[:, None, :]) & (apts == pend_pts[:, None, :])
+    )  # (R, Rsrc, L)
+    aok = (apkf & 2) == 2
+
+    bit = jnp.int32(1) << jnp.arange(Rs, dtype=jnp.int32)[None, :, None]
+    gained = jnp.sum(jnp.where(matched, bit, 0), axis=1).astype(jnp.int32)  # (R, L)
+    nacked = jnp.any(matched & ~aok, axis=1)  # (R, L)
+
+    full = jnp.int32((1 << Rs) - 1)
+    live = ctl.live_mask[:, None]
+
+    infl = sess.status == t.S_INFL
+    sacks = jnp.where(infl, sess.acks | gained[:, :S], sess.acks)
+    covered = ((sacks | ~live) & full) == full
+    abort = infl & nacked[:, :S] & (sess.op == t.OP_RMW) & ~frozen
+    commit = infl & covered & ~frozen & ~abort
+
+    # One ownership gather + one Valid scatter cover sessions AND replay
+    # lanes (concatenated pending arrays).
+    pend_owns = pend_pts == _fgather(table.pts, pend_key)
+    owns, rowns = pend_owns[:, :S], pend_owns[:, S:]
+
+    racks = jnp.where(replay.active, replay.acks | gained[:, S:], replay.acks)
+    rcovered = ((racks | ~live) & full) == full
+    rcommit = replay.active & rcovered & ~frozen
+    rsuper = replay.active & ~rowns & ~frozen
+    commit_lane_owned = jnp.concatenate([commit & owns, rcommit & rowns], axis=1)
+    table = table._replace(
+        sst=_fscatter(
+            table.sst, pend_key,
+            pack_sst(step, jnp.full((R, L), t.VALID, jnp.int32)),
+            commit_lane_owned,
+        )
+    )
+    replay = replay._replace(acks=racks, active=replay.active & ~rcommit & ~rsuper)
+
+    # --- outbound VALs: compact commit lanes to the same budget C ---------
+    commit_lane = commit_lane_owned
+    lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
+    prio = jnp.where(commit_lane, lane_idx, L + lane_idx)
+    _, vperm = jax.lax.sort((prio, lane_idx), dimension=1, num_keys=1, is_stable=True)
+    vslot = vperm[:, :C]
+    out_val = FastVal(
+        valid=jnp.take_along_axis(commit_lane, vslot, axis=1),
+        key=jnp.take_along_axis(pend_key, vslot, axis=1),
+        pts=jnp.take_along_axis(pend_pts, vslot, axis=1),
+        epoch=ctl.epoch,
+    )
+
+    # --- session completion + stats ---------------------------------------
+    is_rmw = sess.op == t.OP_RMW
+    code = jnp.where(
+        abort, t.C_RMW_ABORT,
+        jnp.where(commit, jnp.where(is_rmw, t.C_RMW, t.C_WRITE),
+                  jnp.where(read_done, t.C_READ, t.C_NONE)),
+    )
+    comp = st.Completions(
+        code=code.astype(jnp.int32),
+        key=sess.key,
+        wval=sess.val,
+        rval=sess.rd_val,
+        ver=pts_ver(sess.pts),
+        fc=pts_fc(sess.pts),
+        invoke_step=sess.invoke_step,
+        commit_step=jnp.broadcast_to(step, (R, S)).astype(jnp.int32),
+    )
+    lat = jnp.where(commit, step - sess.invoke_step, 0)
+    nbin = st.LAT_BINS
+    bins = jnp.arange(nbin, dtype=jnp.int32)[None, None, :]
+    hist_add = jnp.sum(
+        (jnp.clip(lat, 0, nbin - 1)[..., None] == bins) & commit[..., None],
+        axis=1, dtype=jnp.int32,
+    )
+    meta = meta._replace(
+        n_read=meta.n_read + jnp.sum(read_done, axis=1, dtype=jnp.int32),
+        n_write=meta.n_write + jnp.sum(commit & ~is_rmw, axis=1, dtype=jnp.int32),
+        n_rmw=meta.n_rmw + jnp.sum(commit & is_rmw, axis=1, dtype=jnp.int32),
+        n_abort=meta.n_abort + jnp.sum(abort, axis=1, dtype=jnp.int32),
+        lat_sum=meta.lat_sum + jnp.sum(lat, axis=1, dtype=jnp.int32),
+        lat_cnt=meta.lat_cnt + jnp.sum(commit, axis=1, dtype=jnp.int32),
+        lat_hist=meta.lat_hist + hist_add,
+    )
+
+    done = commit | abort
+    sess = sess._replace(
+        acks=sacks,
+        status=jnp.where(done, t.S_IDLE, sess.status),
+        op_idx=jnp.where(done, sess.op_idx + 1, sess.op_idx),
+    )
+    return fs._replace(table=table, sess=sess, replay=replay, meta=meta), out_val, comp
+
+
+def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_val: FastVal):
+    """VAL apply (SURVEY.md §3.1 tail): ts-matching keys go Valid."""
+    table = fs.table
+    R, Rs, C = in_val.valid.shape
+    key = in_val.key.reshape(R, Rs * C)
+    pts = in_val.pts.reshape(R, Rs * C)
+    ok = (
+        in_val.valid
+        & (in_val.epoch == ctl.epoch[:, None])[..., None]
+        & ~ctl.frozen[:, None, None]
+    ).reshape(R, Rs * C)
+    ok = ok & (pts == _fgather(table.pts, key))
+    sst = _fscatter(
+        table.sst, key,
+        pack_sst(ctl.step, jnp.full(key.shape, t.VALID, jnp.int32)), ok,
+    )
+    return fs._replace(table=table._replace(sst=sst))
+
+
+def fast_round(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream,
+               exchange_inv, exchange_ack, exchange_val):
+    """One full protocol round, parameterized over the exchange primitives
+    (array ops in batched mode, ICI collectives under shard_map)."""
+    fs, out_inv, slot_lane, read_done = _coordinate(cfg, ctl, fs, stream)
+    in_inv = exchange_inv(out_inv)
+    fs, out_ack = _apply_inv(cfg, ctl, fs, in_inv)
+    in_ack = exchange_ack(out_ack)
+    fs, out_val, comp = _collect_acks(cfg, ctl, fs, in_ack, slot_lane, read_done)
+    in_val = exchange_val(out_val)
+    fs = _apply_val(cfg, ctl, fs, in_val)
+    return fs, comp
+
+
+# --------------------------------------------------------------------------
+# Batched (single-device) exchanges and step builders
+# --------------------------------------------------------------------------
+
+
+from hermes_tpu.core.step import lockstep_bcast as _bcast  # noqa: E402  (shared lockstep broadcast)
+
+
+def _route_back(block):
+    """ACK route-back: out[p][q, ...] -> in[q][p, ...].  Per-block scalars
+    (epoch, (R,)) broadcast: every destination sees each sender's value."""
+    r = jax.tree_util.tree_leaves(block)[0].shape[0]
+
+    def one(x):
+        if x.ndim == 1:
+            return jnp.broadcast_to(x[None, :], (r, r))
+        return jnp.swapaxes(x, 0, 1)
+
+    return jax.tree.map(one, block)
+
+
+def make_fast_ctl(cfg: HermesConfig, step: int) -> FastCtl:
+    r = cfg.n_replicas
+    return FastCtl(
+        step=jnp.int32(step),
+        my_cid=jnp.arange(r, dtype=jnp.int32),
+        epoch=jnp.zeros((r,), jnp.int32),
+        live_mask=jnp.full((r,), cfg.full_mask, jnp.int32),
+        frozen=jnp.zeros((r,), jnp.bool_),
+    )
+
+
+def build_fast_batched(cfg: HermesConfig, donate: bool = False):
+    def step(fs, stream, ctl):
+        return fast_round(cfg, ctl, fs, stream, _bcast, _route_back, _bcast)
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def build_fast_scan(cfg: HermesConfig, rounds: int, donate: bool = True):
+    """``rounds`` rounds per dispatch (amortizes the host round trip,
+    SURVEY.md §7 M6).  Completions feed only the meta counters."""
+
+    def chunk(fs, stream, ctl):
+        def body(carry, off):
+            nxt, _comp = fast_round(
+                cfg, ctl._replace(step=ctl.step + off), carry, stream,
+                _bcast, _route_back, _bcast,
+            )
+            return nxt, None
+
+        fs, _ = jax.lax.scan(body, fs, jnp.arange(rounds, dtype=jnp.int32))
+        return fs
+
+    return jax.jit(chunk, donate_argnums=(0,) if donate else ())
+
+
+# --------------------------------------------------------------------------
+# Sharded (one replica per device) step: transport=tpu_ici (BASELINE.json:5)
+# --------------------------------------------------------------------------
+
+
+def _ici_bcast(block):
+    return jax.tree.map(
+        lambda x: jnp.swapaxes(
+            jax.lax.all_gather(x, "replica", axis=0, tiled=False), 0, 1
+        ),
+        block,
+    )
+
+
+def _ici_route_back(block):
+    # out[p][0, q, ...] answers q's INVs; all_to_all on axis 1 delivers
+    # in[q][0, p, ...] = p's acks of q's slots.  1-D per-block scalars
+    # (epoch, local shape (1,)) ride an all_gather instead.
+    def one(x):
+        if x.ndim == 1:
+            return jnp.swapaxes(
+                jax.lax.all_gather(x, "replica", axis=0, tiled=False), 0, 1
+            )
+        return jax.lax.all_to_all(x, "replica", split_axis=1, concat_axis=1, tiled=True)
+
+    return jax.tree.map(one, block)
+
+
+def build_fast_sharded(cfg: HermesConfig, mesh: Mesh, rounds: int = 1,
+                       donate: bool = True):
+    """The fast round under shard_map over Mesh(('replica',)): INV/VAL ride
+    all_gather, the ACK route-back all_to_all, over the 'replica' ICI axis."""
+    if mesh.shape["replica"] != cfg.n_replicas:
+        raise ValueError("mesh 'replica' axis must equal cfg.n_replicas")
+
+    def shard_body(fs, stream, ctl):
+        my = jax.lax.axis_index("replica").astype(jnp.int32)
+        lctl = FastCtl(
+            step=ctl.step,
+            my_cid=my[None],
+            epoch=ctl.epoch,
+            live_mask=ctl.live_mask,
+            frozen=ctl.frozen,
+        )
+
+        def body(carry, off):
+            nxt, _comp = fast_round(
+                cfg, lctl._replace(step=lctl.step + off), carry, stream,
+                _ici_bcast, _ici_route_back, _ici_bcast,
+            )
+            return nxt, None
+
+        fs, _ = jax.lax.scan(body, fs, jnp.arange(rounds, dtype=jnp.int32))
+        return fs
+
+    rspec = P("replica")
+    ctl_spec = FastCtl(step=P(), my_cid=P(), epoch=rspec, live_mask=rspec, frozen=rspec)
+    sharded = jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(rspec, rspec, ctl_spec),
+        out_specs=rspec,
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def place_fast_sharded(cfg: HermesConfig, mesh: Mesh, fs: FastState, stream):
+    sh = NamedSharding(mesh, P("replica"))
+    return jax.device_put(fs, sh), jax.device_put(stream, sh)

@@ -227,3 +227,156 @@ class Runtime:
 
 def _to_jnp(block):
     return jax.tree.map(jnp.asarray, block)
+
+
+class FastRuntime:
+    """Run driver for the TPU-optimized round (core/faststep.py): same
+    membership / failure-injection / history-recording surface as Runtime,
+    over the packed-column FastState.  Backends: ``batched`` (R replicas on
+    one device) and ``sharded`` (one replica per mesh device — the
+    transport=tpu_ici layout, BASELINE.json:5)."""
+
+    def __init__(self, cfg: HermesConfig, backend: str = "batched", mesh=None,
+                 record: bool = False, stream: Optional[st.OpStream] = None):
+        from hermes_tpu.core import faststep as fst
+
+        self.cfg = cfg
+        self.backend = backend
+        r = cfg.n_replicas
+        self.fs = fst.init_fast_state(cfg)
+        raw = stream if stream is not None else ycsb.make_streams(cfg)
+        self.stream = jax.tree.map(jnp.asarray, raw)
+
+        self.step_idx = 0
+        self.epoch = np.zeros((r,), np.int32)
+        self.live = np.full((r,), cfg.full_mask, np.int32)
+        self.frozen = np.zeros((r,), bool)
+        self.recorder = HistoryRecorder(cfg) if record else None
+        self.membership = None
+
+        if backend == "batched":
+            self._step = fst.build_fast_batched(cfg)
+        elif backend == "sharded":
+            if mesh is None:
+                raise ValueError("sharded backend needs a mesh")
+            self._step = fst.build_fast_sharded(cfg, mesh, rounds=1, donate=False)
+            self.fs, self.stream = fst.place_fast_sharded(cfg, mesh, self.fs, self.stream)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        self._fst = fst
+
+    def _ctl(self):
+        fst = self._fst
+        r = self.cfg.n_replicas
+        return fst.FastCtl(
+            step=jnp.int32(self.step_idx),
+            my_cid=jnp.arange(r, dtype=jnp.int32),
+            epoch=jnp.asarray(self.epoch),
+            live_mask=jnp.asarray(self.live),
+            frozen=jnp.asarray(self.frozen),
+        )
+
+    # -- membership / failure injection (same surface as Runtime) ----------
+
+    def freeze(self, replica: int) -> None:
+        self.frozen[replica] = True
+
+    def thaw(self, replica: int) -> None:
+        self.frozen[replica] = False
+
+    def set_live(self, mask: int) -> None:
+        self.live[:] = mask
+        self.epoch += 1
+
+    def remove(self, replica: int) -> None:
+        self.frozen[replica] = True
+        self.set_live(int(self.live[0]) & ~(1 << replica))
+
+    def join(self, replica: int, from_replica: int) -> None:
+        """Reconfiguration join (config 5, BASELINE.json:11): copy a live
+        donor's table; the donor's own pending-coordination keys enter the
+        joiner as Invalid (validated by the live coordinator's VAL/replay)."""
+        fst = self._fst
+        tbl = self.fs.table
+        d_state = fst.sst_state(tbl.sst[from_replica])
+        j_state = jnp.where(
+            (d_state == t.WRITE) | (d_state == t.TRANS) | (d_state == t.REPLAY),
+            t.INVALID, d_state,
+        )
+        j_sst = fst.pack_sst(jnp.int32(self.step_idx), j_state)
+        self.fs = self.fs._replace(table=tbl._replace(
+            pts=tbl.pts.at[replica].set(tbl.pts[from_replica]),
+            sst=tbl.sst.at[replica].set(j_sst),
+            val=tbl.val.at[replica].set(tbl.val[from_replica]),
+        ))
+        self.frozen[replica] = False
+        self.set_live(int(self.live[0]) | (1 << replica))
+        if self.membership is not None:
+            self.membership.note_join(self, replica)
+
+    def attach_membership(self, service) -> None:
+        self.membership = service
+
+    # -- stepping ----------------------------------------------------------
+
+    def step_once(self) -> None:
+        if self.backend == "sharded":
+            self.fs = self._step(self.fs, self.stream, self._ctl())
+            comp = None
+        else:
+            self.fs, comp = self._step(self.fs, self.stream, self._ctl())
+        if self.recorder is not None:
+            assert comp is not None, "recording needs the batched backend"
+            self.recorder.record_step(jax.device_get(comp))
+        self.step_idx += 1
+        if self.membership is not None:
+            self.membership.poll(self)
+
+    def run(self, n_steps: int) -> None:
+        for _ in range(n_steps):
+            self.step_once()
+
+    def drain(self, max_steps: int = 10_000) -> bool:
+        for _ in range(max_steps):
+            status = np.asarray(jax.device_get(self.fs.sess.status))
+            live0 = int(self.live[0])
+            done = all(
+                (status[r] == t.S_DONE).all() or not (live0 >> r) & 1 or self.frozen[r]
+                for r in range(self.cfg.n_replicas)
+            )
+            if done:
+                return True
+            self.step_once()
+        return False
+
+    # -- observability -----------------------------------------------------
+
+    def counters(self) -> dict:
+        m = jax.device_get(self.fs.meta)
+        return dict(
+            n_read=np.asarray(m.n_read).sum(),
+            n_write=np.asarray(m.n_write).sum(),
+            n_rmw=np.asarray(m.n_rmw).sum(),
+            n_abort=np.asarray(m.n_abort).sum(),
+            lat_sum=np.asarray(m.lat_sum).sum(),
+            lat_cnt=np.asarray(m.lat_cnt).sum(),
+            lat_hist=np.asarray(m.lat_hist).sum(axis=0),
+        )
+
+    def history_ops(self):
+        assert self.recorder is not None, "construct FastRuntime(record=True)"
+        fst = self._fst
+        sess = jax.device_get(self.fs.sess)
+        adapter = type("SessView", (), dict(
+            status=sess.status, op=sess.op, key=sess.key, val=sess.val,
+            ver=np.asarray(fst.pts_ver(jnp.asarray(sess.pts))),
+            fc=np.asarray(fst.pts_fc(jnp.asarray(sess.pts))),
+            invoke_step=sess.invoke_step,
+        ))
+        return self.recorder.finalize(adapter)
+
+    def check(self, max_keys: Optional[int] = None) -> lin.Verdict:
+        ops = self.history_ops()
+        if max_keys is not None:
+            ops = lin.sample_keys(ops, max_keys=max_keys)
+        return lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)

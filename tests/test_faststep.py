@@ -1,0 +1,163 @@
+"""Correctness of the TPU-optimized round (core/faststep.py).
+
+faststep re-engineers the phases for the measured TPU cost model (packed
+timestamps + scatter-max conflict resolution, lane compaction with
+rebroadcast backoff, cond-gated replay scan) — these tests pin that it still
+IS the Hermes protocol: every run drains and passes the linearizability gate
+(BASELINE.json:2), failure/recovery works, and the batched and sharded
+(tpu_ici-shaped) executions agree.
+"""
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import faststep as fst
+from hermes_tpu.core import types as t
+from hermes_tpu.runtime import FastRuntime, Runtime
+
+from helpers import get
+
+
+def drained_checked(cfg, max_steps=400, **kw):
+    rt = FastRuntime(cfg, record=True, **kw)
+    assert rt.drain(max_steps)
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+    return rt
+
+
+def test_pts_packing_orders_like_lex():
+    ver = np.array([0, 1, 1, 2, 1])
+    fc = np.array([5, 1, 2, 0, 1023])
+    pts = [(int(v) << fst.PTS_FC_BITS) | int(f) for v, f in zip(ver, fc)]
+    lex = sorted(range(5), key=lambda i: (ver[i], fc[i]))
+    assert sorted(range(5), key=lambda i: pts[i]) == lex
+
+
+def test_ycsb_a_uniform_checked():
+    """Config-1-shaped (BASELINE.json:7): YCSB-A, uniform keys."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=512, n_sessions=16, replay_slots=8, ops_per_session=32,
+        workload=WorkloadConfig(read_frac=0.5, seed=31),
+    )
+    rt = drained_checked(cfg)
+    c = rt.counters()
+    assert c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"] == 3 * 16 * 32
+
+
+def test_ycsb_f_rmw_checked():
+    """Config-2-shaped (BASELINE.json:8): write-heavy RMW mix; the ok-flag
+    nack path must abort conflicting RMWs without breaking linearizability."""
+    cfg = HermesConfig(
+        n_replicas=5, n_keys=64, n_sessions=8, replay_slots=8, ops_per_session=24,
+        workload=WorkloadConfig(read_frac=0.3, rmw_frac=1.0, seed=32),
+    )
+    rt = drained_checked(cfg)
+    assert rt.counters()["n_rmw"] > 0
+
+
+def test_zipfian_contention_checked():
+    """Config-3-shaped (BASELINE.json:9): hot keys force the scatter-max
+    winner path (many same-key INVs per round)."""
+    cfg = HermesConfig(
+        n_replicas=7, n_keys=32, n_sessions=8, replay_slots=8, ops_per_session=16,
+        workload=WorkloadConfig(read_frac=0.5, distribution="zipfian",
+                                zipf_theta=0.99, seed=33),
+    )
+    drained_checked(cfg)
+
+
+def test_lane_budget_backpressure():
+    """A lane budget far below the in-flight count must only slow the run
+    (overflowing lanes wait; idempotent re-broadcast), never lose ops."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=256, n_sessions=16, replay_slots=4, ops_per_session=16,
+        lane_budget_cfg=4, rebroadcast_every=2,
+        workload=WorkloadConfig(read_frac=0.2, seed=34),
+    )
+    rt = drained_checked(cfg, max_steps=2000)
+    c = rt.counters()
+    assert c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"] == 3 * 16 * 16
+
+
+def test_frozen_replica_stall_and_recovery():
+    """Config-4-shaped (BASELINE.json:10): a replica stalls mid-run; after
+    the membership removes it, waiting writes commit against the shrunken
+    quorum and stuck Invalid keys recover via the (gated) replay scan."""
+    cfg = HermesConfig(
+        n_replicas=4, n_keys=128, n_sessions=8, replay_slots=16, ops_per_session=16,
+        replay_age=4, replay_scan_every=4,
+        workload=WorkloadConfig(read_frac=0.4, seed=35),
+    )
+    rt = FastRuntime(cfg, record=True)
+    rt.run(6)
+    rt.freeze(3)
+    rt.run(4)  # writes stall against the dead replica's missing acks
+    rt.remove(3)  # membership: epoch++, live mask shrinks
+    assert rt.drain(1500)
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+    # survivors finished their streams
+    status = get(rt.fs.sess.status)
+    for r in range(3):
+        assert (status[r] == t.S_DONE).all()
+
+
+def test_membership_join_mid_workload():
+    """Config-5-shaped (BASELINE.json:11): remove a replica, then re-join it
+    via state transfer mid-workload; run drains and checks."""
+    cfg = HermesConfig(
+        n_replicas=4, n_keys=128, n_sessions=6, replay_slots=8, ops_per_session=12,
+        replay_age=4, replay_scan_every=4,
+        workload=WorkloadConfig(read_frac=0.5, seed=36),
+    )
+    rt = FastRuntime(cfg, record=True)
+    rt.run(4)
+    rt.remove(2)
+    rt.run(6)
+    rt.join(2, from_replica=0)
+    assert rt.drain(1500)
+    assert rt.check().ok
+
+
+def test_sharded_matches_batched():
+    """The shard_map execution (all_gather/all_to_all over the 'replica'
+    axis — the tpu_ici transport shape, BASELINE.json:5) must produce the
+    same table state as the batched execution on the same stream."""
+    import jax
+    from jax.sharding import Mesh
+
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=128, n_sessions=4, replay_slots=4, ops_per_session=8,
+        workload=WorkloadConfig(read_frac=0.5, rmw_frac=0.3, seed=37),
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+    a = FastRuntime(cfg, backend="batched", record=True)
+    b = FastRuntime(cfg, backend="sharded", mesh=mesh)
+    assert a.drain(300)
+    assert b.drain(300)
+    np.testing.assert_array_equal(get(a.fs.table.pts), get(b.fs.table.pts))
+    np.testing.assert_array_equal(get(a.fs.table.val), get(b.fs.table.val))
+    ca, cb = a.counters(), b.counters()
+    for k in ("n_read", "n_write", "n_rmw", "n_abort"):
+        assert ca[k] == cb[k], k
+    assert a.check().ok
+
+
+def test_matches_reference_phases_commit_totals():
+    """faststep and the reference phases implementation must agree on the
+    workload outcome (op totals; both checker-clean) for the same stream."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=256, n_sessions=8, replay_slots=4, ops_per_session=16,
+        workload=WorkloadConfig(read_frac=0.5, rmw_frac=0.2, seed=38),
+    )
+    a = Runtime(cfg, backend="batched", record=True)
+    b = FastRuntime(cfg, backend="batched", record=True)
+    assert a.drain(300) and b.drain(300)
+    ca, cb = a.counters(), b.counters()
+    total_a = ca["n_read"] + ca["n_write"] + ca["n_rmw"] + ca["n_abort"]
+    total_b = cb["n_read"] + cb["n_write"] + cb["n_rmw"] + cb["n_abort"]
+    assert total_a == total_b == 3 * 8 * 16
+    assert ca["n_read"] == cb["n_read"]
+    assert a.check().ok and b.check().ok
