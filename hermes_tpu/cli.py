@@ -29,7 +29,25 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--replay-slots", type=int, default=64)
     ap.add_argument("--ops-per-session", type=int, default=256)
     ap.add_argument("--steps", type=int, default=0, help="0 = run until drained")
-    ap.add_argument("--backend", choices=["batched", "sharded", "sim"], default="batched")
+    ap.add_argument(
+        "--backend",
+        choices=["batched", "sharded", "sim", "fast", "fast-sharded"],
+        default="fast",
+        help="fast/fast-sharded = TPU-optimized round (core/faststep.py); "
+        "batched/sharded = reference phases; sim = host-mediated adversarial",
+    )
+    ap.add_argument("--lane-budget", type=int, default=None,
+                    help="faststep outbound-lane compaction budget")
+    ap.add_argument("--wrap-stream", action="store_true",
+                    help="cycle op streams forever (bench mode; use --steps)")
+    ap.add_argument("--acceptance", default=None,
+                    choices=["1", "2", "3", "4", "5", "all"],
+                    help="run BASELINE acceptance config N (1-5) or all; "
+                    "ignores most other flags")
+    ap.add_argument("--scale", type=float, default=0.01,
+                    help="acceptance size scale (1.0 = full 1M-key shape)")
+    ap.add_argument("--profile", type=str, default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the run into DIR")
     ap.add_argument(
         "--workload", choices=["a", "b", "c", "f"], default="a",
         help="YCSB mix: a=50/50, b=95/5, c=read-only, f=50/50 with RMW updates",
@@ -57,7 +75,21 @@ def main(argv=None) -> int:
 
     from hermes_tpu import stats as stats_lib
     from hermes_tpu.config import HermesConfig, WorkloadConfig
-    from hermes_tpu.runtime import Runtime
+    from hermes_tpu.runtime import FastRuntime, Runtime
+
+    if args.acceptance:
+        from hermes_tpu import acceptance
+
+        which = range(1, 6) if args.acceptance == "all" else [int(args.acceptance)]
+        rc = 0
+        for n in which:
+            counters, verdict = acceptance.run_config(
+                n, scale=args.scale, log=lambda s: print(s, file=sys.stderr)
+            )
+            ok = counters["drained"] and (verdict is None or verdict.ok)
+            print(f"config {n}: {'PASS' if ok else 'FAIL'} {counters}")
+            rc |= 0 if ok else 1
+        return rc
 
     cfg = HermesConfig(
         n_replicas=args.replicas,
@@ -66,6 +98,8 @@ def main(argv=None) -> int:
         n_sessions=args.sessions,
         replay_slots=args.replay_slots,
         ops_per_session=args.ops_per_session,
+        lane_budget_cfg=args.lane_budget,
+        wrap_stream=args.wrap_stream,
         workload=WorkloadConfig(
             distribution=args.distribution,
             zipf_theta=args.zipf_theta,
@@ -75,7 +109,7 @@ def main(argv=None) -> int:
     )
 
     mesh = None
-    if args.backend == "sharded":
+    if args.backend in ("sharded", "fast-sharded"):
         import jax
         from jax.sharding import Mesh
 
@@ -85,27 +119,43 @@ def main(argv=None) -> int:
             return 2
         mesh = Mesh(np.array(devs), ("replica",))
 
-    rt = Runtime(cfg, backend=args.backend, mesh=mesh, record=args.check)
+    if args.backend in ("fast", "fast-sharded"):
+        backend = "batched" if args.backend == "fast" else "sharded"
+        rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=args.check)
+    else:
+        rt = Runtime(cfg, backend=args.backend, mesh=mesh, record=args.check)
+
+    if args.profile:
+        import jax
+
+        jax.profiler.start_trace(args.profile)
     logger = None
     if args.metrics_jsonl:
         logger = stats_lib.JsonlLogger(open(args.metrics_jsonl, "w"))
 
+    meta_of = lambda: rt.fs.meta if hasattr(rt, "fs") else rt.rs.meta
     t0 = time.perf_counter()
-    if args.steps > 0:
-        for s in range(args.steps):
-            rt.step_once()
-            if args.report_every and (s + 1) % args.report_every == 0:
-                rec = stats_lib.summarize(rt.rs.meta, time.perf_counter() - t0, s + 1)
-                print(rec, file=sys.stderr)
-                if logger:
-                    logger.log(rec)
-    else:
-        ok = rt.drain()
-        if not ok:
-            print("WARNING: did not drain", file=sys.stderr)
+    try:
+        if args.steps > 0:
+            for s in range(args.steps):
+                rt.step_once()
+                if args.report_every and (s + 1) % args.report_every == 0:
+                    rec = stats_lib.summarize(meta_of(), time.perf_counter() - t0, s + 1)
+                    print(rec, file=sys.stderr)
+                    if logger:
+                        logger.log(rec)
+        else:
+            ok = rt.drain()
+            if not ok:
+                print("WARNING: did not drain", file=sys.stderr)
+    finally:
+        if args.profile:
+            import jax
+
+            jax.profiler.stop_trace()
     wall = time.perf_counter() - t0
 
-    rec = stats_lib.summarize(rt.rs.meta, wall, rt.step_idx)
+    rec = stats_lib.summarize(meta_of(), wall, rt.step_idx)
     print(rec)
     if logger:
         logger.log(rec)

@@ -300,6 +300,11 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
     new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
     new_val = _write_value(cfg, ctl.my_cid, sess.op_idx)
+    if stream.uval is not None:
+        # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry the
+        # user value; words 0-1 keep the derived unique write id.
+        uval = jnp.take_along_axis(stream.uval, g[..., None, None], axis=2)[:, :, 0]
+        new_val = jnp.concatenate([new_val[..., :2], uval], axis=-1)
     is_nop = can_load & (new_op == t.OP_NOP)
     status = jnp.where(
         can_load,
@@ -750,6 +755,11 @@ def build_fast_sharded(cfg: HermesConfig, mesh: Mesh, rounds: int = 1,
             live_mask=ctl.live_mask,
             frozen=ctl.frozen,
         )
+        if rounds == 1:
+            # single-round driver shape: completions come back (FastRuntime /
+            # kvs.py consume them for history recording + client futures)
+            return fast_round(cfg, lctl, fs, stream,
+                              _ici_bcast, _ici_route_back, _ici_bcast)
 
         def body(carry, off):
             nxt, _comp = fast_round(
@@ -766,7 +776,7 @@ def build_fast_sharded(cfg: HermesConfig, mesh: Mesh, rounds: int = 1,
     sharded = jax.shard_map(
         shard_body, mesh=mesh,
         in_specs=(rspec, rspec, ctl_spec),
-        out_specs=rspec,
+        out_specs=(rspec, rspec) if rounds == 1 else rspec,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

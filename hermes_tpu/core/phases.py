@@ -112,6 +112,10 @@ def coordinate(
     new_op = stream.op[idx, g]
     new_key = stream.key[idx, g]
     new_val = _write_value(cfg, ctl.my_cid, idx, sess.op_idx)
+    if stream.uval is not None:
+        # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry the
+        # user value; words 0-1 keep the derived unique write id.
+        new_val = jnp.concatenate([new_val[:, :2], stream.uval[idx, g]], axis=-1)
 
     is_nop = can_load & (new_op == t.OP_NOP)
     status = jnp.where(

@@ -15,7 +15,7 @@ sharded runs shard the same pytrees over the 'replica' mesh axis.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
@@ -209,12 +209,16 @@ LAT_BINS = 64
 
 
 class OpStream(NamedTuple):
-    """Pre-generated per-session op stream (SURVEY.md §1 L6): (S, G) arrays.
-    Write values are derived on device from (replica, session, op_idx), so the
-    stream only stores op codes and keys."""
+    """Per-session op stream (SURVEY.md §1 L6): (S, G) arrays.  Synthetic
+    workloads store only op codes and keys — write values are derived on
+    device from (replica, session, op_idx).  The client KVS API
+    (hermes_tpu/kvs.py) additionally supplies user payload words ``uval``
+    ((S, G, value_words-2); words 0-1 of every value remain the
+    device-derived unique write id the checker keys on)."""
 
     op: jnp.ndarray
     key: jnp.ndarray
+    uval: Optional[jnp.ndarray] = None
 
 
 def init_table(cfg: config_lib.HermesConfig) -> KeyTable:

@@ -1,0 +1,215 @@
+"""Client-facing KVS API (SURVEY.md §1 L5, §2 "KVS client API + sessions").
+
+The reference multiplexes client sessions onto worker threads, each session
+holding one in-flight get/put/RMW (worker.c session arrays).  The rebuild
+exposes the same session model over the bulk-synchronous runtime: callers
+enqueue operations on (replica, session) slots; every ``step()`` injects one
+op per idle session into the device-side op stream, runs one protocol round,
+and resolves the completions that came back.
+
+The north star keeps this API untouched (BASELINE.json:5: "the KVS API and
+linearizability guarantees are untouched") — gets are local (serve from the
+replica's own table, stall while the key is Invalid), puts/RMWs run the
+INV/ACK/VAL broadcast round and linearize at quorum.
+
+Values are ``value_words - 2`` int32 payload words: words 0-1 of every
+stored value carry the device-derived unique write id (the linearizability
+witness, checker/history.py), so checked runs work unchanged over client
+traffic.
+
+Usage::
+
+    kvs = KVS(HermesConfig(n_replicas=3, n_keys=1024, value_words=6))
+    f1 = kvs.put(replica=0, session=0, key=7, value=[1, 2, 3, 4])
+    f2 = kvs.get(replica=1, session=0, key=7)
+    kvs.run_until([f1, f2])
+    assert f2.result().value == [1, 2, 3, 4]   # after the VAL reaches replica 1
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import types as t
+from hermes_tpu.runtime import FastRuntime
+
+
+@dataclasses.dataclass
+class Completion:
+    """Result of one client op."""
+
+    kind: str  # 'get' | 'put' | 'rmw' | 'rmw_abort'
+    key: int
+    value: Optional[List[int]] = None  # payload read (get / rmw read-part)
+    uid: Optional[Tuple[int, int]] = None  # unique id of the written value
+    step: int = -1
+
+
+class Future:
+    def __init__(self):
+        self._result: Optional[Completion] = None
+
+    def done(self) -> bool:
+        return self._result is not None
+
+    def result(self) -> Completion:
+        assert self._result is not None, "op not complete; call KVS.step()/run_until()"
+        return self._result
+
+
+class KVS:
+    """A replicated, linearizable KVS served by the Hermes protocol.
+
+    One instance drives all R replicas of a single-process deployment (the
+    reference's test/bench shape, BASELINE.json:7); each (replica, session)
+    slot accepts one op at a time, queued FIFO beyond that.
+    """
+
+    def __init__(self, cfg: HermesConfig, backend: str = "batched", mesh=None,
+                 record: bool = False):
+        if cfg.value_words < 3:
+            raise ValueError("KVS needs value_words >= 3 (2 uid words + payload)")
+        # One-deep, rewritable stream: wrap_stream makes idle sessions reload
+        # slot op_idx % 1 == 0 every round, so the host can inject ops by
+        # rewriting the (R, S, 1) stream between rounds.
+        self.cfg = dataclasses.replace(cfg, ops_per_session=1, wrap_stream=True)
+        r, s, u = cfg.n_replicas, cfg.n_sessions, cfg.value_words - 2
+        self._op = np.zeros((r, s, 1), np.int32)  # OP_NOP
+        self._key = np.zeros((r, s, 1), np.int32)
+        self._uval = np.zeros((r, s, 1, u), np.int32)
+        from hermes_tpu.core import state as st
+
+        stream = st.OpStream(op=self._op, key=self._key, uval=self._uval)
+        self.rt = FastRuntime(self.cfg, backend=backend, mesh=mesh, record=record,
+                              stream=stream)
+        self._queues: Dict[Tuple[int, int], collections.deque] = (
+            collections.defaultdict(collections.deque)
+        )
+        self._inflight: Dict[Tuple[int, int], Tuple[str, Future]] = {}
+        self._dirty = True
+
+    # -- client ops ----------------------------------------------------------
+
+    def _enqueue(self, kind, replica, session, key, value) -> Future:
+        cfg = self.cfg
+        if not (0 <= replica < cfg.n_replicas):
+            raise ValueError(f"replica {replica} out of range [0, {cfg.n_replicas})")
+        if not (0 <= session < cfg.n_sessions):
+            raise ValueError(f"session {session} out of range [0, {cfg.n_sessions})")
+        if not (0 <= key < cfg.n_keys):
+            raise ValueError(f"key {key} out of range [0, {cfg.n_keys})")
+        fut = Future()
+        self._queues[(replica, session)].append((kind, key, value, fut))
+        return fut
+
+    def get(self, replica: int, session: int, key: int) -> Future:
+        """Local linearizable read: served from ``replica``'s own table,
+        stalling while the key is Invalid (SURVEY.md §3.2)."""
+        return self._enqueue("get", replica, session, key, None)
+
+    def put(self, replica: int, session: int, key: int, value: Sequence[int]) -> Future:
+        """Replicated write: commits after the INV/ACK round (quorum of live
+        replicas), linearizing at commit (SURVEY.md §3.1)."""
+        return self._enqueue("put", replica, session, key, self._payload(value))
+
+    def rmw(self, replica: int, session: int, key: int, value: Sequence[int]) -> Future:
+        """Conditional update (YCSB-F, BASELINE.json:8): writes ``value`` and
+        returns the value it displaced; aborts (kind='rmw_abort') if a
+        concurrent higher-ts update intervenes."""
+        return self._enqueue("rmw", replica, session, key, self._payload(value))
+
+    def _payload(self, value) -> np.ndarray:
+        u = self.cfg.value_words - 2
+        arr = np.asarray(list(value), np.int32)
+        if arr.ndim != 1 or arr.shape[0] > u:
+            raise ValueError(f"value must be <= {u} int32 words")
+        return np.pad(arr, (0, u - arr.shape[0]))
+
+    # -- stepping ------------------------------------------------------------
+
+    _OPC = {"get": t.OP_READ, "put": t.OP_WRITE, "rmw": t.OP_RMW}
+
+    def step(self) -> int:
+        """Inject queued ops, run one protocol round, resolve completions.
+        Returns the number of ops completed this round."""
+        import jax.numpy as jnp
+        from hermes_tpu.core import state as st
+
+        # clear slots whose op completed last round, then inject new ops
+        for rs_key, q in list(self._queues.items()):
+            if rs_key in self._inflight or not q:
+                continue
+            kind, key, value, fut = q.popleft()
+            r, s = rs_key
+            self._op[r, s, 0] = self._OPC[kind]
+            self._key[r, s, 0] = key
+            if value is not None:
+                self._uval[r, s, 0] = value
+            self._inflight[rs_key] = (kind, fut)
+            self._dirty = True
+        if self._dirty:
+            self.rt.stream = st.OpStream(
+                op=jnp.asarray(self._op), key=jnp.asarray(self._key),
+                uval=jnp.asarray(self._uval),
+            )
+            self._dirty = False
+
+        comp = self.rt.step_once()
+        code = np.asarray(comp.code)
+        rval = np.asarray(comp.rval)
+        wval = np.asarray(comp.wval)
+        ckey = np.asarray(comp.key)
+        ndone = 0
+        for (r, s), (kind, fut) in list(self._inflight.items()):
+            c = int(code[r, s])
+            if c == t.C_NONE or int(ckey[r, s]) != self._key[r, s, 0]:
+                continue
+            expect = {"get": t.C_READ, "put": t.C_WRITE}.get(kind)
+            if kind == "rmw" and c not in (t.C_RMW, t.C_RMW_ABORT):
+                continue
+            if kind != "rmw" and c != expect:
+                continue
+            done = Completion(
+                kind="rmw_abort" if c == t.C_RMW_ABORT else kind,
+                key=int(ckey[r, s]),
+                step=self.rt.step_idx - 1,
+            )
+            if c in (t.C_READ, t.C_RMW):
+                done.value = rval[r, s, 2:].tolist()
+            if c in (t.C_WRITE, t.C_RMW):
+                done.uid = (int(wval[r, s, 0]), int(wval[r, s, 1]))
+            fut._result = done
+            del self._inflight[(r, s)]
+            # retire the slot so the session doesn't reload the same op
+            self._op[r, s, 0] = t.OP_NOP
+            self._dirty = True
+            ndone += 1
+        return ndone
+
+    def run_until(self, futures: Sequence[Future], max_steps: int = 10_000) -> bool:
+        """Step until every future resolves (or the step budget runs out)."""
+        for _ in range(max_steps):
+            if all(f.done() for f in futures):
+                return True
+            self.step()
+        return all(f.done() for f in futures)
+
+    # -- membership / failure passthrough ------------------------------------
+
+    def freeze(self, replica: int) -> None:
+        self.rt.freeze(replica)
+
+    def remove(self, replica: int) -> None:
+        self.rt.remove(replica)
+
+    def join(self, replica: int, from_replica: int) -> None:
+        self.rt.join(replica, from_replica)
+
+    def counters(self) -> dict:
+        return self.rt.counters()

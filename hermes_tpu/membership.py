@@ -51,7 +51,8 @@ class MembershipService:
         if rt.step_idx % self.poll_interval != 0:
             return None
         live = int(rt.live[0])
-        last_seen = np.asarray(jax.device_get(rt.rs.meta.last_seen))  # (R_obs, R_src)
+        state = getattr(rt, "fs", None) or rt.rs  # FastRuntime | Runtime
+        last_seen = np.asarray(jax.device_get(state.meta.last_seen))  # (R_obs, R_src)
         evt = None
         for r in range(self.cfg.n_replicas):
             if not (live >> r) & 1:

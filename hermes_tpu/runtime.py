@@ -261,6 +261,7 @@ class FastRuntime:
                 raise ValueError("sharded backend needs a mesh")
             self._step = fst.build_fast_sharded(cfg, mesh, rounds=1, donate=False)
             self.fs, self.stream = fst.place_fast_sharded(cfg, mesh, self.fs, self.stream)
+            self.mesh = mesh
         else:
             raise ValueError(f"unknown backend {backend!r}")
         self._fst = fst
@@ -319,18 +320,17 @@ class FastRuntime:
 
     # -- stepping ----------------------------------------------------------
 
-    def step_once(self) -> None:
-        if self.backend == "sharded":
-            self.fs = self._step(self.fs, self.stream, self._ctl())
-            comp = None
-        else:
-            self.fs, comp = self._step(self.fs, self.stream, self._ctl())
+    def step_once(self):
+        """One protocol round; returns the host-side Completions (also fed to
+        the recorder when recording)."""
+        self.fs, comp = self._step(self.fs, self.stream, self._ctl())
+        comp_np = jax.device_get(comp)
         if self.recorder is not None:
-            assert comp is not None, "recording needs the batched backend"
-            self.recorder.record_step(jax.device_get(comp))
+            self.recorder.record_step(comp_np)
         self.step_idx += 1
         if self.membership is not None:
             self.membership.poll(self)
+        return comp_np
 
     def run(self, n_steps: int) -> None:
         for _ in range(n_steps):

@@ -1,0 +1,20 @@
+"""The five BASELINE acceptance scenarios (BASELINE.json:7-11), CI-scaled.
+
+Each scenario runs end-to-end on the fast runtime with history recording and
+must drain and pass the linearizability gate; scenario 4 additionally proves
+the lease-based membership service detects the injected stall by itself.
+"""
+
+import pytest
+
+from hermes_tpu import acceptance
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5])
+def test_acceptance_config(n):
+    counters, verdict = acceptance.run_config(n, scale=0.004, max_steps=4000)
+    assert counters["drained"], counters
+    assert verdict.ok, (verdict.failures[:2], verdict.undecided[:2])
+    assert counters["n_write"] + counters["n_rmw"] > 0
+    if n == 2:
+        assert counters["n_rmw"] > 0

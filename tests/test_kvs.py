@@ -1,0 +1,102 @@
+"""Client KVS API (hermes_tpu/kvs.py) — the reference's session-based
+get/put/RMW surface (SURVEY.md §1 L5) over the protocol runtime."""
+
+import numpy as np
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.kvs import KVS
+
+
+def mk(**kw):
+    base = dict(n_replicas=3, n_keys=256, n_sessions=8, replay_slots=4,
+                value_words=6, replay_age=4, replay_scan_every=4)
+    base.update(kw)
+    return KVS(HermesConfig(**base), record=True)
+
+
+def test_put_get_roundtrip_remote_replica():
+    kvs = mk()
+    fp = kvs.put(0, 0, key=7, value=[11, 22, 33, 44])
+    assert kvs.run_until([fp])
+    assert fp.result().kind == "put"
+    # the write is replicated: replica 2 reads it locally
+    fg = kvs.get(2, 0, key=7)
+    assert kvs.run_until([fg])
+    assert fg.result().value == [11, 22, 33, 44]
+    # and the writer reads its own write
+    fo = kvs.get(0, 1, key=7)
+    assert kvs.run_until([fo])
+    assert fo.result().value == [11, 22, 33, 44]
+
+
+def test_get_untouched_key_returns_initial():
+    kvs = mk()
+    f = kvs.get(1, 0, key=42)
+    assert kvs.run_until([f])
+    assert f.result().value == [0, 0, 0, 0]
+
+
+def test_concurrent_puts_same_key_converge():
+    kvs = mk()
+    fa = kvs.put(0, 0, key=9, value=[100])
+    fb = kvs.put(1, 0, key=9, value=[200])
+    assert kvs.run_until([fa, fb])
+    # both commit (plain writes never abort); all replicas agree on the winner
+    reads = [kvs.get(r, 2, key=9) for r in range(3)]
+    assert kvs.run_until(reads)
+    vals = [f.result().value for f in reads]
+    assert vals[0] == vals[1] == vals[2]
+    assert vals[0][0] in (100, 200)
+
+
+def test_rmw_reads_displaced_value():
+    kvs = mk()
+    f1 = kvs.put(0, 0, key=5, value=[1])
+    assert kvs.run_until([f1])
+    f2 = kvs.rmw(1, 0, key=5, value=[2])
+    assert kvs.run_until([f2])
+    c = f2.result()
+    assert c.kind == "rmw"
+    assert c.value == [1, 0, 0, 0]
+    f3 = kvs.get(2, 0, key=5)
+    assert kvs.run_until([f3])
+    assert f3.result().value == [2, 0, 0, 0]
+
+
+def test_session_queueing_fifo():
+    kvs = mk()
+    futs = [kvs.put(0, 3, key=1, value=[i]) for i in range(5)]
+    futs.append(kvs.get(0, 3, key=1))
+    assert kvs.run_until(futs)
+    assert futs[-1].result().value == [4, 0, 0, 0]
+
+
+def test_survives_replica_failure():
+    kvs = mk(n_replicas=4)
+    f1 = kvs.put(0, 0, key=3, value=[7])
+    assert kvs.run_until([f1])
+    kvs.freeze(3)
+    kvs.remove(3)
+    f2 = kvs.put(1, 0, key=3, value=[8])
+    f3 = kvs.get(0, 1, key=3)
+    assert kvs.run_until([f2, f3], max_steps=2000)
+    fg = kvs.get(2, 0, key=3)
+    assert kvs.run_until([fg])
+    assert fg.result().value == [8, 0, 0, 0]
+
+
+def test_checked_client_run():
+    """Client traffic records a history the linearizability gate accepts."""
+    kvs = mk()
+    rng = np.random.default_rng(0)
+    futs = []
+    for i in range(60):
+        r = int(rng.integers(3))
+        s = int(rng.integers(8))
+        k = int(rng.integers(16))
+        if rng.random() < 0.5:
+            futs.append(kvs.get(r, s, k))
+        else:
+            futs.append(kvs.put(r, s, k, [int(rng.integers(1000))]))
+    assert kvs.run_until(futs)
+    assert kvs.rt.check().ok
