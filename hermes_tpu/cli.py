@@ -121,7 +121,9 @@ def main(argv=None) -> int:
 
     if args.backend in ("fast", "fast-sharded"):
         backend = "batched" if args.backend == "fast" else "sharded"
-        rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=args.check)
+        # fast backends use the columnar recorder + native witness checker
+        rt = FastRuntime(cfg, backend=backend, mesh=mesh,
+                         record="array" if args.check else False)
     else:
         rt = Runtime(cfg, backend=args.backend, mesh=mesh, record=args.check)
 

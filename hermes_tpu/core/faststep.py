@@ -55,7 +55,7 @@ local supersession needs no separate detection pass.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -161,9 +161,13 @@ class FastAck(NamedTuple):
 
 
 class FastVal(NamedTuple):
+    """VAL block: one bit per INV slot of the SAME round ("this slot's write
+    committed — validate its key").  key/ts live in the round's INV block;
+    fields stay for structural compatibility but are None in faststep."""
+
     valid: jnp.ndarray  # (R, C) / (R, Rsrc, C)
-    key: jnp.ndarray
-    pts: jnp.ndarray
+    key: Optional[jnp.ndarray]
+    pts: Optional[jnp.ndarray]
     epoch: jnp.ndarray
 
 
@@ -443,7 +447,7 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
 
     fs = fs._replace(table=table, sess=sess, replay=replay)
-    return fs, out_inv, slot_lane, read_done
+    return fs, out_inv, slot_lane, lane_elig, read_done
 
 
 def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
@@ -501,7 +505,7 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
 
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
-                  in_ack: FastAck, slot_lane, read_done):
+                  in_ack: FastAck, slot_lane, lane_elig, read_done):
     """Coordinator-side ``poll_acks()`` + commit + VAL build
     (BASELINE.json:5).  Inbound acks are slot-aligned; the slot->lane map of
     THIS round's compaction plus the (key, pts) echo route them to pending
@@ -544,7 +548,13 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     sacks = jnp.where(infl, sess.acks | gained[:, :S], sess.acks)
     covered = ((sacks | ~live) & full) == full
     abort = infl & nacked[:, :S] & (sess.op == t.OP_RMW) & ~frozen
-    commit = infl & covered & ~frozen & ~abort
+    # Commit requires having BROADCAST this round: the slot-aligned VAL (see
+    # below) can only notify followers through a slot this lane holds.  A
+    # lane whose quorum is completed by a membership change (live_mask
+    # shrink) while it is in rebroadcast backoff simply commits at its next
+    # broadcast round instead — acks persist in the bitmap, so nothing is
+    # lost, and the VAL is never silently dropped.
+    commit = infl & covered & lane_elig[:, :S] & ~frozen & ~abort
 
     # One ownership gather + one Valid scatter cover sessions AND replay
     # lanes (concatenated pending arrays).
@@ -553,7 +563,7 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
 
     racks = jnp.where(replay.active, replay.acks | gained[:, S:], replay.acks)
     rcovered = ((racks | ~live) & full) == full
-    rcommit = replay.active & rcovered & ~frozen
+    rcommit = replay.active & rcovered & lane_elig[:, S:] & ~frozen
     rsuper = replay.active & ~rowns & ~frozen
     commit_lane_owned = jnp.concatenate([commit & owns, rcommit & rowns], axis=1)
     table = table._replace(
@@ -565,18 +575,14 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     )
     replay = replay._replace(acks=racks, active=replay.active & ~rcommit & ~rsuper)
 
-    # --- outbound VALs: compact commit lanes to the same budget C ---------
-    commit_lane = commit_lane_owned
-    lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
-    prio = jnp.where(commit_lane, lane_idx, L + lane_idx)
-    _, vperm = jax.lax.sort((prio, lane_idx), dimension=1, num_keys=1, is_stable=True)
-    vslot = vperm[:, :C]
-    out_val = FastVal(
-        valid=jnp.take_along_axis(commit_lane, vslot, axis=1),
-        key=jnp.take_along_axis(pend_key, vslot, axis=1),
-        pts=jnp.take_along_axis(pend_pts, vslot, axis=1),
-        epoch=ctl.epoch,
-    )
+    # --- outbound VALs ride the round's INV slots -------------------------
+    # Lockstep invariant: a lane can only commit in a round it broadcast in
+    # (acks answer this round's INVs), so every committing lane holds a slot
+    # in THIS round's compaction.  The VAL is then just a per-slot bit —
+    # receivers reconstruct (key, pts) from the INV block they already hold
+    # (fast_round passes it to _apply_val).  Kills the VAL compaction sort.
+    commit_at_slot = jnp.take_along_axis(commit_lane_owned, slot_lane, axis=1)
+    out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
 
     # --- session completion + stats ---------------------------------------
     is_rmw = sess.op == t.OP_RMW
@@ -621,14 +627,18 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     return fs._replace(table=table, sess=sess, replay=replay, meta=meta), out_val, comp
 
 
-def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_val: FastVal):
-    """VAL apply (SURVEY.md §3.1 tail): ts-matching keys go Valid."""
+def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_val: FastVal,
+               in_inv: FastInv):
+    """VAL apply (SURVEY.md §3.1 tail): ts-matching keys go Valid.  VALs are
+    slot-aligned bits over the same round's INV block (see _collect_acks);
+    key and ts come from the inbound INVs."""
     table = fs.table
     R, Rs, C = in_val.valid.shape
-    key = in_val.key.reshape(R, Rs * C)
-    pts = in_val.pts.reshape(R, Rs * C)
+    key = in_inv.key.reshape(R, Rs * C)
+    pts = in_inv.pts.reshape(R, Rs * C)
     ok = (
         in_val.valid
+        & in_inv.valid
         & (in_val.epoch == ctl.epoch[:, None])[..., None]
         & ~ctl.frozen[:, None, None]
     ).reshape(R, Rs * C)
@@ -644,13 +654,14 @@ def fast_round(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream,
                exchange_inv, exchange_ack, exchange_val):
     """One full protocol round, parameterized over the exchange primitives
     (array ops in batched mode, ICI collectives under shard_map)."""
-    fs, out_inv, slot_lane, read_done = _coordinate(cfg, ctl, fs, stream)
+    fs, out_inv, slot_lane, lane_elig, read_done = _coordinate(cfg, ctl, fs, stream)
     in_inv = exchange_inv(out_inv)
     fs, out_ack = _apply_inv(cfg, ctl, fs, in_inv)
     in_ack = exchange_ack(out_ack)
-    fs, out_val, comp = _collect_acks(cfg, ctl, fs, in_ack, slot_lane, read_done)
+    fs, out_val, comp = _collect_acks(cfg, ctl, fs, in_ack, slot_lane, lane_elig,
+                                      read_done)
     in_val = exchange_val(out_val)
-    fs = _apply_val(cfg, ctl, fs, in_val)
+    fs = _apply_val(cfg, ctl, fs, in_val, in_inv)
     return fs, comp
 
 

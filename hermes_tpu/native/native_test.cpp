@@ -1,0 +1,110 @@
+// Standalone sanitizer harness for the native components (SURVEY.md §5.2).
+//
+// Exercises the C++ TCP transport (threads + sockets: the race-prone code)
+// and the checker core WITHOUT Python/JAX in the address space, so
+// ASan/UBSan/TSan findings are actionable and belong to OUR code.
+//
+// Build+run (scripts/native_sanitize.sh):
+//   g++ -fsanitize=... native_test.cpp tcp_transport.cpp checker_core.cpp
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* ht_create(int my_rank, int n_ranks, const char* hosts_csv, int base_port);
+int ht_exchange(void* handle, const uint8_t* out, uint64_t block_size,
+                uint8_t* in);
+void ht_destroy(void* handle);
+
+int64_t hc_check_witness(int64_t n, const int32_t* key, const int8_t* kind,
+                         const int64_t* inv, const int64_t* resp,
+                         const int64_t* wuid, const int64_t* ruid,
+                         const int64_t* ts, int32_t* out_keys, int64_t max_out);
+}
+
+static void tcp_mesh_test(int n_ranks, int steps, uint64_t block) {
+  std::string hosts = "127.0.0.1";
+  for (int i = 1; i < n_ranks; ++i) hosts += ",127.0.0.1";
+  std::vector<std::thread> threads;
+  for (int r = 0; r < n_ranks; ++r) {
+    threads.emplace_back([=]() {
+      void* h = ht_create(r, n_ranks, hosts.c_str(), 31500 + 64 * n_ranks);
+      assert(h);
+      std::vector<uint8_t> out(n_ranks * block), in(n_ranks * block);
+      for (int s = 0; s < steps; ++s) {
+        for (int d = 0; d < n_ranks; ++d)
+          memset(&out[d * block], (r * steps + s) & 0xFF, block);
+        int rc = ht_exchange(h, out.data(), block, in.data());
+        assert(rc == 0);
+        for (int src = 0; src < n_ranks; ++src)
+          for (uint64_t b = 0; b < block; ++b)
+            assert(in[src * block + b] == ((src * steps + s) & 0xFF));
+      }
+      ht_destroy(h);
+    });
+  }
+  for (auto& t : threads) t.join();
+  printf("tcp mesh: %d ranks x %d steps x %llu B ok\n", n_ranks, steps,
+         (unsigned long long)block);
+}
+
+static int64_t pack_uid(int32_t lo, int32_t hi) {
+  return (int64_t)(((uint64_t)(uint32_t)hi << 32) | (uint32_t)lo);
+}
+
+static void checker_test() {
+  constexpr int64_t NONE = INT64_MIN;
+  // clean history on key 5: w(ts1) -> r -> w(ts2) -> r
+  {
+    int32_t key[] = {5, 5, 5, 5};
+    int8_t kind[] = {1, 0, 1, 0};
+    int64_t inv[] = {0, 2, 4, 6};
+    int64_t resp[] = {1, 2, 5, 6};
+    int64_t wuid[] = {pack_uid(100, 0), NONE, pack_uid(200, 0), NONE};
+    int64_t ruid[] = {NONE, pack_uid(100, 0), NONE, pack_uid(200, 0)};
+    int64_t ts[] = {(1LL << 32), NONE, (2LL << 32), NONE};
+    int32_t out[8];
+    int64_t ns = hc_check_witness(4, key, kind, inv, resp, wuid, ruid, ts, out, 8);
+    assert(ns == 0);
+  }
+  // stale read (reads old value after a newer write): suspect
+  {
+    int32_t key[] = {7, 7, 7};
+    int8_t kind[] = {1, 1, 0};
+    int64_t inv[] = {0, 2, 8};
+    int64_t resp[] = {1, 3, 8};
+    int64_t wuid[] = {pack_uid(1, 0), pack_uid(2, 0), NONE};
+    int64_t ruid[] = {NONE, NONE, pack_uid(1, 0)};
+    int64_t ts[] = {(1LL << 32), (2LL << 32), NONE};
+    int32_t out[8];
+    int64_t ns = hc_check_witness(3, key, kind, inv, resp, wuid, ruid, ts, out, 8);
+    assert(ns == 1 && out[0] == 7);
+  }
+  // read of the initial value only: clean
+  {
+    int32_t key[] = {9};
+    int8_t kind[] = {0};
+    int64_t inv[] = {0};
+    int64_t resp[] = {0};
+    int64_t wuid[] = {NONE};
+    int64_t ruid[] = {pack_uid(9, -1)};
+    int64_t ts[] = {NONE};
+    int32_t out[8];
+    int64_t ns = hc_check_witness(1, key, kind, inv, resp, wuid, ruid, ts, out, 8);
+    assert(ns == 0);
+  }
+  printf("checker core: witness cases ok\n");
+}
+
+int main() {
+  checker_test();
+  tcp_mesh_test(3, 20, 4096);
+  tcp_mesh_test(5, 10, 64);
+  printf("native sanitizer harness: all ok\n");
+  return 0;
+}

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hermes_tpu.checker.history import HistoryRecorder
+from hermes_tpu.checker.fast import ArrayRecorder, check_arrays
 from hermes_tpu.checker import linearizability as lin
 from hermes_tpu.config import HermesConfig
 from hermes_tpu.core import state as st, step as step_lib
@@ -237,7 +238,7 @@ class FastRuntime:
     transport=tpu_ici layout, BASELINE.json:5)."""
 
     def __init__(self, cfg: HermesConfig, backend: str = "batched", mesh=None,
-                 record: bool = False, stream: Optional[st.OpStream] = None):
+                 record=False, stream: Optional[st.OpStream] = None):
         from hermes_tpu.core import faststep as fst
 
         self.cfg = cfg
@@ -251,7 +252,12 @@ class FastRuntime:
         self.epoch = np.zeros((r,), np.int32)
         self.live = np.full((r,), cfg.full_mask, np.int32)
         self.frozen = np.zeros((r,), bool)
-        self.recorder = HistoryRecorder(cfg) if record else None
+        # record: False | True (Python Op recorder) | "array" (columnar
+        # recorder + native witness checker, checker/fast.py — bench scale)
+        if record == "array":
+            self.recorder = ArrayRecorder(cfg)
+        else:
+            self.recorder = HistoryRecorder(cfg) if record else None
         self.membership = None
 
         if backend == "batched":
@@ -363,19 +369,26 @@ class FastRuntime:
             lat_hist=np.asarray(m.lat_hist).sum(axis=0),
         )
 
-    def history_ops(self):
-        assert self.recorder is not None, "construct FastRuntime(record=True)"
+    def _sess_view(self):
         fst = self._fst
         sess = jax.device_get(self.fs.sess)
-        adapter = type("SessView", (), dict(
+        return type("SessView", (), dict(
             status=sess.status, op=sess.op, key=sess.key, val=sess.val,
             ver=np.asarray(fst.pts_ver(jnp.asarray(sess.pts))),
             fc=np.asarray(fst.pts_fc(jnp.asarray(sess.pts))),
             invoke_step=sess.invoke_step,
         ))
-        return self.recorder.finalize(adapter)
+
+    def history_ops(self):
+        assert self.recorder is not None, "construct FastRuntime(record=True)"
+        rec = self.recorder.finalize(self._sess_view())
+        return rec.to_ops() if isinstance(rec, ArrayRecorder) else rec
 
     def check(self, max_keys: Optional[int] = None) -> lin.Verdict:
+        assert self.recorder is not None, "construct FastRuntime(record=True)"
+        if isinstance(self.recorder, ArrayRecorder):
+            self.recorder.finalize(self._sess_view())
+            return check_arrays(self.recorder, max_keys=max_keys)
         ops = self.history_ops()
         if max_keys is not None:
             ops = lin.sample_keys(ops, max_keys=max_keys)

@@ -1,0 +1,80 @@
+"""State-table snapshot / restore (SURVEY.md §5.4).
+
+The reference has no durability story (Hermes is an in-memory store; the
+paper scopes persistence out), so snapshots here serve operational needs,
+not fidelity: seeding test bootstraps, capturing a run for offline
+inspection, and fast-forwarding bench warmup.  A snapshot is a plain
+``.npz`` of the FastState (or ReplicaState) pytree plus the host-side
+control state (step index, epoch, live mask, frozen flags).
+
+Restore semantics: a snapshot taken mid-protocol freezes in-flight writes
+exactly as they were; resuming with the same config continues the run
+deterministically (the op streams are derived from the config seed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Tuple
+
+import jax
+import numpy as np
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if hasattr(tree, "_asdict"):
+        for f, v in tree._asdict().items():
+            out.update(_flatten(v, f"{prefix}{f}."))
+    else:
+        out[prefix[:-1]] = np.asarray(jax.device_get(tree))
+    return out
+
+
+def save(path: str, rt) -> None:
+    """Snapshot a FastRuntime / Runtime (state pytree + host control)."""
+    state = rt.fs if hasattr(rt, "fs") else rt.rs
+    arrays = _flatten(state, "state.")
+    arrays["ctl.step_idx"] = np.int64(rt.step_idx)
+    arrays["ctl.epoch"] = np.asarray(rt.epoch)
+    arrays["ctl.live"] = np.asarray(rt.live)
+    arrays["ctl.frozen"] = np.asarray(rt.frozen)
+    arrays["meta.cfg"] = np.frombuffer(
+        json.dumps(dataclasses.asdict(rt.cfg)).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(path, **arrays)
+
+
+def _rebuild(template, arrays, prefix=""):
+    if hasattr(template, "_asdict"):
+        kw = {
+            f: _rebuild(v, arrays, f"{prefix}{f}.")
+            for f, v in template._asdict().items()
+        }
+        return type(template)(**kw)
+    import jax.numpy as jnp
+
+    return jnp.asarray(arrays[prefix[:-1]])
+
+
+def load(path: str, rt) -> None:
+    """Restore a snapshot into a runtime built with the SAME config."""
+    z = np.load(path)
+    saved_cfg = json.loads(bytes(z["meta.cfg"]).decode())
+    cur_cfg = dataclasses.asdict(rt.cfg)
+    if saved_cfg != cur_cfg:
+        raise ValueError(
+            "snapshot config mismatch; rebuild the runtime with the saved "
+            f"config (saved={saved_cfg}, current={cur_cfg})"
+        )
+    state = rt.fs if hasattr(rt, "fs") else rt.rs
+    restored = _rebuild(state, z, "state.")
+    if hasattr(rt, "fs"):
+        rt.fs = restored
+    else:
+        rt.rs = restored
+    rt.step_idx = int(z["ctl.step_idx"])
+    rt.epoch[:] = z["ctl.epoch"]
+    rt.live[:] = z["ctl.live"]
+    rt.frozen[:] = z["ctl.frozen"]

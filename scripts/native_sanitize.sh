@@ -1,0 +1,21 @@
+#!/bin/bash
+# Sanitizer pass over the native components (SURVEY.md §5.2): builds the C++
+# TCP transport + checker core together with the standalone harness
+# (native/native_test.cpp) under ASan+UBSan and TSan and runs it.  The
+# harness runs WITHOUT Python/JAX in the process, so findings belong to our
+# code (sanitizing the full python process flags jaxlib internals we don't
+# own).
+set -euo pipefail
+cd "$(dirname "$0")/../hermes_tpu/native"
+
+echo "== ASan + UBSan =="
+g++ -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer \
+    -o /tmp/hermes_native_asan native_test.cpp tcp_transport.cpp checker_core.cpp -pthread
+/tmp/hermes_native_asan
+
+echo "== TSan (threaded transport) =="
+g++ -O1 -g -fsanitize=thread \
+    -o /tmp/hermes_native_tsan native_test.cpp tcp_transport.cpp checker_core.cpp -pthread
+/tmp/hermes_native_tsan
+
+echo "native sanitizer pass complete"

@@ -161,3 +161,29 @@ def test_matches_reference_phases_commit_totals():
     assert total_a == total_b == 3 * 8 * 16
     assert ca["n_read"] == cb["n_read"]
     assert a.check().ok and b.check().ok
+
+
+def test_commit_during_backoff_after_membership_change():
+    """A lane whose quorum completes via a live-mask shrink while it is in
+    rebroadcast backoff must still deliver its VAL: commit waits for the
+    lane's next broadcast round (slot-aligned VALs need a slot), so no
+    follower is left Invalid until the replay scan."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=64, n_sessions=4, replay_slots=2, ops_per_session=6,
+        rebroadcast_every=4, replay_age=1000, replay_scan_every=1000,  # replay OFF
+        workload=WorkloadConfig(read_frac=0.0, seed=39),
+    )
+    rt = FastRuntime(cfg, record=True)
+    rt.run(2)
+    rt.freeze(2)  # quorum stalls: writes gather acks from {0,1} only
+    rt.run(3)
+    rt.remove(2)  # live mask shrink completes the quorums mid-backoff
+    assert rt.drain(600)
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+    # every surviving replica's touched keys reached VALID without replay
+    status = get(rt.fs.sess.status)
+    for r in range(2):
+        assert (status[r] == t.S_DONE).all()
+    sst = get(rt.fs.table.sst)
+    assert ((sst[:2] & 7) == t.VALID).all()

@@ -1,0 +1,45 @@
+"""Snapshot/restore (hermes_tpu/snapshot.py, SURVEY.md §5.4): a mid-run
+snapshot resumes deterministically."""
+
+import numpy as np
+
+from hermes_tpu import snapshot
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.runtime import FastRuntime
+
+from helpers import get
+
+
+def test_snapshot_resume_deterministic(tmp_path):
+    cfg = HermesConfig(n_replicas=3, n_keys=128, n_sessions=8, replay_slots=4,
+                       ops_per_session=16, workload=WorkloadConfig(seed=61))
+    a = FastRuntime(cfg)
+    a.run(7)
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, a)
+
+    b = FastRuntime(cfg)
+    snapshot.load(p, b)
+    assert b.step_idx == 7
+    np.testing.assert_array_equal(get(a.fs.table.pts), get(b.fs.table.pts))
+
+    a.run(10)
+    b.run(10)
+    np.testing.assert_array_equal(get(a.fs.table.pts), get(b.fs.table.pts))
+    np.testing.assert_array_equal(get(a.fs.table.val), get(b.fs.table.val))
+    np.testing.assert_array_equal(get(a.fs.sess.status), get(b.fs.sess.status))
+
+
+def test_snapshot_config_mismatch_rejected(tmp_path):
+    cfg = HermesConfig(n_replicas=3, n_keys=128, n_sessions=8, replay_slots=4,
+                       ops_per_session=16, workload=WorkloadConfig(seed=62))
+    a = FastRuntime(cfg)
+    a.run(2)
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, a)
+    other = FastRuntime(HermesConfig(n_replicas=3, n_keys=256, n_sessions=8,
+                                     replay_slots=4, ops_per_session=16))
+    import pytest
+
+    with pytest.raises(ValueError):
+        snapshot.load(p, other)
