@@ -62,6 +62,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import kernels
 from hermes_tpu.core import state as st
 from hermes_tpu.core import types as t
 
@@ -218,11 +219,14 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
 
 
 def _ridx(key):
-    """(R, 1) replica-index column for 2-D table indexing.  Gathers and
-    scatters index the tables in their NATIVE (R, K[, V]) shapes — flattening
-    to (R*K,) first forces XLA to materialize a relayout copy of the whole
-    table every round (measured: ~256 MB/round on the bench config)."""
-    return jnp.arange(key.shape[0], dtype=jnp.int32)[:, None]
+    """Replica-index array broadcastable against ``key`` (any rank) for
+    native-shape table indexing.  Two measured rules drive this helper:
+    flattening tables to (R*K,) materializes a relayout copy of the whole
+    table, and flattening (R, Rsrc, C) message blocks to (R, Rsrc*C) inserts
+    a layout-conversion copy (a kernel launch) per block — so both tables
+    AND index arrays keep their native shapes."""
+    r = key.shape[0]
+    return jnp.arange(r, dtype=jnp.int32).reshape((r,) + (1,) * (key.ndim - 1))
 
 
 def _fgather(col, key):
@@ -428,8 +432,15 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
     rot = (lane_idx + step * 127) % L  # rotating tie-break
     prio = jnp.where(lane_elig, rot, L + rot)
-    _, perm = jax.lax.sort((prio, lane_idx), dimension=1, num_keys=1, is_stable=True)
-    slot_lane = perm[:, :C]  # (R, C) lane id occupying each slot
+    if L < (1 << 15):
+        # single-operand sort: pack (prio, lane) into one word — one sort
+        # buffer instead of two, fewer layout copies
+        packed = jax.lax.sort((prio << 15) | lane_idx, dimension=1)
+        slot_lane = packed[:, :C] & ((1 << 15) - 1)  # (R, C) lane id per slot
+    else:
+        _, perm = jax.lax.sort((prio, lane_idx), dimension=1, num_keys=1,
+                               is_stable=True)
+        slot_lane = perm[:, :C]
 
     pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
     pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
@@ -459,14 +470,13 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
     R, Rs, C = in_inv.valid.shape
     step = ctl.step
 
+    # all blocks kept 3-D (R, Rs, C): reshapes would insert relayout copies
     ok = in_inv.valid & (in_inv.epoch == ctl.epoch[:, None])[..., None] & ~ctl.frozen[:, None, None]
-    key = in_inv.key.reshape(R, Rs * C)
-    pts = in_inv.pts.reshape(R, Rs * C)
-    okf = ok.reshape(R, Rs * C)
+    key, pts = in_inv.key, in_inv.pts
 
     pre_pts = _fgather(table.pts, key)
     pre_sst = _fgather(table.sst, key)
-    pts_col = _fscatter_max(table.pts, key, pts, okf)
+    pts_col = _fscatter_max(table.pts, key, pts, ok)
     post_pts = _fgather(pts_col, key)
 
     # An INV holding the key's (new) maximum ts (re)writes state+value:
@@ -475,13 +485,12 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
     # re-broadcast re-applies identical content (same ts => same write =>
     # same value) and keeps the key's current state — all idempotent
     # (SURVEY.md §3.4).
-    winner = okf & (pts == post_pts)
+    winner = ok & (pts == post_pts)
     fresh_win = winner & (pts > pre_pts)
     had_pending = (sst_state(pre_sst) == t.WRITE) | (sst_state(pre_sst) == t.TRANS)
-    src_self = (
+    is_self = (
         ctl.my_cid[:, None] == jnp.arange(Rs, dtype=jnp.int32)[None, :]
     )[..., None]  # (R, Rs, 1): the block axis-1 order is replica id
-    is_self = jnp.broadcast_to(src_self, (R, Rs, C)).reshape(R, Rs * C)
     new_state = jnp.where(
         fresh_win,
         jnp.where(had_pending, t.TRANS, t.INVALID),
@@ -490,11 +499,11 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
     table = table._replace(
         pts=pts_col,
         sst=_fscatter(table.sst, key, pack_sst(step, new_state), winner),
-        val=_fscatter_rows(table.val, key, in_inv.val.reshape(R, Rs * C, -1), winner),
+        val=_fscatter_rows(table.val, key, in_inv.val, winner),
     )
 
     ack_ok = pts == post_pts
-    pkf = ((in_inv.key << 2) | (ack_ok.reshape(R, Rs, C).astype(jnp.int32) << 1)
+    pkf = ((in_inv.key << 2) | (ack_ok.astype(jnp.int32) << 1)
            | ok.astype(jnp.int32))
     out_ack = FastAck(pkf=pkf, pts=in_inv.pts, epoch=ctl.epoch)
 
@@ -505,7 +514,8 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
 
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
-                  in_ack: FastAck, slot_lane, lane_elig, read_done):
+                  in_ack: FastAck, out_inv: FastInv, slot_lane, lane_elig,
+                  read_done):
     """Coordinator-side ``poll_acks()`` + commit + VAL build
     (BASELINE.json:5).  Inbound acks are slot-aligned; the slot->lane map of
     THIS round's compaction plus the (key, pts) echo route them to pending
@@ -517,29 +527,29 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     step = ctl.step
     frozen = ctl.frozen[:, None]
 
-    # lane -> slot map (L,): inverse of slot_lane, C where lane has no slot
-    lane_slot = jnp.full((R, L), C, jnp.int32).at[_ridx(slot_lane), slot_lane].set(
-        jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None], (R, C))
-    )
-
     pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
     pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
 
-    # Expand slot-aligned acks to lanes: in_ack[r, q, lane_slot[r, l]]
-    sl = jnp.minimum(lane_slot, C - 1)[:, None, :]  # (R, 1, L)
-    has_slot = (lane_slot < C)[:, None, :]
-    apkf = jnp.take_along_axis(in_ack.pkf, sl, axis=2)
-    apts = jnp.take_along_axis(in_ack.pts, sl, axis=2)
+    # Ack matching stays in SLOT domain: the echo is compared against the
+    # block we actually sent (out_inv carries the compacted key/pts), then
+    # the per-slot ack bits scatter back to lanes through slot_lane — no
+    # lane->slot inverse map or per-lane expansion gathers needed.
     epoch_ok = (in_ack.epoch == ctl.epoch[:, None])[..., None]
     matched = (
-        has_slot & ((apkf & 1) == 1) & epoch_ok & ~frozen[..., None]
-        & ((apkf >> 2) == pend_key[:, None, :]) & (apts == pend_pts[:, None, :])
-    )  # (R, Rsrc, L)
-    aok = (apkf & 2) == 2
+        out_inv.valid[:, None, :] & ((in_ack.pkf & 1) == 1) & epoch_ok
+        & ~frozen[..., None]
+        & ((in_ack.pkf >> 2) == out_inv.key[:, None, :])
+        & (in_ack.pts == out_inv.pts[:, None, :])
+    )  # (R, Rsrc, C)
+    aok = (in_ack.pkf & 2) == 2
 
     bit = jnp.int32(1) << jnp.arange(Rs, dtype=jnp.int32)[None, :, None]
-    gained = jnp.sum(jnp.where(matched, bit, 0), axis=1).astype(jnp.int32)  # (R, L)
-    nacked = jnp.any(matched & ~aok, axis=1)  # (R, L)
+    gained_slot = jnp.sum(jnp.where(matched, bit, 0), axis=1).astype(jnp.int32)
+    nacked_slot = jnp.any(matched & ~aok, axis=1)  # (R, C)
+    lz = jnp.zeros((R, L), jnp.int32)
+    gained = lz.at[_ridx(slot_lane), slot_lane].max(gained_slot, mode="drop")
+    nacked = lz.at[_ridx(slot_lane), slot_lane].max(
+        nacked_slot.astype(jnp.int32), mode="drop").astype(jnp.bool_)
 
     full = jnp.int32((1 << Rs) - 1)
     live = ctl.live_mask[:, None]
@@ -584,15 +594,12 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     commit_at_slot = jnp.take_along_axis(commit_lane_owned, slot_lane, axis=1)
     out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
 
-    # --- session completion + stats ---------------------------------------
-    is_rmw = sess.op == t.OP_RMW
-    code = jnp.where(
-        abort, t.C_RMW_ABORT,
-        jnp.where(commit, jnp.where(is_rmw, t.C_RMW, t.C_WRITE),
-                  jnp.where(read_done, t.C_READ, t.C_NONE)),
+    # --- session completion + stats (fused Pallas kernel) -----------------
+    code, ctr, hist_add = kernels.stats_block(
+        step, sess.op, sess.invoke_step, commit, abort, read_done
     )
     comp = st.Completions(
-        code=code.astype(jnp.int32),
+        code=code,
         key=sess.key,
         wval=sess.val,
         rval=sess.rd_val,
@@ -601,20 +608,13 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
         invoke_step=sess.invoke_step,
         commit_step=jnp.broadcast_to(step, (R, S)).astype(jnp.int32),
     )
-    lat = jnp.where(commit, step - sess.invoke_step, 0)
-    nbin = st.LAT_BINS
-    bins = jnp.arange(nbin, dtype=jnp.int32)[None, None, :]
-    hist_add = jnp.sum(
-        (jnp.clip(lat, 0, nbin - 1)[..., None] == bins) & commit[..., None],
-        axis=1, dtype=jnp.int32,
-    )
     meta = meta._replace(
-        n_read=meta.n_read + jnp.sum(read_done, axis=1, dtype=jnp.int32),
-        n_write=meta.n_write + jnp.sum(commit & ~is_rmw, axis=1, dtype=jnp.int32),
-        n_rmw=meta.n_rmw + jnp.sum(commit & is_rmw, axis=1, dtype=jnp.int32),
-        n_abort=meta.n_abort + jnp.sum(abort, axis=1, dtype=jnp.int32),
-        lat_sum=meta.lat_sum + jnp.sum(lat, axis=1, dtype=jnp.int32),
-        lat_cnt=meta.lat_cnt + jnp.sum(commit, axis=1, dtype=jnp.int32),
+        n_read=meta.n_read + ctr[:, kernels.CTR_READ],
+        n_write=meta.n_write + ctr[:, kernels.CTR_WRITE],
+        n_rmw=meta.n_rmw + ctr[:, kernels.CTR_RMW],
+        n_abort=meta.n_abort + ctr[:, kernels.CTR_ABORT],
+        lat_sum=meta.lat_sum + ctr[:, kernels.CTR_LATSUM],
+        lat_cnt=meta.lat_cnt + ctr[:, kernels.CTR_LATCNT],
         lat_hist=meta.lat_hist + hist_add,
     )
 
@@ -633,15 +633,14 @@ def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_val: FastVal,
     slot-aligned bits over the same round's INV block (see _collect_acks);
     key and ts come from the inbound INVs."""
     table = fs.table
-    R, Rs, C = in_val.valid.shape
-    key = in_inv.key.reshape(R, Rs * C)
-    pts = in_inv.pts.reshape(R, Rs * C)
+    key = in_inv.key
+    pts = in_inv.pts
     ok = (
         in_val.valid
         & in_inv.valid
         & (in_val.epoch == ctl.epoch[:, None])[..., None]
         & ~ctl.frozen[:, None, None]
-    ).reshape(R, Rs * C)
+    )
     ok = ok & (pts == _fgather(table.pts, key))
     sst = _fscatter(
         table.sst, key,
@@ -658,8 +657,8 @@ def fast_round(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream,
     in_inv = exchange_inv(out_inv)
     fs, out_ack = _apply_inv(cfg, ctl, fs, in_inv)
     in_ack = exchange_ack(out_ack)
-    fs, out_val, comp = _collect_acks(cfg, ctl, fs, in_ack, slot_lane, lane_elig,
-                                      read_done)
+    fs, out_val, comp = _collect_acks(cfg, ctl, fs, in_ack, out_inv, slot_lane,
+                                      lane_elig, read_done)
     in_val = exchange_val(out_val)
     fs = _apply_val(cfg, ctl, fs, in_val, in_inv)
     return fs, comp

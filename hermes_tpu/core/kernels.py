@@ -1,0 +1,107 @@
+"""Pallas TPU kernels for faststep's dense hot blocks.
+
+Where Pallas genuinely wins on this workload (measured; see ARCHITECTURE.md
+"Why no Pallas kernel" for the random-access cases where it does NOT):
+fusing a cluster of dense elementwise+reduction ops into ONE kernel removes
+their per-kernel-launch overhead — a dominant cost of the round on the
+target runtime (~0.5 ms marginal per launch measured).
+
+``stats_block`` fuses the per-round completion-code computation, the op
+counters, and the commit-latency histogram (collect_acks' tail: ~6 separate
+XLA fusions) into a single VMEM-resident kernel over the (R, S) session
+arrays (a few MB — comfortably VMEM-sized for bench shapes).
+
+The kernel runs ``interpret=True`` on non-TPU backends, so the same code
+runs under the CPU test suite (tests/test_kernels.py pins equivalence
+against the pure-jnp formulation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hermes_tpu.core import state as st
+from hermes_tpu.core import types as t
+
+# counter row layout in the packed (R, 8) counters output
+CTR_READ, CTR_WRITE, CTR_RMW, CTR_ABORT, CTR_LATSUM, CTR_LATCNT = range(6)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _stats_kernel(step_ref, op_ref, invoke_ref, commit_ref, abort_ref,
+                  read_ref, code_ref, ctr_ref, hist_ref):
+    step = step_ref[0, 0]
+    op = op_ref[:]
+    commit = commit_ref[:] != 0
+    abort = abort_ref[:] != 0
+    read_done = read_ref[:] != 0
+    is_rmw = op == t.OP_RMW
+
+    code = jnp.where(
+        abort, t.C_RMW_ABORT,
+        jnp.where(commit, jnp.where(is_rmw, t.C_RMW, t.C_WRITE),
+                  jnp.where(read_done, t.C_READ, t.C_NONE)),
+    )
+    code_ref[:] = code.astype(jnp.int32)
+
+    lat = jnp.where(commit, step - invoke_ref[:], 0)
+    ci = commit.astype(jnp.int32)
+    # keepdims reductions concatenated on the lane axis — the 2-D form
+    # Mosaic lowers reliably (validated on the target TPU via bench.py)
+    red = lambda x: jnp.sum(x, axis=1, keepdims=True)
+    zero = jnp.zeros((op.shape[0], 1), jnp.int32)
+    ctr_ref[:] = jnp.concatenate([
+        red(read_done.astype(jnp.int32)),
+        red(ci * (1 - is_rmw.astype(jnp.int32))),
+        red(ci * is_rmw.astype(jnp.int32)),
+        red(abort.astype(jnp.int32)),
+        red(lat),
+        red(ci),
+        zero, zero,
+    ], axis=1)
+
+    # histogram: one reduction per bin (static unroll; all inside this kernel)
+    nbin = st.LAT_BINS
+    clat = jnp.clip(lat, 0, nbin - 1)
+    hist_ref[:] = jnp.concatenate(
+        [red(((clat == b) & commit).astype(jnp.int32)) for b in range(nbin)],
+        axis=1,
+    )
+
+
+def stats_block(step, sess_op, invoke_step, commit, abort, read_done):
+    """Fused completion codes + counters + latency histogram.
+
+    Args: scalar round index + (R, S) session arrays (commit/abort/read_done
+    bool).  Returns (code (R,S) int32, ctr (R,8) int32 packed per CTR_*,
+    hist_add (R, LAT_BINS) int32).
+    """
+    R, S = sess_op.shape
+    nbin = st.LAT_BINS
+    vm = pl.BlockSpec(memory_space=pltpu.VMEM)
+    code, ctr, hist = pl.pallas_call(
+        _stats_kernel,
+        in_specs=[
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            vm, vm, vm, vm, vm,
+        ],
+        out_specs=[vm, vm, vm],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, S), jnp.int32),
+            jax.ShapeDtypeStruct((R, 8), jnp.int32),
+            jax.ShapeDtypeStruct((R, nbin), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(
+        jnp.asarray(step, jnp.int32).reshape(1, 1),
+        sess_op, invoke_step,
+        commit.astype(jnp.int32), abort.astype(jnp.int32),
+        read_done.astype(jnp.int32),
+    )
+    return code, ctr, hist

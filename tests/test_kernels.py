@@ -1,0 +1,45 @@
+"""Pallas kernels (core/kernels.py) vs pure-jnp reference formulation."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from hermes_tpu.core import kernels, state as st, types as t
+
+
+def test_stats_block_matches_reference():
+    rng = np.random.default_rng(0)
+    R, S = 4, 512
+    op = rng.choice([t.OP_READ, t.OP_WRITE, t.OP_RMW], (R, S)).astype(np.int32)
+    invoke = rng.integers(0, 40, (R, S)).astype(np.int32)
+    commit = rng.random((R, S)) < 0.3
+    abort = (rng.random((R, S)) < 0.05) & ~commit
+    read = (rng.random((R, S)) < 0.3) & ~commit & ~abort
+    step = 41
+
+    code, ctr, hist = kernels.stats_block(
+        step, jnp.asarray(op), jnp.asarray(invoke),
+        jnp.asarray(commit), jnp.asarray(abort), jnp.asarray(read))
+
+    is_rmw = op == t.OP_RMW
+    ref_code = np.where(
+        abort, t.C_RMW_ABORT,
+        np.where(commit, np.where(is_rmw, t.C_RMW, t.C_WRITE),
+                 np.where(read, t.C_READ, t.C_NONE)))
+    np.testing.assert_array_equal(np.asarray(code), ref_code)
+
+    lat = np.where(commit, step - invoke, 0)
+    np.testing.assert_array_equal(np.asarray(ctr[:, kernels.CTR_READ]), read.sum(1))
+    np.testing.assert_array_equal(np.asarray(ctr[:, kernels.CTR_WRITE]),
+                                  (commit & ~is_rmw).sum(1))
+    np.testing.assert_array_equal(np.asarray(ctr[:, kernels.CTR_RMW]),
+                                  (commit & is_rmw).sum(1))
+    np.testing.assert_array_equal(np.asarray(ctr[:, kernels.CTR_ABORT]), abort.sum(1))
+    np.testing.assert_array_equal(np.asarray(ctr[:, kernels.CTR_LATSUM]), lat.sum(1))
+    np.testing.assert_array_equal(np.asarray(ctr[:, kernels.CTR_LATCNT]), commit.sum(1))
+
+    ref_hist = np.zeros((R, st.LAT_BINS), np.int32)
+    for r in range(R):
+        for s in range(S):
+            if commit[r, s]:
+                ref_hist[r, min(lat[r, s], st.LAT_BINS - 1)] += 1
+    np.testing.assert_array_equal(np.asarray(hist), ref_hist)
