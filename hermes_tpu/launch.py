@@ -1,0 +1,96 @@
+"""Multi-host bootstrap (SURVEY.md §1 L0, §5.8 "DCN via jax.distributed").
+
+The reference boots one process per machine and exchanges connection info
+through a registry (HERD-style memcached bootstrap).  The JAX-native
+equivalent is ``jax.distributed.initialize`` — the coordinator address
+plays the registry role, and the global device mesh that results carries
+replica traffic over ICI within a slice and DCN across hosts.
+
+Single-process usage (tests, single chip/slice) skips initialization and
+just builds the mesh over local devices.
+
+    # one process per host, same command everywhere:
+    python -m hermes_tpu.launch --coordinator host0:9999 --num-hosts 4 \
+        --host-id $ID --replicas 16 --steps 200
+
+Each global device becomes one Hermes replica (BASELINE.json:5: one chip =
+one replica); the sharded faststep round runs under shard_map over the
+'replica' axis of the global mesh, so INV/ACK/VAL collectives ride ICI
+within a host's slice and DCN between hosts — no NCCL/MPI analog needed,
+XLA owns the wire.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+
+def init_distributed(coordinator: Optional[str] = None, num_hosts: int = 1,
+                     host_id: int = 0) -> None:
+    """Initialize cross-host JAX (no-op for single-process runs)."""
+    if num_hosts <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=host_id,
+    )
+
+
+def replica_mesh(n_replicas: Optional[int] = None):
+    """Mesh(('replica',)) over the global device list (all hosts)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_replicas or len(devs)
+    if len(devs) < n:
+        raise RuntimeError(f"need {n} devices for {n} replicas, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("replica",))
+
+
+def run(cfg, steps: int, coordinator=None, num_hosts=1, host_id=0):
+    """Boot (multi-host if asked), build the mesh, run the sharded fast
+    round for ``steps`` rounds; returns the runtime for inspection."""
+    init_distributed(coordinator, num_hosts, host_id)
+    from hermes_tpu.runtime import FastRuntime
+
+    mesh = replica_mesh(cfg.n_replicas)
+    rt = FastRuntime(cfg, backend="sharded", mesh=mesh)
+    rt.run(steps)
+    return rt
+
+
+def _main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coordinator", type=str, default=None,
+                    help="host:port of process 0 (multi-host only)")
+    ap.add_argument("--num-hosts", type=int, default=1)
+    ap.add_argument("--host-id", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="default: one per global device")
+    ap.add_argument("--keys", type=int, default=1 << 16)
+    ap.add_argument("--sessions", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=100)
+    args = ap.parse_args()
+
+    init_distributed(args.coordinator, args.num_hosts, args.host_id)
+    import jax
+
+    from hermes_tpu.config import HermesConfig
+
+    n = args.replicas or len(jax.devices())
+    cfg = HermesConfig(n_replicas=n, n_keys=args.keys, n_sessions=args.sessions,
+                       ops_per_session=256, wrap_stream=True)
+    rt = run(cfg, args.steps)
+    if getattr(jax, "process_index", lambda: 0)() == 0:
+        print(rt.counters())
+
+
+if __name__ == "__main__":
+    _main()

@@ -244,7 +244,9 @@ class FastRuntime:
         self.cfg = cfg
         self.backend = backend
         r = cfg.n_replicas
-        self.fs = fst.init_fast_state(cfg)
+        # sharded: every shard owns its own value table (n_local allocates
+        # per-replica vals); batched shares one (see faststep.FastTable)
+        self.fs = fst.init_fast_state(cfg, n_local=r if backend == "sharded" else None)
         raw = stream if stream is not None else ycsb.make_streams(cfg)
         self.stream = jax.tree.map(jnp.asarray, raw)
 
@@ -305,17 +307,25 @@ class FastRuntime:
         joiner as Invalid (validated by the live coordinator's VAL/replay)."""
         fst = self._fst
         tbl = self.fs.table
-        d_state = fst.sst_state(tbl.sst[from_replica])
+        K = self.cfg.n_keys
+        dst, dsrc = replica * K, from_replica * K
+        d_state = fst.sst_state(jax.lax.dynamic_slice_in_dim(tbl.sst, dsrc, K))
         j_state = jnp.where(
             (d_state == t.WRITE) | (d_state == t.TRANS) | (d_state == t.REPLAY),
             t.INVALID, d_state,
         )
         j_sst = fst.pack_sst(jnp.int32(self.step_idx), j_state)
-        self.fs = self.fs._replace(table=tbl._replace(
-            pts=tbl.pts.at[replica].set(tbl.pts[from_replica]),
-            sst=tbl.sst.at[replica].set(j_sst),
-            val=tbl.val.at[replica].set(tbl.val[from_replica]),
-        ))
+        upd = lambda col, rows: jax.lax.dynamic_update_slice_in_dim(col, rows, dst, 0)
+        new_tbl = tbl._replace(
+            pts=upd(tbl.pts, jax.lax.dynamic_slice_in_dim(tbl.pts, dsrc, K)),
+            sst=upd(tbl.sst, j_sst),
+        )
+        if tbl.val.shape[0] != K:  # per-shard value tables: transfer too
+            new_tbl = new_tbl._replace(
+                vpts=upd(tbl.vpts, jax.lax.dynamic_slice_in_dim(tbl.vpts, dsrc, K)),
+                val=upd(tbl.val, jax.lax.dynamic_slice_in_dim(tbl.val, dsrc, K)),
+            )
+        self.fs = self.fs._replace(table=new_tbl)
         self.frozen[replica] = False
         self.set_live(int(self.live[0]) | (1 << replica))
         if self.membership is not None:
@@ -328,8 +338,15 @@ class FastRuntime:
 
     def step_once(self):
         """One protocol round; returns the host-side Completions (also fed to
-        the recorder when recording)."""
+        the recorder when recording).  Multi-host runs (jax.distributed,
+        hermes_tpu/launch.py) skip the completion fetch — the global arrays
+        span non-addressable devices; use counters() (which allgathers) for
+        observability there."""
         self.fs, comp = self._step(self.fs, self.stream, self._ctl())
+        if jax.process_count() > 1:
+            assert self.recorder is None, "history recording is single-host only"
+            self.step_idx += 1
+            return None
         comp_np = jax.device_get(comp)
         if self.recorder is not None:
             self.recorder.record_step(comp_np)
@@ -343,6 +360,10 @@ class FastRuntime:
             self.step_once()
 
     def drain(self, max_steps: int = 10_000) -> bool:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "drain() polls per-step session status and is single-host "
+                "only; multi-host runs should use run(n_steps)")
         for _ in range(max_steps):
             status = np.asarray(jax.device_get(self.fs.sess.status))
             live0 = int(self.live[0])
@@ -358,7 +379,12 @@ class FastRuntime:
     # -- observability -----------------------------------------------------
 
     def counters(self) -> dict:
-        m = jax.device_get(self.fs.meta)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            m = multihost_utils.process_allgather(self.fs.meta)
+        else:
+            m = jax.device_get(self.fs.meta)
         return dict(
             n_read=np.asarray(m.n_read).sum(),
             n_write=np.asarray(m.n_write).sum(),
