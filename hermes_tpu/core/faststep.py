@@ -101,29 +101,27 @@ def sst_step(sst):
 
 
 class FastTable(NamedTuple):
-    """Key-state table as four HBM-resident columns (BASELINE.json:5):
-    ``pts`` the packed Lamport ts, ``sst`` the packed (age_step, state),
-    ``vpts`` the shared-value write arbiter, ``val`` the value words.
+    """Key-state table (BASELINE.json:5) as HBM-resident columns.
 
-    Layout (all measured on the target chip): the metadata columns are
-    allocated FLAT over (replica, key) — ``(R*K,)`` — and indexed with
-    computed global indices (leading replica axes and per-round reshapes
-    both cost relayouts/slow scatters).
+    Lockstep sharing (measured to dominate the bench; soundness arguments in
+    _apply_inv/_coordinate): all replicas of a shard receive the identical
+    INV/VAL blocks each round, so the authoritative per-key state —
+    ``vpts`` (max applied packed-ts, the Lamport conflict arbiter), ``sst``
+    (packed (age_step << 3) | state), ``val`` (value words) — is stored ONCE
+    per shard (shape (K,)/(K, V) batched; per-chip in sharded mode, where a
+    chip IS one replica and the same body runs with a local view).  Two
+    replicas can only disagree on these cells while at least one holds the
+    key un-readable, so reads stay correct (see _apply_inv).
 
-    The VALUE table is SHARED across the replicas of a shard (shape
-    ``(K, V)`` batched): under the lockstep exchange every replica receives
-    the identical INV block each round, so two replicas can only disagree
-    on a value cell while at least one of them holds the key in a
-    non-readable state — a key VALID at packed-ts p on any replica is
-    guaranteed to read the value of ts p from the shared table (argument in
-    _apply_inv).  This cuts the dominant value-scatter from R*Rsrc*C rows
-    to Rsrc*C — exactly the per-chip cost of the real mesh, where each chip
-    naturally owns one table (global val is (R*K, V) sharded to (K, V) per
-    chip).  ``vpts`` arbitrates shared-value writes (max packed ts applied
-    so far, same scatter-max as the protocol's conflict resolution)."""
+    ``pts`` is the only per-replica column — the ISSUE LEDGER (R*K, flat
+    global indexing): each replica records the packed ts of its own issued
+    writes there so a budget-deferred (not-yet-broadcast) write still forces
+    the next same-key issue on that replica to a strictly higher version.
+    It is written only at issue time and read only by the issue path.
+    """
 
-    pts: jnp.ndarray  # (R*K,)
-    sst: jnp.ndarray  # (R*K,)
+    pts: jnp.ndarray  # (R*K,) per-replica issue ledger
+    sst: jnp.ndarray  # (K,) batched / (R*K,) sharded-global
     vpts: jnp.ndarray  # (K,) batched / (R*K,) sharded-global
     val: jnp.ndarray  # (K, V) batched / (R*K, V) sharded-global
 
@@ -200,8 +198,8 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
     recognizable initial value (lo=key, hi=-1) (state.init_table)."""
     r = cfg.n_replicas if n_local is None else n_local
     k, s, rs, v = cfg.n_keys, cfg.n_sessions, cfg.replay_slots, cfg.value_words
-    # batched mode shares one value table; sharded init (n_val_shards=r via
-    # init_fast_state_sharded) allocates one per future shard
+    # batched mode shares the authoritative tables across the shard's
+    # replicas; sharded init (n_local=r) allocates one set per future shard
     nv = 1 if n_local is None else r
     val = jnp.zeros((nv * k, v), jnp.int32)
     val = val.at[:, 0].set(jnp.tile(jnp.arange(k, dtype=jnp.int32), nv))
@@ -218,7 +216,7 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
         lat_hist=z(r, st.LAT_BINS),
     )
     return FastState(
-        table=FastTable(pts=z(r * k), sst=z(r * k), vpts=z(nv * k), val=val),
+        table=FastTable(pts=z(r * k), sst=z(nv * k), vpts=z(nv * k), val=val),
         sess=FastSess(
             status=z(r, s), op=z(r, s), op_idx=z(r, s), key=z(r, s),
             val=z(r, s, v), pts=z(r, s), acks=z(r, s),
@@ -342,9 +340,12 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
 
     # --- reads + issue -----------------------------------------------------
-    k_pts = _fgather(table.pts, sess.key)
-    k_sst = _fgather(table.sst, sess.key)
-    k_valid = sst_state(k_sst) == t.VALID
+    k_led = _fgather(table.pts, sess.key)  # my issue ledger
+    k_vpts = table.vpts[sess.key]  # shared arbiter (plain key indexing)
+    k_valid = sst_state(table.sst[sess.key]) == t.VALID
+    # a ledger entry above the shared arbiter = my own not-yet-broadcast
+    # write: block further same-key issues until it ships (dup-ts guard)
+    pending_local = k_led > k_vpts
 
     read_done = (sess.status == t.S_READ) & k_valid & ~frozen
     rd_val = table.val[sess.key]  # shared value table: plain key indexing
@@ -357,7 +358,7 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # Same-key same-replica issue arbitration via a small hash-slot race:
     # colliding sessions (same slot) defer to the lowest index; a false
     # collision (different keys, same slot) only delays an issue one round.
-    want = (sess.status == t.S_ISSUE) & k_valid & ~frozen
+    want = (sess.status == t.S_ISSUE) & k_valid & ~pending_local & ~frozen
     HS = cfg.arb_slots
     h = sess.key & (HS - 1)
     idxs = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (R, S))
@@ -367,14 +368,12 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
 
     flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
     fc = (flag << 8) | ctl.my_cid[:, None]
-    new_pts = pack_pts(pts_ver(k_pts) + 1, fc)
+    new_pts = pack_pts(jnp.maximum(pts_ver(k_led), pts_ver(k_vpts)) + 1, fc)
     old_val = rd_val  # RMW read-part observes the pre-issue value
 
-    # Local apply, minimal form: only the packed ts advances here (so a
-    # same-key issue next round proposes a strictly higher version even if
-    # this lane's broadcast is budget-deferred); state+value land via the
-    # self-INV in _apply_inv (the broadcast includes self), which treats any
-    # current-max INV as (re)writable — idempotent for re-broadcasts.
+    # Issue records only the ledger entry; state+value land via the
+    # broadcast INV in _apply_inv (the block includes self) — idempotent
+    # for re-broadcasts (SURVEY.md §3.4).
     table = table._replace(
         pts=_fscatter_max(table.pts, sess.key, new_pts, win),
     )
@@ -389,17 +388,27 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # --- replay scan, cond-gated (SURVEY.md §3.4; only matters after
     # failures, so it runs every replay_scan_every rounds) ------------------
     def do_scan(args):
+        # The stuck mask lives in the SHARED state, so every live replica
+        # sees the same candidates and replays the same keys — duplicate
+        # same-ts re-INVs are idempotent (SURVEY.md §3.4), and any live
+        # replica alone suffices to finish a dead coordinator's write.
         table, replay = args
-        sst_rk = table.sst.reshape(R, K)  # relayout only on scan rounds
-        age = step - sst_step(sst_rk)
-        state = sst_state(sst_rk)
-        stuck = ((state == t.INVALID) | (state == t.TRANS)) & (age > cfg.replay_age)
-        kiota = jnp.arange(K, dtype=jnp.int32)[None, :]
-        score = jnp.where(stuck & ~frozen[:, :1], -kiota, I32_MIN)
+        sstK = table.sst.reshape(1, -1)  # (1, nv*K): top_k wants a batch dim
+        age = step - sst_step(sstK)
+        state = sst_state(sstK)
+        # REPLAY is included: the shared mark means SOME replica snapshotted
+        # the key, but if every slot-holder dies before committing, the key
+        # must be re-detected once it ages again (the mark re-stamps age).
+        stuck = (
+            (state == t.INVALID) | (state == t.TRANS) | (state == t.REPLAY)
+        ) & (age > cfg.replay_age)
+        kiota = jnp.arange(sstK.shape[1], dtype=jnp.int32)[None, :]
+        score = jnp.where(stuck, -kiota, I32_MIN)
         top, _ = jax.lax.top_k(score, RS)
-        cand = -top  # (R, RS); invalid entries have score I32_MIN -> huge cand
-        cand_ok = top != I32_MIN
-        cand = jnp.where(cand_ok, cand, 0)
+        cand_ok1 = top[0] != I32_MIN  # (RS,)
+        cand1 = jnp.where(cand_ok1, -top[0], 0) % K  # global row -> key id
+        cand_ok = jnp.broadcast_to(cand_ok1[None], (R, RS)) & ~frozen[:, :1]
+        cand = jnp.broadcast_to(cand1[None], (R, RS))
         # i-th candidate -> i-th free slot (sorted free-slot order)
         free_rank = jnp.cumsum((~replay.active).astype(jnp.int32), axis=1) - 1
         # for each slot: which candidate it takes = rank among free slots
@@ -408,17 +417,15 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             jnp.pad(cand_ok, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1
         )
         ck = jnp.take_along_axis(jnp.pad(cand, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1)
-        c_pts = _fgather(table.pts, ck)
         new_replay = FastReplay(
             active=jnp.where(take_ok, True, replay.active),
             key=jnp.where(take_ok, ck, replay.key),
-            pts=jnp.where(take_ok, c_pts, replay.pts),
+            pts=jnp.where(take_ok, table.vpts[ck], replay.pts),
             val=jnp.where(take_ok[..., None], table.val[ck], replay.val),
             acks=jnp.where(take_ok, 0, replay.acks),
         )
-        new_sst = _fscatter(
-            table.sst, ck,
-            pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32)), take_ok,
+        new_sst = table.sst.at[jnp.where(take_ok, ck, table.sst.shape[0])].set(
+            pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32)), mode="drop"
         )
         return table._replace(sst=new_sst), new_replay
 
@@ -473,64 +480,47 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
 
 
 def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
-    """Follower-side ``apply_inv()`` (BASELINE.json:5) over the inbound
-    (R, Rsrc, C) block: per-key winner + stale-drop + idempotent re-apply all
-    via one scatter-max on the packed ts; ALWAYS ack with the ok conflict
-    flag.  The coordinator's own block is included (self-ack)."""
+    """Follower-side ``apply_inv()`` (BASELINE.json:5): per-key winner +
+    stale-drop + idempotent re-apply all via one scatter-max on the packed
+    ts; ALWAYS ack with the ok conflict flag (the block includes self, so
+    the coordinator self-acks).
+
+    All table writes go to the SHARED columns through the [0] view of the
+    block — THE block in both modes (batched broadcasts make axis 0
+    identical; a shard's local axis 0 has size 1).  Soundness of sharing
+    under lockstep: a key Valid at ts p on any replica means no broadcast
+    INV ever exceeded p (it would have invalidated that replica too), so
+    the shared cells — arbitrated by the vpts scatter-max — hold exactly
+    ts p's value and state when read through a Valid check.  The ACK ok
+    flag also derives from the shared arbiter: conflicts among broadcast
+    writes are global facts, and the write-flag tiebreak (types.FLAG_*)
+    guarantees a same-version plain write beats any concurrent RMW, which
+    is what makes shared nack detection equivalent to per-replica (a
+    deferred, not-yet-broadcast write can never be the one an RMW must
+    abort for).  Epochs are uniform across a shard's replicas (FastRuntime
+    bumps them together).  (The reference phases engine keeps the fuller
+    per-replica Write/Trans bookkeeping.)"""
     table = fs.table
     R, Rs, C = in_inv.valid.shape
     step = ctl.step
 
-    # all blocks kept 3-D (R, Rs, C): reshapes would insert relayout copies
-    ok = in_inv.valid & (in_inv.epoch == ctl.epoch[:, None])[..., None] & ~ctl.frozen[:, None, None]
-    key, pts = in_inv.key, in_inv.pts
-
-    pts_col = _fscatter_max(table.pts, key, pts, ok)
-    post_pts = _fgather(pts_col, key)
-
-    # --- shared value table (see FastTable): one write per broadcast slot.
-    # Lockstep argument: all replicas receive this same block, so the max
-    # applied ts is global; a key VALID at ts p on some replica implies no
-    # broadcast INV ever exceeded p (else that replica's pts would exceed p
-    # and the key could not be Valid), hence the shared cell — written by
-    # the max-ts winner, arbitrated by vpts — holds exactly ts p's value.
-    # The [0] view is THE block in both modes: batched broadcasts make axis
-    # 0 identical; a shard's local axis 0 has size 1.  Epochs are uniform
-    # across a shard's replicas (FastRuntime bumps them together).
     key0 = in_inv.key[0]
+    pts0 = in_inv.pts[0]
     v_ok = in_inv.valid[0] & (in_inv.epoch[0] == ctl.epoch[0])[..., None]
-    vpts_col = table.vpts.at[jnp.where(v_ok, key0, table.vpts.shape[0])].max(
-        in_inv.pts[0], mode="drop")
-    v_win = v_ok & (in_inv.pts[0] == vpts_col[key0])
-    val_col = table.val.at[jnp.where(v_win, key0, table.val.shape[0])].set(
-        in_inv.val[0], mode="drop")
-
-    # An INV holding the key's (new) maximum ts (re)writes state+value:
-    # newer INVs invalidate; the coordinator's own INV (state+value deferred
-    # at issue, see _coordinate) moves its key to Write; a same-ts
-    # re-broadcast re-applies identical content (same ts => same write =>
-    # same value) — all idempotent (SURVEY.md §3.4).  No pre-state read is
-    # needed: under lockstep + commit-requires-slot (_collect_acks), a
-    # writer stops broadcasting strictly before its VAL can have validated
-    # the key anywhere, so a current-max INV never clobbers a readable
-    # Valid state.  (The reference phases engine keeps the fuller
-    # Write->Trans bookkeeping; here a superseded pending write simply
-    # shows as Invalid — the two states behave identically everywhere in
-    # this engine.)
-    winner = ok & (pts == post_pts)
-    is_self = (
-        ctl.my_cid[:, None] == jnp.arange(Rs, dtype=jnp.int32)[None, :]
-    )[..., None]  # (R, Rs, 1): the block axis-1 order is replica id
-    new_state = jnp.where(is_self, t.WRITE, t.INVALID).astype(jnp.int32)
-    new_state = jnp.broadcast_to(new_state, winner.shape)
+    oob = table.vpts.shape[0]
+    vpts_col = table.vpts.at[jnp.where(v_ok, key0, oob)].max(pts0, mode="drop")
+    post0 = vpts_col[key0]
+    win0 = v_ok & (pts0 == post0)
     table = table._replace(
-        pts=pts_col,
-        sst=_fscatter(table.sst, key, pack_sst(step, new_state), winner),
         vpts=vpts_col,
-        val=val_col,
+        val=table.val.at[jnp.where(win0, key0, oob)].set(in_inv.val[0], mode="drop"),
+        sst=table.sst.at[jnp.where(win0, key0, oob)].set(
+            pack_sst(step, jnp.full(key0.shape, t.INVALID, jnp.int32)), mode="drop"),
     )
 
-    ack_ok = pts == post_pts
+    # per-replica ACK blocks: shared conflict flag, per-replica validity
+    ok = in_inv.valid & (in_inv.epoch == ctl.epoch[:, None])[..., None] & ~ctl.frozen[:, None, None]
+    ack_ok = jnp.broadcast_to((pts0 == post0)[None], (R, Rs, C))
     pkf = ((in_inv.key << 2) | (ack_ok.astype(jnp.int32) << 1)
            | ok.astype(jnp.int32))
     out_ack = FastAck(pkf=pkf, pts=in_inv.pts, epoch=ctl.epoch)
@@ -554,9 +544,6 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     S, RS, L = cfg.n_sessions, cfg.replay_slots, cfg.n_lanes
     step = ctl.step
     frozen = ctl.frozen[:, None]
-
-    pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
-    pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
 
     # Ack matching stays in SLOT domain: the echo is compared against the
     # block we actually sent (out_inv carries the compacted key/pts), then
@@ -594,23 +581,15 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # lost, and the VAL is never silently dropped.
     commit = infl & covered & lane_elig[:, :S] & ~frozen & ~abort
 
-    # One ownership gather + one Valid scatter cover sessions AND replay
-    # lanes (concatenated pending arrays).
-    pend_owns = pend_pts == _fgather(table.pts, pend_key)
-    owns, rowns = pend_owns[:, :S], pend_owns[:, S:]
+    # Replay-slot release: a slot whose key's shared arbiter moved past the
+    # slot's ts was taken over by a newer write — that writer's VAL will
+    # validate the key.
+    rowns = replay.pts == table.vpts[replay.key]
 
     racks = jnp.where(replay.active, replay.acks | gained[:, S:], replay.acks)
     rcovered = ((racks | ~live) & full) == full
     rcommit = replay.active & rcovered & lane_elig[:, S:] & ~frozen
     rsuper = replay.active & ~rowns & ~frozen
-    commit_lane_owned = jnp.concatenate([commit & owns, rcommit & rowns], axis=1)
-    table = table._replace(
-        sst=_fscatter(
-            table.sst, pend_key,
-            pack_sst(step, jnp.full((R, L), t.VALID, jnp.int32)),
-            commit_lane_owned,
-        )
-    )
     replay = replay._replace(acks=racks, active=replay.active & ~rcommit & ~rsuper)
 
     # --- outbound VALs ride the round's INV slots -------------------------
@@ -618,8 +597,11 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # (acks answer this round's INVs), so every committing lane holds a slot
     # in THIS round's compaction.  The VAL is then just a per-slot bit —
     # receivers reconstruct (key, pts) from the INV block they already hold
-    # (fast_round passes it to _apply_val).  Kills the VAL compaction sort.
-    commit_at_slot = jnp.take_along_axis(commit_lane_owned, slot_lane, axis=1)
+    # (fast_round passes it to _apply_val); its shared Valid write (with the
+    # vpts ownership check) also covers the committer's own table, so no
+    # separate commit scatter exists.
+    commit_lane = jnp.concatenate([commit, rcommit & rowns], axis=1)
+    commit_at_slot = jnp.take_along_axis(commit_lane, slot_lane, axis=1)
     out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
 
     # --- session completion + stats (fused Pallas kernel) -----------------
@@ -658,21 +640,20 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
 def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_val: FastVal,
                in_inv: FastInv):
     """VAL apply (SURVEY.md §3.1 tail): ts-matching keys go Valid.  VALs are
-    slot-aligned bits over the same round's INV block (see _collect_acks);
-    key and ts come from the inbound INVs."""
+    slot-aligned bits over the same round's INV block; the write lands once
+    in the shared state table ([0] view, see _apply_inv), guarded by the
+    shared arbiter so a VAL whose write was superseded this round is a
+    no-op."""
     table = fs.table
-    key = in_inv.key
-    pts = in_inv.pts
-    ok = (
-        in_val.valid
-        & in_inv.valid
-        & (in_val.epoch == ctl.epoch[:, None])[..., None]
-        & ~ctl.frozen[:, None, None]
+    key0 = in_inv.key[0]
+    ok0 = (
+        in_val.valid[0]
+        & in_inv.valid[0]
+        & (in_val.epoch[0] == ctl.epoch[0])[..., None]
+        & (in_inv.pts[0] == table.vpts[key0])
     )
-    ok = ok & (pts == _fgather(table.pts, key))
-    sst = _fscatter(
-        table.sst, key,
-        pack_sst(ctl.step, jnp.full(key.shape, t.VALID, jnp.int32)), ok,
+    sst = table.sst.at[jnp.where(ok0, key0, table.sst.shape[0])].set(
+        pack_sst(ctl.step, jnp.full(key0.shape, t.VALID, jnp.int32)), mode="drop"
     )
     return fs._replace(table=table._replace(sst=sst))
 

@@ -88,8 +88,9 @@ def _main():
     cfg = HermesConfig(n_replicas=n, n_keys=args.keys, n_sessions=args.sessions,
                        ops_per_session=256, wrap_stream=True)
     rt = run(cfg, args.steps)
-    if getattr(jax, "process_index", lambda: 0)() == 0:
-        print(rt.counters())
+    counters = rt.counters()  # collective (allgather) — every process joins
+    if jax.process_index() == 0:
+        print(counters)
 
 
 if __name__ == "__main__":

@@ -308,24 +308,27 @@ class FastRuntime:
         fst = self._fst
         tbl = self.fs.table
         K = self.cfg.n_keys
-        dst, dsrc = replica * K, from_replica * K
-        d_state = fst.sst_state(jax.lax.dynamic_slice_in_dim(tbl.sst, dsrc, K))
-        j_state = jnp.where(
-            (d_state == t.WRITE) | (d_state == t.TRANS) | (d_state == t.REPLAY),
-            t.INVALID, d_state,
-        )
-        j_sst = fst.pack_sst(jnp.int32(self.step_idx), j_state)
-        upd = lambda col, rows: jax.lax.dynamic_update_slice_in_dim(col, rows, dst, 0)
-        new_tbl = tbl._replace(
-            pts=upd(tbl.pts, jax.lax.dynamic_slice_in_dim(tbl.pts, dsrc, K)),
-            sst=upd(tbl.sst, j_sst),
-        )
-        if tbl.val.shape[0] != K:  # per-shard value tables: transfer too
-            new_tbl = new_tbl._replace(
+        if tbl.val.shape[0] != K:
+            # sharded: each shard owns its tables — transfer the donor's,
+            # folding its in-flight coordination states to Invalid (the live
+            # coordinator's VAL or the replay scan re-validates them)
+            dst, dsrc = replica * K, from_replica * K
+            d_state = fst.sst_state(jax.lax.dynamic_slice_in_dim(tbl.sst, dsrc, K))
+            j_state = jnp.where(
+                (d_state == t.WRITE) | (d_state == t.TRANS) | (d_state == t.REPLAY),
+                t.INVALID, d_state,
+            )
+            j_sst = fst.pack_sst(jnp.int32(self.step_idx), j_state)
+            upd = lambda col, rows: jax.lax.dynamic_update_slice_in_dim(col, rows, dst, 0)
+            self.fs = self.fs._replace(table=tbl._replace(
+                pts=upd(tbl.pts, jax.lax.dynamic_slice_in_dim(tbl.pts, dsrc, K)),
+                sst=upd(tbl.sst, j_sst),
                 vpts=upd(tbl.vpts, jax.lax.dynamic_slice_in_dim(tbl.vpts, dsrc, K)),
                 val=upd(tbl.val, jax.lax.dynamic_slice_in_dim(tbl.val, dsrc, K)),
-            )
-        self.fs = self.fs._replace(table=new_tbl)
+            ))
+        # batched: the authoritative tables are shared — they already ARE
+        # the joiner's state, and its own issue ledger (pts) survived the
+        # fencing, so no table transfer is needed.
         self.frozen[replica] = False
         self.set_live(int(self.live[0]) | (1 << replica))
         if self.membership is not None:
