@@ -57,7 +57,7 @@ def main() -> None:
     )
 
     fs = jax.device_put(fst.init_fast_state(cfg))
-    stream = jax.device_put(jax.tree.map(jnp.asarray, ycsb.make_streams(cfg)))
+    stream = jax.device_put(fst.prep_stream(ycsb.make_streams(cfg)))
     chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
 
     def counters(x):

@@ -479,88 +479,110 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     return fs, out_inv, slot_lane, lane_elig, read_done
 
 
-def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_inv: FastInv):
-    """Follower-side ``apply_inv()`` (BASELINE.json:5): per-key winner +
-    stale-drop + idempotent re-apply all via one scatter-max on the packed
-    ts; ALWAYS ack with the ok conflict flag (the block includes self, so
-    the coordinator self-acks).
+def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv):
+    """Follower-side ``apply_inv()`` (BASELINE.json:5) over the SOURCE-shaped
+    block ``inv_src`` (fields (Rsrc, C); epoch/alive (Rsrc,)): per-key winner
+    + stale-drop + idempotent re-apply via one scatter-max on the packed ts.
 
-    All table writes go to the SHARED columns through the [0] view of the
-    block — THE block in both modes (batched broadcasts make axis 0
-    identical; a shard's local axis 0 has size 1).  Soundness of sharing
-    under lockstep: a key Valid at ts p on any replica means no broadcast
-    INV ever exceeded p (it would have invalidated that replica too), so
-    the shared cells — arbitrated by the vpts scatter-max — hold exactly
-    ts p's value and state when read through a Valid check.  The ACK ok
-    flag also derives from the shared arbiter: conflicts among broadcast
-    writes are global facts, and the write-flag tiebreak (types.FLAG_*)
-    guarantees a same-version plain write beats any concurrent RMW, which
-    is what makes shared nack detection equivalent to per-replica (a
-    deferred, not-yet-broadcast write can never be the one an RMW must
-    abort for).  Epochs are uniform across a shard's replicas (FastRuntime
-    bumps them together).  (The reference phases engine keeps the fuller
-    per-replica Write/Trans bookkeeping.)"""
+    All table writes go to the SHARED columns (see FastTable).  Soundness of
+    sharing under lockstep: a key Valid at ts p on any replica means no
+    broadcast INV ever exceeded p (it would have invalidated that replica
+    too), so the shared cells — arbitrated by the vpts scatter-max — hold
+    exactly ts p's value and state when read through a Valid check.  The
+    returned ``ack_flags`` (Rsrc, C) are the shared conflict verdicts (the
+    ACK ok bit): conflicts among broadcast writes are global facts, and the
+    write-flag tiebreak (types.FLAG_*) guarantees a same-version plain write
+    beats any concurrent RMW, which makes the shared verdict equivalent to
+    per-replica evaluation.  Epochs are uniform across a shard's replicas
+    (FastRuntime bumps them together).  (The reference phases engine keeps
+    the fuller per-replica Write/Trans bookkeeping.)"""
     table = fs.table
-    R, Rs, C = in_inv.valid.shape
     step = ctl.step
 
-    key0 = in_inv.key[0]
-    pts0 = in_inv.pts[0]
-    v_ok = in_inv.valid[0] & (in_inv.epoch[0] == ctl.epoch[0])[..., None]
+    key0, pts0 = inv_src.key, inv_src.pts
+    v_ok = inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
     oob = table.vpts.shape[0]
     vpts_col = table.vpts.at[jnp.where(v_ok, key0, oob)].max(pts0, mode="drop")
     post0 = vpts_col[key0]
     win0 = v_ok & (pts0 == post0)
     table = table._replace(
         vpts=vpts_col,
-        val=table.val.at[jnp.where(win0, key0, oob)].set(in_inv.val[0], mode="drop"),
+        val=table.val.at[jnp.where(win0, key0, oob)].set(inv_src.val, mode="drop"),
         sst=table.sst.at[jnp.where(win0, key0, oob)].set(
             pack_sst(step, jnp.full(key0.shape, t.INVALID, jnp.int32)), mode="drop"),
     )
-
-    # per-replica ACK blocks: shared conflict flag, per-replica validity
-    ok = in_inv.valid & (in_inv.epoch == ctl.epoch[:, None])[..., None] & ~ctl.frozen[:, None, None]
-    ack_ok = jnp.broadcast_to((pts0 == post0)[None], (R, Rs, C))
-    pkf = ((in_inv.key << 2) | (ack_ok.astype(jnp.int32) << 1)
-           | ok.astype(jnp.int32))
-    out_ack = FastAck(pkf=pkf, pts=in_inv.pts, epoch=ctl.epoch)
+    ack_flags = pts0 == post0  # (Rsrc, C): ok bit for every slot of every source
 
     meta = fs.meta._replace(
-        last_seen=jnp.where(in_inv.alive & ~ctl.frozen[:, None], step, fs.meta.last_seen)
+        last_seen=jnp.where(
+            inv_src.alive[None, :] & ~ctl.frozen[:, None], step, fs.meta.last_seen
+        )
     )
-    return fs._replace(table=table, meta=meta), out_ack
+    return fs._replace(table=table, meta=meta), ack_flags
+
+
+def _derived_acks(ctl: FastCtl, out_inv: FastInv, ack_flags):
+    """Lockstep-batched ACK derivation — the quorum bitmap without the wire.
+
+    In the batched emulation every replica computes the identical shared
+    conflict verdict (ack_flags row r = the flags for replica r's slots),
+    and an acker's only per-replica contribution is its aliveness, so the
+    gathered-ack bitmap for a valid slot is exactly the alive-replica mask.
+    Failure injection stays faithful: frozen replicas contribute no bits,
+    and membership changes act through the live_mask quorum test as always.
+    (The sharded engine keeps the real ACK collective — on a mesh the
+    verdicts genuinely travel.)"""
+    R, C = out_inv.valid.shape
+    abits = jnp.sum(
+        jnp.where(~ctl.frozen, jnp.int32(1) << jnp.arange(R, dtype=jnp.int32), 0)
+    ).astype(jnp.int32)
+    gained_slot = jnp.where(out_inv.valid, abits, 0)
+    nacked_slot = out_inv.valid & ~ack_flags & (abits != 0)
+    return gained_slot, nacked_slot
+
+
+def _wire_acks(cfg: HermesConfig, ctl: FastCtl, inv_src: FastInv, ack_flags,
+               out_inv: FastInv, exchange_ack):
+    """Sharded ACK exchange: pack my verdicts for every source's slots, move
+    them with the collective, and match the returned echoes against the
+    block I actually sent — a delayed or stale ack can never mis-credit a
+    different pending update."""
+    ok = (
+        inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
+        & ~ctl.frozen[0]
+    )
+    pkf = ((inv_src.key << 2) | (ack_flags.astype(jnp.int32) << 1)
+           | ok.astype(jnp.int32))
+    out_ack = FastAck(pkf=pkf[None], pts=inv_src.pts[None], epoch=ctl.epoch)
+    in_ack = exchange_ack(out_ack)  # (1, Rsrc, C): each source's ack of MY slots
+    Rs = in_ack.pkf.shape[1]
+    epoch_ok = (in_ack.epoch == ctl.epoch[:, None])[..., None]
+    matched = (
+        out_inv.valid[:, None, :] & ((in_ack.pkf & 1) == 1) & epoch_ok
+        & ~ctl.frozen[:, None, None]
+        & ((in_ack.pkf >> 2) == out_inv.key[:, None, :])
+        & (in_ack.pts == out_inv.pts[:, None, :])
+    )
+    aok = (in_ack.pkf & 2) == 2
+    bit = jnp.int32(1) << jnp.arange(Rs, dtype=jnp.int32)[None, :, None]
+    gained_slot = jnp.sum(jnp.where(matched, bit, 0), axis=1).astype(jnp.int32)
+    nacked_slot = jnp.any(matched & ~aok, axis=1)
+    return gained_slot, nacked_slot
 
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
-                  in_ack: FastAck, out_inv: FastInv, slot_lane, lane_elig,
-                  read_done):
+                  gained_slot, nacked_slot, slot_lane, lane_elig, read_done):
     """Coordinator-side ``poll_acks()`` + commit + VAL build
-    (BASELINE.json:5).  Inbound acks are slot-aligned; the slot->lane map of
-    THIS round's compaction plus the (key, pts) echo route them to pending
-    lanes.  Commit = ack bitmap covers live_mask (the linearization point,
-    SURVEY.md §3.1); RMW aborts on any nack (ok=False)."""
+    (BASELINE.json:5).  Per-slot ack bits (derived or wired) scatter back to
+    lanes through slot_lane; commit = ack bitmap covers live_mask (the
+    linearization point, SURVEY.md §3.1); RMW aborts on any nack."""
     table, sess, replay, meta = fs.table, fs.sess, fs.replay, fs.meta
-    R, Rs, C = in_ack.pkf.shape
+    R, C = gained_slot.shape
+    Rs = cfg.n_replicas
     S, RS, L = cfg.n_sessions, cfg.replay_slots, cfg.n_lanes
     step = ctl.step
     frozen = ctl.frozen[:, None]
 
-    # Ack matching stays in SLOT domain: the echo is compared against the
-    # block we actually sent (out_inv carries the compacted key/pts), then
-    # the per-slot ack bits scatter back to lanes through slot_lane — no
-    # lane->slot inverse map or per-lane expansion gathers needed.
-    epoch_ok = (in_ack.epoch == ctl.epoch[:, None])[..., None]
-    matched = (
-        out_inv.valid[:, None, :] & ((in_ack.pkf & 1) == 1) & epoch_ok
-        & ~frozen[..., None]
-        & ((in_ack.pkf >> 2) == out_inv.key[:, None, :])
-        & (in_ack.pts == out_inv.pts[:, None, :])
-    )  # (R, Rsrc, C)
-    aok = (in_ack.pkf & 2) == 2
-
-    bit = jnp.int32(1) << jnp.arange(Rs, dtype=jnp.int32)[None, :, None]
-    gained_slot = jnp.sum(jnp.where(matched, bit, 0), axis=1).astype(jnp.int32)
-    nacked_slot = jnp.any(matched & ~aok, axis=1)  # (R, C)
     lz = jnp.zeros((R * L,), jnp.int32)
     gained = lz.at[_gkey(lz, slot_lane)].max(gained_slot, mode="drop").reshape(R, L)
     nacked = lz.at[_gkey(lz, slot_lane)].max(
@@ -596,10 +618,9 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # Lockstep invariant: a lane can only commit in a round it broadcast in
     # (acks answer this round's INVs), so every committing lane holds a slot
     # in THIS round's compaction.  The VAL is then just a per-slot bit —
-    # receivers reconstruct (key, pts) from the INV block they already hold
-    # (fast_round passes it to _apply_val); its shared Valid write (with the
-    # vpts ownership check) also covers the committer's own table, so no
-    # separate commit scatter exists.
+    # receivers reconstruct (key, pts) from the INV block they already hold;
+    # its shared Valid write (with the vpts ownership check) also covers the
+    # committer's own table, so no separate commit scatter exists.
     commit_lane = jnp.concatenate([commit, rcommit & rowns], axis=1)
     commit_at_slot = jnp.take_along_axis(commit_lane, slot_lane, axis=1)
     out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
@@ -637,20 +658,19 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     return fs._replace(table=table, sess=sess, replay=replay, meta=meta), out_val, comp
 
 
-def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_val: FastVal,
-               in_inv: FastInv):
+def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, val_bits,
+               val_epochs, inv_src: FastInv):
     """VAL apply (SURVEY.md §3.1 tail): ts-matching keys go Valid.  VALs are
-    slot-aligned bits over the same round's INV block; the write lands once
-    in the shared state table ([0] view, see _apply_inv), guarded by the
-    shared arbiter so a VAL whose write was superseded this round is a
-    no-op."""
+    slot-aligned bits ((Rsrc, C)) over the same round's INV block; the write
+    lands once in the shared state table, guarded by the shared arbiter so a
+    VAL whose write was superseded this round is a no-op."""
     table = fs.table
-    key0 = in_inv.key[0]
+    key0 = inv_src.key
     ok0 = (
-        in_val.valid[0]
-        & in_inv.valid[0]
-        & (in_val.epoch[0] == ctl.epoch[0])[..., None]
-        & (in_inv.pts[0] == table.vpts[key0])
+        val_bits
+        & inv_src.valid
+        & (val_epochs == ctl.epoch[0])[..., None]
+        & (inv_src.pts == table.vpts[key0])
     )
     sst = table.sst.at[jnp.where(ok0, key0, table.sst.shape[0])].set(
         pack_sst(ctl.step, jnp.full(key0.shape, t.VALID, jnp.int32)), mode="drop"
@@ -658,40 +678,51 @@ def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, in_val: FastVal,
     return fs._replace(table=table._replace(sst=sst))
 
 
-def fast_round(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream,
-               exchange_inv, exchange_ack, exchange_val):
-    """One full protocol round, parameterized over the exchange primitives
-    (array ops in batched mode, ICI collectives under shard_map)."""
+def fast_round_batched(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
+    """One protocol round, batched lockstep emulation: the broadcast IS the
+    outbound block (every replica sees the same source-shaped tensors), and
+    the ACK bitmap derives from the shared verdicts (_derived_acks) — no
+    exchange ops at all on a single chip."""
     fs, out_inv, slot_lane, lane_elig, read_done = _coordinate(cfg, ctl, fs, stream)
-    in_inv = exchange_inv(out_inv)
-    fs, out_ack = _apply_inv(cfg, ctl, fs, in_inv)
-    in_ack = exchange_ack(out_ack)
-    fs, out_val, comp = _collect_acks(cfg, ctl, fs, in_ack, out_inv, slot_lane,
-                                      lane_elig, read_done)
-    in_val = exchange_val(out_val)
-    fs = _apply_val(cfg, ctl, fs, in_val, in_inv)
+    fs, ack_flags = _apply_inv(cfg, ctl, fs, out_inv)
+    gained_slot, nacked_slot = _derived_acks(ctl, out_inv, ack_flags)
+    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained_slot, nacked_slot,
+                                      slot_lane, lane_elig, read_done)
+    fs = _apply_val(cfg, ctl, fs, out_val.valid, out_val.epoch, out_inv)
+    return fs, comp
+
+
+def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
+    """One protocol round on the mesh (transport=tpu_ici, BASELINE.json:5):
+    INV and VAL blocks ride ``all_gather`` and the ACK verdicts ride
+    ``all_to_all`` over the 'replica' ICI axis."""
+    fs, out_inv, slot_lane, lane_elig, read_done = _coordinate(cfg, ctl, fs, stream)
+    inv_src = jax.tree.map(_ici_gather_src, out_inv)
+    fs, ack_flags = _apply_inv(cfg, ctl, fs, inv_src)
+    gained_slot, nacked_slot = _wire_acks(
+        cfg, ctl, inv_src, ack_flags, out_inv, _ici_route_back
+    )
+    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained_slot, nacked_slot,
+                                      slot_lane, lane_elig, read_done)
+    val_bits = _ici_gather_src(out_val.valid)
+    val_epochs = _ici_gather_src(out_val.epoch)
+    fs = _apply_val(cfg, ctl, fs, val_bits, val_epochs, inv_src)
     return fs, comp
 
 
 # --------------------------------------------------------------------------
-# Batched (single-device) exchanges and step builders
+# Step builders
 # --------------------------------------------------------------------------
 
 
-from hermes_tpu.core.step import lockstep_bcast as _bcast  # noqa: E402  (shared lockstep broadcast)
-
-
-def _route_back(block):
-    """ACK route-back: out[p][q, ...] -> in[q][p, ...].  Per-block scalars
-    (epoch, (R,)) broadcast: every destination sees each sender's value."""
-    r = jax.tree_util.tree_leaves(block)[0].shape[0]
-
-    def one(x):
-        if x.ndim == 1:
-            return jnp.broadcast_to(x[None, :], (r, r))
-        return jnp.swapaxes(x, 0, 1)
-
-    return jax.tree.map(one, block)
+def prep_stream(stream):
+    """Device-place an (R, S, G[, U]) op stream for the fast engines.
+    (A G-major transpose was tried here and measured slower.)"""
+    return st.OpStream(
+        op=jnp.asarray(stream.op),
+        key=jnp.asarray(stream.key),
+        uval=None if stream.uval is None else jnp.asarray(stream.uval),
+    )
 
 
 def make_fast_ctl(cfg: HermesConfig, step: int) -> FastCtl:
@@ -707,7 +738,7 @@ def make_fast_ctl(cfg: HermesConfig, step: int) -> FastCtl:
 
 def build_fast_batched(cfg: HermesConfig, donate: bool = False):
     def step(fs, stream, ctl):
-        return fast_round(cfg, ctl, fs, stream, _bcast, _route_back, _bcast)
+        return fast_round_batched(cfg, ctl, fs, stream)
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
@@ -718,9 +749,8 @@ def build_fast_scan(cfg: HermesConfig, rounds: int, donate: bool = True):
 
     def chunk(fs, stream, ctl):
         def body(carry, off):
-            nxt, _comp = fast_round(
-                cfg, ctl._replace(step=ctl.step + off), carry, stream,
-                _bcast, _route_back, _bcast,
+            nxt, _comp = fast_round_batched(
+                cfg, ctl._replace(step=ctl.step + off), carry, stream
             )
             return nxt, None
 
@@ -735,13 +765,9 @@ def build_fast_scan(cfg: HermesConfig, rounds: int, donate: bool = True):
 # --------------------------------------------------------------------------
 
 
-def _ici_bcast(block):
-    return jax.tree.map(
-        lambda x: jnp.swapaxes(
-            jax.lax.all_gather(x, "replica", axis=0, tiled=False), 0, 1
-        ),
-        block,
-    )
+def _ici_gather_src(x):
+    """Local (1, ...) leaf -> source-shaped (Rsrc, ...) via all_gather."""
+    return jax.lax.all_gather(x[0], "replica", axis=0, tiled=False)
 
 
 def _ici_route_back(block):
@@ -749,10 +775,8 @@ def _ici_route_back(block):
     # in[q][0, p, ...] = p's acks of q's slots.  1-D per-block scalars
     # (epoch, local shape (1,)) ride an all_gather instead.
     def one(x):
-        if x.ndim == 1:
-            return jnp.swapaxes(
-                jax.lax.all_gather(x, "replica", axis=0, tiled=False), 0, 1
-            )
+        if x.ndim == 1:  # per-block epoch, local (1,) -> (1, Rsrc)
+            return jax.lax.all_gather(x[0], "replica", axis=0, tiled=False)[None]
         return jax.lax.all_to_all(x, "replica", split_axis=1, concat_axis=1, tiled=True)
 
     return jax.tree.map(one, block)
@@ -760,8 +784,7 @@ def _ici_route_back(block):
 
 def build_fast_sharded(cfg: HermesConfig, mesh: Mesh, rounds: int = 1,
                        donate: bool = True):
-    """The fast round under shard_map over Mesh(('replica',)): INV/VAL ride
-    all_gather, the ACK route-back all_to_all, over the 'replica' ICI axis."""
+    """The fast round under shard_map over Mesh(('replica',))."""
     if mesh.shape["replica"] != cfg.n_replicas:
         raise ValueError("mesh 'replica' axis must equal cfg.n_replicas")
 
@@ -777,13 +800,11 @@ def build_fast_sharded(cfg: HermesConfig, mesh: Mesh, rounds: int = 1,
         if rounds == 1:
             # single-round driver shape: completions come back (FastRuntime /
             # kvs.py consume them for history recording + client futures)
-            return fast_round(cfg, lctl, fs, stream,
-                              _ici_bcast, _ici_route_back, _ici_bcast)
+            return fast_round_sharded(cfg, lctl, fs, stream)
 
         def body(carry, off):
-            nxt, _comp = fast_round(
-                cfg, lctl._replace(step=lctl.step + off), carry, stream,
-                _ici_bcast, _ici_route_back, _ici_bcast,
+            nxt, _comp = fast_round_sharded(
+                cfg, lctl._replace(step=lctl.step + off), carry, stream
             )
             return nxt, None
 

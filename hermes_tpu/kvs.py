@@ -154,10 +154,11 @@ class KVS:
             self._inflight[rs_key] = (kind, fut)
             self._dirty = True
         if self._dirty:
-            self.rt.stream = st.OpStream(
-                op=jnp.asarray(self._op), key=jnp.asarray(self._key),
-                uval=jnp.asarray(self._uval),
-            )
+            from hermes_tpu.core import faststep as fst
+
+            self.rt.stream = fst.prep_stream(st.OpStream(
+                op=self._op, key=self._key, uval=self._uval,
+            ))
             self._dirty = False
 
         comp = self.rt.step_once()

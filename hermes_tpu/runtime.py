@@ -248,7 +248,7 @@ class FastRuntime:
         # per-replica vals); batched shares one (see faststep.FastTable)
         self.fs = fst.init_fast_state(cfg, n_local=r if backend == "sharded" else None)
         raw = stream if stream is not None else ycsb.make_streams(cfg)
-        self.stream = jax.tree.map(jnp.asarray, raw)
+        self.stream = fst.prep_stream(raw)
 
         self.step_idx = 0
         self.epoch = np.zeros((r,), np.int32)
@@ -320,8 +320,12 @@ class FastRuntime:
             )
             j_sst = fst.pack_sst(jnp.int32(self.step_idx), j_state)
             upd = lambda col, rows: jax.lax.dynamic_update_slice_in_dim(col, rows, dst, 0)
+            # NOTE: the per-replica issue ledger (tbl.pts) is deliberately
+            # NOT transferred — it records the JOINER's own issued (possibly
+            # not-yet-broadcast) writes, which must keep blocking same-key
+            # re-issues after the rejoin (dup-ts guard); the donor's ledger
+            # entries are meaningless to the joiner.
             self.fs = self.fs._replace(table=tbl._replace(
-                pts=upd(tbl.pts, jax.lax.dynamic_slice_in_dim(tbl.pts, dsrc, K)),
                 sst=upd(tbl.sst, j_sst),
                 vpts=upd(tbl.vpts, jax.lax.dynamic_slice_in_dim(tbl.vpts, dsrc, K)),
                 val=upd(tbl.val, jax.lax.dynamic_slice_in_dim(tbl.val, dsrc, K)),

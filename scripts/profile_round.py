@@ -36,7 +36,7 @@ cfg = HermesConfig(
 
 def timed_chunk(round_fn, rounds=30, reps=3):
     fs = jax.device_put(fst.init_fast_state(cfg))
-    stream = jax.device_put(jax.tree.map(jnp.asarray, ycsb.make_streams(cfg)))
+    stream = jax.device_put(fst.prep_stream(ycsb.make_streams(cfg)))
 
     @jax.jit
     def chunk(fs, stream, ctl):
@@ -58,7 +58,7 @@ def timed_chunk(round_fn, rounds=30, reps=3):
 
 
 def full(ctl, fs, stream):
-    nxt, _ = fst.fast_round(cfg, ctl, fs, stream, fst._bcast, fst._route_back, fst._bcast)
+    nxt, _ = fst.fast_round_batched(cfg, ctl, fs, stream)
     return nxt
 
 
@@ -69,8 +69,7 @@ def coordinate_only(ctl, fs, stream):
 
 def through_apply_inv(ctl, fs, stream):
     fs2, out_inv, slot_lane, lane_elig, read_done = fst._coordinate(cfg, ctl, fs, stream)
-    in_inv = fst._bcast(out_inv)
-    fs3, out_ack = fst._apply_inv(cfg, ctl, fs2, in_inv)
+    fs3, _flags = fst._apply_inv(cfg, ctl, fs2, out_inv)
     return fs3
 
 
