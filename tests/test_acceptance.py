@@ -18,3 +18,25 @@ def test_acceptance_config(n):
     assert counters["n_write"] + counters["n_rmw"] > 0
     if n == 2:
         assert counters["n_rmw"] > 0
+
+
+@pytest.mark.parametrize("mix", ["b", "c"])
+def test_ycsb_read_heavy_mixes(mix):
+    """YCSB-B (95/5) and YCSB-C (read-only) round out the reference's
+    workload matrix (SURVEY.md §1 L6); local reads never cross the network,
+    so read-heavy mixes mostly exercise the coordinate fast path."""
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.runtime import FastRuntime
+
+    rf = {"b": 0.95, "c": 1.0}[mix]
+    cfg = HermesConfig(n_replicas=3, n_keys=256, n_sessions=16, replay_slots=4,
+                       ops_per_session=24,
+                       workload=WorkloadConfig(read_frac=rf, seed=70 + ord(mix)))
+    rt = FastRuntime(cfg, record="array")
+    assert rt.drain(400)
+    v = rt.check()
+    assert v.ok
+    c = rt.counters()
+    assert c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"] == 3 * 16 * 24
+    if mix == "c":
+        assert c["n_write"] == 0
