@@ -50,6 +50,7 @@ def main() -> None:
         replay_slots=256,
         ops_per_session=256,
         wrap_stream=True,  # stream cycles; write uids stay unique (config.py)
+        device_stream=True,  # counter-hash op stream (no stream gathers)
         lane_budget_cfg=8192,
         rebroadcast_every=4,
         replay_scan_every=32,
@@ -57,7 +58,7 @@ def main() -> None:
     )
 
     fs = jax.device_put(fst.init_fast_state(cfg))
-    stream = jax.device_put(fst.prep_stream(ycsb.make_streams(cfg)))
+    stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
     chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
 
     def counters(x):

@@ -68,6 +68,12 @@ class HermesConfig:
     # The full-table stuck-key replay scan (SURVEY.md §3.4) runs every this
     # many rounds (it only matters after failures/drops).
     replay_scan_every: int = 8
+    # Generate the op stream ON DEVICE from a counter hash instead of
+    # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
+    # removes the stream-gather ops from the hot round.  Uniform keys only
+    # (n_keys must be a power of two); workload.rmw_frac/read_frac honored;
+    # ycsb.device_stream_host reproduces the exact stream host-side.
+    device_stream: bool = False
 
     workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)
 
@@ -82,6 +88,11 @@ class HermesConfig:
         # Unique write ids are (hi=replica, lo=session*G+op) int32 pairs.
         if self.n_sessions * self.ops_per_session >= 2**31:
             raise ValueError("n_sessions * ops_per_session must fit int32")
+        if self.device_stream:
+            if self.workload.distribution != "uniform":
+                raise ValueError("device_stream supports uniform keys only")
+            if self.n_keys & (self.n_keys - 1):
+                raise ValueError("device_stream needs power-of-two n_keys")
 
     @property
     def full_mask(self) -> int:

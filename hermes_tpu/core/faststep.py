@@ -313,8 +313,27 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     else:
         can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~frozen
         g = jnp.clip(sess.op_idx, 0, G - 1)
-    new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
-    new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
+    if cfg.device_stream:
+        # counter-hash op stream (SURVEY.md §2 "in-kernel PRNG"): ONE shared
+        # formula with the host twin (workload.ycsb.stream_hash)
+        from hermes_tpu.workload.ycsb import device_stream_params, stream_hash
+
+        read_t, rmw_t = device_stream_params(cfg)
+        import numpy as _np
+
+        u_op, u_rmw, hkey = stream_hash(
+            cfg,
+            ctl.my_cid[:, None].astype(jnp.uint32),
+            jnp.arange(S, dtype=jnp.uint32)[None, :],
+            sess.op_idx.astype(jnp.uint32),
+        )
+        new_op = jnp.where(u_op < _np.uint32(read_t), t.OP_READ,
+                           jnp.where(u_rmw < _np.uint32(rmw_t), t.OP_RMW,
+                                     t.OP_WRITE)).astype(jnp.int32)
+        new_key = hkey.astype(jnp.int32)
+    else:
+        new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
+        new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
     new_val = _write_value(cfg, ctl.my_cid, sess.op_idx)
     if stream.uval is not None:
         # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry the

@@ -75,6 +75,9 @@ class KVS:
                  record: bool = False):
         if cfg.value_words < 3:
             raise ValueError("KVS needs value_words >= 3 (2 uid words + payload)")
+        if cfg.device_stream:
+            raise ValueError("KVS drives ops through the stream; device_stream "
+                             "would replace client requests with hash-generated ops")
         # One-deep, rewritable stream: wrap_stream makes idle sessions reload
         # slot op_idx % 1 == 0 every round, so the host can inject ops by
         # rewriting the (R, S, 1) stream between rounds.

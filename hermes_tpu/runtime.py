@@ -247,7 +247,14 @@ class FastRuntime:
         # sharded: every shard owns its own value table (n_local allocates
         # per-replica vals); batched shares one (see faststep.FastTable)
         self.fs = fst.init_fast_state(cfg, n_local=r if backend == "sharded" else None)
-        raw = stream if stream is not None else ycsb.make_streams(cfg)
+        if cfg.device_stream:
+            if stream is not None:
+                raise ValueError(
+                    "device_stream generates ops on device; a caller-supplied "
+                    "op stream would be silently ignored")
+            raw = ycsb.stub_stream(cfg)
+        else:
+            raw = stream if stream is not None else ycsb.make_streams(cfg)
         self.stream = fst.prep_stream(raw)
 
         self.step_idx = 0

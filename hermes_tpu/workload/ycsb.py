@@ -67,3 +67,61 @@ def make_streams(cfg: HermesConfig) -> st.OpStream:
         op=np.stack([p.op for p in parts]),
         key=np.stack([p.key for p in parts]),
     )
+
+
+# --------------------------------------------------------------------------
+# Device-side stream (SURVEY.md §2 "in-kernel PRNG"): the op stream as a
+# stateless counter hash, identical on device (core/faststep._coordinate)
+# and host (this twin, used by tests and any checker bootstrap).
+# --------------------------------------------------------------------------
+
+def _mix32(x):
+    """xxhash-style avalanche on uint32 (works for numpy and jax arrays;
+    constants as numpy scalars so jax does not weak-type-promote)."""
+    c1, c2 = np.uint32(0x7FEB352D), np.uint32(0x846CA68B)
+    s16, s15 = np.uint32(16), np.uint32(15)
+    x = (x ^ (x >> s16)) * c1
+    x = (x ^ (x >> s15)) * c2
+    return x ^ (x >> s16)
+
+
+def device_stream_params(cfg: HermesConfig):
+    """Thresholds the hash is compared against (16-bit fixed point)."""
+    wl = cfg.workload
+    read_t = int(wl.read_frac * 65536)
+    rmw_t = int(wl.rmw_frac * 65536)
+    return read_t, rmw_t
+
+
+def stream_hash(cfg: HermesConfig, replica, session, op_idx):
+    """The counter-hash op stream, backend-agnostic: works on numpy AND jax
+    uint32 arrays (pure ^ * >> & arithmetic), so the device engine
+    (core/faststep._coordinate) and the host twin call ONE implementation —
+    the two cannot drift.  Returns (u_op, u_rmw, key) as uint32."""
+    seed_mixed = np.uint32((cfg.workload.seed * 0x9E3779B9) & 0xFFFFFFFF)
+    base = _mix32(seed_mixed ^ _mix32(
+        replica * np.uint32(0x85EBCA6B)
+        ^ _mix32(session * np.uint32(0xC2B2AE35) ^ op_idx)))
+    u_op = base & np.uint32(0xFFFF)
+    u_rmw = (base >> np.uint32(16)) & np.uint32(0xFFFF)
+    key = _mix32(base ^ np.uint32(0x27220A95)) & np.uint32(cfg.n_keys - 1)
+    return u_op, u_rmw, key
+
+
+def device_stream_host(cfg: HermesConfig, replica, session, op_idx):
+    """Host twin of the device stream: (op, key) for broadcastable uint32
+    index arrays (numpy)."""
+    read_t, rmw_t = device_stream_params(cfg)
+    with np.errstate(over="ignore"):
+        u_op, u_rmw, key = stream_hash(
+            cfg, np.uint32(replica), np.uint32(session), np.uint32(op_idx))
+    op = np.where(u_op < read_t, t.OP_READ,
+                  np.where(u_rmw < rmw_t, t.OP_RMW, t.OP_WRITE)).astype(np.int32)
+    return op, key.astype(np.int64).astype(np.int32)
+
+
+def stub_stream(cfg: HermesConfig) -> st.OpStream:
+    """Placeholder stream for device_stream runs (the arrays are never
+    read; keeps step signatures uniform)."""
+    z = np.zeros((cfg.n_replicas, cfg.n_sessions, 1), np.int32)
+    return st.OpStream(op=z, key=z)

@@ -190,3 +190,40 @@ def test_commit_during_backoff_after_membership_change():
         assert (status[r] == t.S_DONE).all()
     sst = get(rt.fs.table.sst)  # shared (K,) in batched mode
     assert ((sst & 7) == t.VALID).all()
+
+
+def test_device_stream_matches_host_twin_and_checks():
+    """The on-device counter-hash workload (cfg.device_stream) must be
+    bit-identical to its host twin and pass the checker end to end."""
+    from hermes_tpu.workload import ycsb
+
+    R, S, G = 3, 8, 16
+    cfg = HermesConfig(
+        n_replicas=R, n_keys=256, n_sessions=S, replay_slots=4, ops_per_session=G,
+        device_stream=True, workload=WorkloadConfig(read_frac=0.5, rmw_frac=0.0, seed=5),
+    )
+    rt = FastRuntime(cfg, record=True)
+    assert rt.drain(400)
+    assert rt.check().ok
+    c = rt.counters()
+    assert c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"] == R * S * G
+
+    # bit-identity: with rmw_frac=0 (no aborts) every op completes and is
+    # recorded, so each session's recorded (kind, key) sequence must equal
+    # the host twin's for g = 0..G-1
+    r, s, g = np.meshgrid(np.arange(R), np.arange(S), np.arange(G), indexing="ij")
+    top, tkey = ycsb.device_stream_host(
+        cfg, r.astype(np.uint32), s.astype(np.uint32), g.astype(np.uint32))
+    kind_of = {t.OP_READ: "r", t.OP_WRITE: "w"}
+    by_sess = {}
+    for o in rt.history_ops():
+        by_sess.setdefault((o.replica, o.session), []).append(o)
+    checked = 0
+    for (rr, ss), ops in by_sess.items():
+        ops.sort(key=lambda o: o.inv)
+        assert len(ops) == G
+        for gg, o in enumerate(ops):
+            assert o.key == int(tkey[rr, ss, gg]), (rr, ss, gg)
+            assert o.kind == kind_of[int(top[rr, ss, gg])], (rr, ss, gg)
+            checked += 1
+    assert checked == R * S * G
