@@ -469,7 +469,10 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
     rot = (lane_idx + step * 127) % L  # rotating tie-break
     prio = jnp.where(lane_elig, rot, L + rot)
-    if L < (1 << 15):
+    if C == L:
+        # budget covers every lane: slots ARE lanes, no compaction sort
+        slot_lane = lane_idx
+    elif L < (1 << 15):
         # single-operand sort: pack (prio, lane) into one word — one sort
         # buffer instead of two, fewer layout copies
         packed = jax.lax.sort((prio << 15) | lane_idx, dimension=1)
