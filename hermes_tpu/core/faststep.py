@@ -5,10 +5,12 @@ coordinate -> INV -> apply_inv -> ACK -> collect_acks -> VAL -> apply_val,
 function roles per BASELINE.json:5), re-engineered for the measured cost
 model of the target TPU runtime:
 
-  * every XLA fusion/kernel launch costs ~1.4 ms through the tunneled PJRT
-    runtime, so the round is built from the FEWEST possible ops;
-  * scatters cost ~4 ns/word and gathers ~0.5 ns/word regardless of table
-    size, so message volume (not key count) is the data cost;
+  * every XLA fusion/kernel launch costs ~0.3-1.4 ms through the tunneled
+    PJRT runtime (measured: sequential unfusable stages at session scale are
+    ~1.1 ms EACH, nearly independent of data size), so the round is built
+    from the FEWEST possible chained kernels;
+  * scatters cost ~4-6 ns/word and gathers ~1-3 ns/word beyond their fixed
+    launch cost, so message volume (not key count) is the data cost;
   * dense K-sized passes are cheap in bandwidth but each op pays the launch
     tax, so the common path touches the key-state table ONLY through
     gathers/scatters — no full-table passes outside the (gated) replay scan.
@@ -28,17 +30,26 @@ The key engineering moves, mapped to the reference:
   2. **Packed state+age** ``sst = (last_change_step << 3) | state``: the
      per-key state machine word and the replay age (SURVEY.md §3.4) travel
      in one scatter.
-  3. **Lane compaction with rebroadcast backoff**: outbound INV lanes
+  3. **One fused key-state row** ``kv = [vpts | sst | val]`` (K, 2+V): the
+     authoritative per-key columns live in ONE array, so the session-side
+     read (arbiter ts + Valid check + read value) is ONE gather, and the
+     winner apply (state + value) is ONE scatter.  The round writes each
+     key's final state ONCE: the commit decision is made before the table
+     write, so a winner lands directly as VALID (committed this round) or
+     INVALID (awaiting acks) — the reference's separate apply_inv/apply_val
+     table writes collapse into a single scatter (the VAL message itself
+     still exists: slot bits, see FastVal).
+  4. **Lane compaction with rebroadcast backoff**: outbound INV lanes
      (sessions + replay slots, SURVEY.md §1 L1 "batching") compact to a
      fixed budget C per round, rotating priority so no lane starves; lanes
      already waiting on acks re-broadcast only every ``rebroadcast_every``
      rounds.  Overflowing lanes simply wait a round — re-broadcast of the
      same-ts INV is idempotent, so backpressure is free (SURVEY.md §7 hard
      part 2).
-  4. **Replay scan gating**: the full-table stuck-key scan runs under
+  5. **Replay scan gating**: the full-table stuck-key scan runs under
      ``lax.cond`` every ``replay_scan_every`` rounds (it only matters after
      failures; BASELINE.json:10).
-  5. **No vmap**: the body is written with an explicit leading replica axis
+  6. **No vmap**: the body is written with an explicit leading replica axis
      and flat global scatter/gather indices, so the same code runs batched
      (R replicas on one chip, the reference's single-process test mode,
      BASELINE.json:7) and under shard_map (1 replica per chip over the
@@ -69,6 +80,11 @@ from hermes_tpu.core import types as t
 PTS_FC_BITS = 10  # fc = (flag << 8) | cid fits 10 bits (flag 2b, cid 8b)
 FC_MASK = (1 << PTS_FC_BITS) - 1
 I32_MIN = jnp.iinfo(jnp.int32).min
+
+# kv row layout (FastTable.kv): [vpts | sst | val words]
+KV_VPTS = 0
+KV_SST = 1
+KV_VAL = 2
 
 
 def pack_pts(ver, fc):
@@ -105,25 +121,43 @@ class FastTable(NamedTuple):
 
     Lockstep sharing (measured to dominate the bench; soundness arguments in
     _apply_inv/_coordinate): all replicas of a shard receive the identical
-    INV/VAL blocks each round, so the authoritative per-key state —
-    ``vpts`` (max applied packed-ts, the Lamport conflict arbiter), ``sst``
-    (packed (age_step << 3) | state), ``val`` (value words) — is stored ONCE
-    per shard (shape (K,)/(K, V) batched; per-chip in sharded mode, where a
-    chip IS one replica and the same body runs with a local view).  Two
-    replicas can only disagree on these cells while at least one holds the
-    key un-readable, so reads stay correct (see _apply_inv).
+    INV/VAL blocks each round, so the authoritative per-key state lives in
+    ONE fused array ``kv`` of shape (K, 2+V) (per-shard in sharded mode,
+    where a chip IS one replica and the same body runs with a local view):
 
-    ``pts`` is the only per-replica column — the ISSUE LEDGER (R*K, flat
-    global indexing): each replica records the packed ts of its own issued
-    writes there so a budget-deferred (not-yet-broadcast) write still forces
-    the next same-key issue on that replica to a strictly higher version.
-    It is written only at issue time and read only by the issue path.
+      kv[:, 0] — ``vpts``: max applied packed-ts, the Lamport conflict
+                 arbiter (one scatter-max per round);
+      kv[:, 1] — ``sst``: packed (age_step << 3) | state;
+      kv[:, 2:] — ``val``: the value words.
+
+    The fused row means the session read path (arbiter + Valid check + read
+    value) is one gather and the winner apply (state + value) one scatter —
+    the dominant cost on this runtime is chained kernel count, not bytes.
+    Two replicas can only disagree on these cells while at least one holds
+    the key un-readable, so reads stay correct (see _apply_inv).
+
+    There is NO per-replica issue ledger: an issue either broadcasts in its
+    own round (winning a compaction slot — fresh issues that miss the budget
+    REVERT and retry next round, see _coordinate) or does not happen, so its
+    INV invalidates the key immediately and the plain Valid check blocks any
+    same-key re-issue until the write resolves.  No deferred-write window
+    exists, hence no dup-ts guard table, no ledger scatter on the hot path.
     """
 
-    pts: jnp.ndarray  # (R*K,) per-replica issue ledger
-    sst: jnp.ndarray  # (K,) batched / (R*K,) sharded-global
-    vpts: jnp.ndarray  # (K,) batched / (R*K,) sharded-global
-    val: jnp.ndarray  # (K, V) batched / (R*K, V) sharded-global
+    kv: jnp.ndarray  # (K, 2+V) batched / (R*K, 2+V) sharded-global
+
+    # Read-only column views (tests/tools; traced code slices kv directly).
+    @property
+    def vpts(self):
+        return self.kv[:, KV_VPTS]
+
+    @property
+    def sst(self):
+        return self.kv[:, KV_SST]
+
+    @property
+    def val(self):
+        return self.kv[:, KV_VAL:]
 
 
 class FastSess(NamedTuple):
@@ -152,10 +186,15 @@ class FastReplay(NamedTuple):
 
 class FastInv(NamedTuple):
     """Compacted INV block.  Outbound (R, C, ...); inbound (R, Rsrc, C, ...).
-    ``epoch``/``alive`` are per-block scalars (a replica's whole batch shares
-    one epoch — SURVEY.md §1 L4)."""
+    ``fresh`` marks first-broadcast slots (a NEW timestamp — unique per
+    (key, ts), since only the issuing session ever broadcasts a ts for the
+    first time); re-broadcast slots carry a ts whose row the table already
+    holds.  _apply_commit uses this to keep its one set-scatter free of
+    conflicting duplicate rows.  ``epoch``/``alive`` are per-block scalars
+    (a replica's whole batch shares one epoch — SURVEY.md §1 L4)."""
 
     valid: jnp.ndarray
+    fresh: jnp.ndarray
     key: jnp.ndarray
     pts: jnp.ndarray
     val: jnp.ndarray  # (..., C, V)
@@ -198,12 +237,12 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
     recognizable initial value (lo=key, hi=-1) (state.init_table)."""
     r = cfg.n_replicas if n_local is None else n_local
     k, s, rs, v = cfg.n_keys, cfg.n_sessions, cfg.replay_slots, cfg.value_words
-    # batched mode shares the authoritative tables across the shard's
+    # batched mode shares the authoritative table across the shard's
     # replicas; sharded init (n_local=r) allocates one set per future shard
     nv = 1 if n_local is None else r
-    val = jnp.zeros((nv * k, v), jnp.int32)
-    val = val.at[:, 0].set(jnp.tile(jnp.arange(k, dtype=jnp.int32), nv))
-    val = val.at[:, 1].set(-1)
+    kv = jnp.zeros((nv * k, 2 + v), jnp.int32)
+    kv = kv.at[:, KV_VAL].set(jnp.tile(jnp.arange(k, dtype=jnp.int32), nv))
+    kv = kv.at[:, KV_VAL + 1].set(-1)
     z = lambda *sh: jnp.zeros(sh, jnp.int32)
     meta = st.Meta(
         last_seen=z(r, cfg.n_replicas),
@@ -216,7 +255,7 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
         lat_hist=z(r, st.LAT_BINS),
     )
     return FastState(
-        table=FastTable(pts=z(r * k), sst=z(nv * k), vpts=z(nv * k), val=val),
+        table=FastTable(kv=kv),
         sess=FastSess(
             status=z(r, s), op=z(r, s), op_idx=z(r, s), key=z(r, s),
             val=z(r, s, v), pts=z(r, s), acks=z(r, s),
@@ -249,22 +288,6 @@ def _gkey(col, key, mask=None):
     if mask is not None:
         g = jnp.where(mask, g, col.shape[0])
     return g
-
-
-def _fgather(col, key):
-    """Gather flat col (R*K,) at per-replica keys (R, ...) -> key-shaped."""
-    return col[_gkey(col, key)]
-
-
-def _fscatter(col, key, val, mask):
-    """Masked set-scatter into flat col (R*K[, V])."""
-    return col.at[_gkey(col, key, mask)].set(val, mode="drop")
-
-
-def _fscatter_max(col, key, val, mask):
-    """Masked max-scatter — the Lamport conflict resolution (max timestamp
-    wins) as one atomic op on the packed-ts column."""
-    return col.at[_gkey(col, key, mask)].max(val, mode="drop")
 
 
 # --------------------------------------------------------------------------
@@ -359,15 +382,14 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
 
     # --- reads + issue -----------------------------------------------------
-    k_led = _fgather(table.pts, sess.key)  # my issue ledger
-    k_vpts = table.vpts[sess.key]  # shared arbiter (plain key indexing)
-    k_valid = sst_state(table.sst[sess.key]) == t.VALID
-    # a ledger entry above the shared arbiter = my own not-yet-broadcast
-    # write: block further same-key issues until it ships (dup-ts guard)
-    pending_local = k_led > k_vpts
+    # ONE gather serves the whole session read path: arbiter ts (vpts),
+    # Valid check (sst), and the read value all live in the fused kv row.
+    krow = table.kv[sess.key]  # (R, S, 2+V) shared authoritative row
+    k_vpts = krow[..., KV_VPTS]
+    k_valid = sst_state(krow[..., KV_SST]) == t.VALID
+    rd_val = krow[..., KV_VAL:]
 
     read_done = (sess.status == t.S_READ) & k_valid & ~frozen
-    rd_val = table.val[sess.key]  # shared value table: plain key indexing
     sess = sess._replace(
         status=jnp.where(read_done, t.S_IDLE, sess.status),
         op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
@@ -377,7 +399,10 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # Same-key same-replica issue arbitration via a small hash-slot race:
     # colliding sessions (same slot) defer to the lowest index; a false
     # collision (different keys, same slot) only delays an issue one round.
-    want = (sess.status == t.S_ISSUE) & k_valid & ~pending_local & ~frozen
+    # An issue requires the key VALID: any in-flight same-key write (its INV
+    # applies the round it issues — see the revert rule below) holds the key
+    # un-readable, so no duplicate-ts window exists.
+    want = (sess.status == t.S_ISSUE) & k_valid & ~frozen
     HS = cfg.arb_slots
     h = sess.key & (HS - 1)
     idxs = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (R, S))
@@ -387,22 +412,8 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
 
     flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
     fc = (flag << 8) | ctl.my_cid[:, None]
-    new_pts = pack_pts(jnp.maximum(pts_ver(k_led), pts_ver(k_vpts)) + 1, fc)
+    new_pts = pack_pts(pts_ver(k_vpts) + 1, fc)
     old_val = rd_val  # RMW read-part observes the pre-issue value
-
-    # Issue records only the ledger entry; state+value land via the
-    # broadcast INV in _apply_inv (the block includes self) — idempotent
-    # for re-broadcasts (SURVEY.md §3.4).
-    table = table._replace(
-        pts=_fscatter_max(table.pts, sess.key, new_pts, win),
-    )
-    is_rmw_issue = win & (sess.op == t.OP_RMW)
-    sess = sess._replace(
-        status=jnp.where(win, t.S_INFL, sess.status),
-        pts=jnp.where(win, new_pts, sess.pts),
-        acks=jnp.where(win, 0, sess.acks),
-        rd_val=jnp.where(is_rmw_issue[..., None], old_val, sess.rd_val),
-    )
 
     # --- replay scan, cond-gated (SURVEY.md §3.4; only matters after
     # failures, so it runs every replay_scan_every rounds) ------------------
@@ -412,7 +423,7 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         # same-ts re-INVs are idempotent (SURVEY.md §3.4), and any live
         # replica alone suffices to finish a dead coordinator's write.
         table, replay = args
-        sstK = table.sst.reshape(1, -1)  # (1, nv*K): top_k wants a batch dim
+        sstK = table.kv[:, KV_SST].reshape(1, -1)  # (1, nv*K): top_k wants a batch dim
         age = step - sst_step(sstK)
         state = sst_state(sstK)
         # REPLAY is included: the shared mark means SOME replica snapshotted
@@ -436,17 +447,18 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             jnp.pad(cand_ok, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1
         )
         ck = jnp.take_along_axis(jnp.pad(cand, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1)
+        ckrow = table.kv[ck]  # (R, RS, 2+V): snapshot pts + value in one gather
         new_replay = FastReplay(
             active=jnp.where(take_ok, True, replay.active),
             key=jnp.where(take_ok, ck, replay.key),
-            pts=jnp.where(take_ok, table.vpts[ck], replay.pts),
-            val=jnp.where(take_ok[..., None], table.val[ck], replay.val),
+            pts=jnp.where(take_ok, ckrow[..., KV_VPTS], replay.pts),
+            val=jnp.where(take_ok[..., None], ckrow[..., KV_VAL:], replay.val),
             acks=jnp.where(take_ok, 0, replay.acks),
         )
-        new_sst = table.sst.at[jnp.where(take_ok, ck, table.sst.shape[0])].set(
-            pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32)), mode="drop"
-        )
-        return table._replace(sst=new_sst), new_replay
+        new_kv = table.kv.at[
+            jnp.where(take_ok, ck, table.kv.shape[0]), KV_SST
+        ].set(pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32)), mode="drop")
+        return table._replace(kv=new_kv), new_replay
 
     table, replay = jax.lax.cond(
         step % cfg.replay_scan_every == 0,
@@ -456,38 +468,70 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
 
     # --- outbound INV compaction (SURVEY.md §7 hard part 2) ---------------
-    # Lanes: sessions 0..S-1, replay slots S..L-1.  Eligible lanes: fresh
-    # issues always; waiting lanes every rebroadcast_every rounds; replay
-    # slots always.  Priority rotates with the step so no lane starves.
+    # Lanes: sessions 0..S-1, replay slots S..L-1.  Waiting (rebroadcast)
+    # and replay lanes take priority band 0 — they are few in steady state
+    # and must not starve behind fresh bursts; fresh issues fill band 1.  A
+    # fresh issue that misses the budget REVERTS (the session stays S_ISSUE
+    # and retries next round): a write that happens always broadcasts — and
+    # therefore applies — in its own round, which is what lets the engine
+    # run without an issue-ledger table (see FastTable).  Priority rotates
+    # with the step so no lane starves within its band.
     L, C = cfg.n_lanes, cfg.lane_budget
-    infl = sess.status == t.S_INFL
-    fresh = win
-    waiting = infl & ~fresh
+    infl = sess.status == t.S_INFL  # in-flight from earlier rounds
     backoff_ok = (step - sess.invoke_step) % cfg.rebroadcast_every == 0
-    sess_elig = (fresh | (waiting & backoff_ok)) & ~frozen
+    waiting = infl & backoff_ok
+    sess_elig = (win | waiting) & ~frozen
+    fresh_s = win & ~frozen
     lane_elig = jnp.concatenate([sess_elig, replay.active & ~frozen], axis=1)
+    lane_fresh = jnp.concatenate(
+        [fresh_s, jnp.zeros_like(replay.active)], axis=1
+    )
     lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
     rot = (lane_idx + step * 127) % L  # rotating tie-break
-    prio = jnp.where(lane_elig, rot, L + rot)
+    prio = jnp.where(
+        lane_elig, jnp.where(lane_fresh, L + rot, rot), 2 * L + rot
+    )
     if C == L:
         # budget covers every lane: slots ARE lanes, no compaction sort
         slot_lane = lane_idx
-    elif L < (1 << 15):
+        taken_lane = lane_elig
+    elif 3 * L <= (1 << 16):
         # single-operand sort: pack (prio, lane) into one word — one sort
-        # buffer instead of two, fewer layout copies
-        packed = jax.lax.sort((prio << 15) | lane_idx, dimension=1)
+        # buffer instead of two, fewer layout copies.  prio < 3L and
+        # lane < L <= 2^15, so (prio << 15) | lane stays positive int32.
+        # Which lanes hold a slot falls out of a THRESHOLD test against the
+        # C-th smallest packed priority (packed values are unique) — no
+        # inverse scatter.
+        packed_own = (prio << 15) | lane_idx
+        packed = jax.lax.sort(packed_own, dimension=1)
         slot_lane = packed[:, :C] & ((1 << 15) - 1)  # (R, C) lane id per slot
+        taken_lane = lane_elig & (packed_own <= packed[:, C - 1 : C])
     else:
         _, perm = jax.lax.sort((prio, lane_idx), dimension=1, num_keys=1,
                                is_stable=True)
         slot_lane = perm[:, :C]
+        tk = jnp.zeros((R * L,), jnp.int32)
+        taken_slot = jnp.take_along_axis(lane_elig, slot_lane, axis=1)
+        tk = tk.at[_gkey(tk, slot_lane, taken_slot)].max(1, mode="drop")
+        taken_lane = tk.reshape(R, L) != 0
+
+    # fresh issues that won arbitration AND hold a slot actually happen;
+    # the rest revert (stay S_ISSUE) and retry next round
+    win_eff = win & taken_lane[:, :S]
+    is_rmw_issue = win_eff & (sess.op == t.OP_RMW)
+    sess = sess._replace(
+        status=jnp.where(win_eff, t.S_INFL, sess.status),
+        pts=jnp.where(win_eff, new_pts, sess.pts),
+        acks=jnp.where(win_eff, 0, sess.acks),
+        rd_val=jnp.where(is_rmw_issue[..., None], old_val, sess.rd_val),
+    )
 
     pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
     pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
     pend_val = jnp.concatenate([sess.val, replay.val], axis=1)
-    taken = jnp.take_along_axis(lane_elig, slot_lane, axis=1)
     out_inv = FastInv(
-        valid=taken,
+        valid=jnp.take_along_axis(taken_lane, slot_lane, axis=1),
+        fresh=jnp.take_along_axis(lane_fresh, slot_lane, axis=1),
         key=jnp.take_along_axis(pend_key, slot_lane, axis=1),
         pts=jnp.take_along_axis(pend_pts, slot_lane, axis=1),
         val=jnp.take_along_axis(
@@ -498,7 +542,7 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
 
     fs = fs._replace(table=table, sess=sess, replay=replay)
-    return fs, out_inv, slot_lane, lane_elig, read_done
+    return fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done
 
 
 def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv):
@@ -506,61 +550,121 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv)
     block ``inv_src`` (fields (Rsrc, C); epoch/alive (Rsrc,)): per-key winner
     + stale-drop + idempotent re-apply via one scatter-max on the packed ts.
 
-    All table writes go to the SHARED columns (see FastTable).  Soundness of
-    sharing under lockstep: a key Valid at ts p on any replica means no
-    broadcast INV ever exceeded p (it would have invalidated that replica
-    too), so the shared cells — arbitrated by the vpts scatter-max — hold
-    exactly ts p's value and state when read through a Valid check.  The
-    returned ``ack_flags`` (Rsrc, C) are the shared conflict verdicts (the
-    ACK ok bit): conflicts among broadcast writes are global facts, and the
-    write-flag tiebreak (types.FLAG_*) guarantees a same-version plain write
-    beats any concurrent RMW, which makes the shared verdict equivalent to
-    per-replica evaluation.  Epochs are uniform across a shard's replicas
-    (FastRuntime bumps them together).  (The reference phases engine keeps
-    the fuller per-replica Write/Trans bookkeeping.)"""
-    table = fs.table
-    step = ctl.step
+    Arbitration ONLY — the winner's state+value table write is deferred to
+    ``_apply_commit`` at the end of the round, once the commit decision is
+    known, so each key row is written once per round (fused [sst|val]
+    scatter) instead of the reference's separate apply_inv/apply_val writes.
 
+    Soundness of the shared table under lockstep: a key Valid at ts p on any
+    replica means no broadcast INV ever exceeded p (it would have
+    invalidated that replica too), so the shared cells — arbitrated by the
+    vpts scatter-max — hold exactly ts p's value and state when read through
+    a Valid check.  The returned ``ack_flags`` (Rsrc, C) are the shared
+    conflict verdicts (the ACK ok bit): conflicts among broadcast writes are
+    global facts, and the write-flag tiebreak (types.FLAG_*) guarantees a
+    same-version plain write beats any concurrent RMW, which makes the
+    shared verdict equivalent to per-replica evaluation.  Epochs are uniform
+    across a shard's replicas (FastRuntime bumps them together).  (The
+    reference phases engine keeps the fuller per-replica Write/Trans
+    bookkeeping.)"""
+    fs = _apply_inv_arb(cfg, ctl, fs, inv_src)
     key0, pts0 = inv_src.key, inv_src.pts
     v_ok = inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
-    oob = table.vpts.shape[0]
-    vpts_col = table.vpts.at[jnp.where(v_ok, key0, oob)].max(pts0, mode="drop")
-    post0 = vpts_col[key0]
+    post0 = fs.table.kv[key0, KV_VPTS]
     win0 = v_ok & (pts0 == post0)
-    table = table._replace(
-        vpts=vpts_col,
-        val=table.val.at[jnp.where(win0, key0, oob)].set(inv_src.val, mode="drop"),
-        sst=table.sst.at[jnp.where(win0, key0, oob)].set(
-            pack_sst(step, jnp.full(key0.shape, t.INVALID, jnp.int32)), mode="drop"),
-    )
     ack_flags = pts0 == post0  # (Rsrc, C): ok bit for every slot of every source
+    return fs, ack_flags, win0
 
+
+def _apply_inv_arb(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
+                   inv_src: FastInv):
+    """Batched-mode ``apply_inv``: the vpts scatter-max ONLY.  Verdicts
+    (win/ack/nack) are derived per LANE afterwards from a single vpts gather
+    (_derived_acks) — gathers are near-free on this runtime while the
+    per-slot post0 gather + slot->lane scatter of the wire path are not."""
+    table = fs.table
+    key0, pts0 = inv_src.key, inv_src.pts
+    v_ok = inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
+    oob = table.kv.shape[0]
+    kv = table.kv.at[jnp.where(v_ok, key0, oob), KV_VPTS].max(pts0, mode="drop")
     meta = fs.meta._replace(
         last_seen=jnp.where(
-            inv_src.alive[None, :] & ~ctl.frozen[:, None], step, fs.meta.last_seen
+            inv_src.alive[None, :] & ~ctl.frozen[:, None], ctl.step,
+            fs.meta.last_seen,
         )
     )
-    return fs._replace(table=table, meta=meta), ack_flags
+    return fs._replace(table=table._replace(kv=kv), meta=meta)
 
 
-def _derived_acks(ctl: FastCtl, out_inv: FastInv, ack_flags):
-    """Lockstep-batched ACK derivation — the quorum bitmap without the wire.
+def _apply_commit(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
+                  inv_src: FastInv, win0, val_bits, val_epochs):
+    """The round's single table write (replaces the reference's separate
+    apply_inv value write + apply_val state write): every winning INV slot
+    lands its [sst | val] row in one scatter, with the state chosen by the
+    slot's VAL bit — VALID if its write committed this round (SURVEY.md §3.1
+    tail), INVALID if it is still gathering acks.  A superseded slot (not
+    win0) writes nothing: its key belongs to the higher-ts winner, whose own
+    VAL will validate it.
+
+    Duplicate (key, ts) slots (a still-in-flight session lane plus replay
+    snapshots of the same write, possibly on every replica) could disagree
+    on the VAL bit within one round, and XLA scatter order for duplicate
+    indices is unspecified — so the write mask admits only rows that are
+    deterministic under duplication: FRESH slots (first broadcast of a ts —
+    unique per (key, ts) by construction, see FastInv.fresh) write their
+    row with their own verdict, while re-broadcast winners write ONLY when
+    committing (all committing duplicates produce the identical VALID row;
+    non-committing re-broadcasts are no-ops — the table already holds this
+    ts's value, and a key VALID at this ts stays readable: VALID means the
+    ts committed somewhere, so an idempotent re-INV need not re-invalidate).
+
+    The scatter writes the FULL kv row — including vpts, which for a winner
+    is exactly pts0 (it won the scatter-max and nothing raises vpts later in
+    the round), so the rewrite is value-identical.  Full-row windows are the
+    fast TPU scatter path; an offset window ([rows, 1:]) was measured 50x
+    slower (249 ms vs 5 ms at bench shape)."""
+    table = fs.table
+    key0 = inv_src.key
+    vbit = val_bits & (val_epochs == ctl.epoch[0])[..., None]
+    state_new = jnp.where(vbit, t.VALID, t.INVALID)
+    sstv = pack_sst(ctl.step, state_new)
+    upd = jnp.concatenate(
+        [inv_src.pts[..., None], sstv[..., None], inv_src.val], axis=-1
+    )  # (..., 2+V): [vpts | sst | val]
+    write0 = win0 & (inv_src.fresh | vbit)
+    rows = jnp.where(write0, key0, table.kv.shape[0])
+    kv = table.kv.at[rows].set(upd, mode="drop")
+    return fs._replace(table=table._replace(kv=kv))
+
+
+def _derived_acks(ctl: FastCtl, table: FastTable, taken_lane, pend_key,
+                  pend_pts):
+    """Lockstep-batched ACK derivation — the quorum bitmap without the wire,
+    computed per LANE (no slot->lane scatter).
 
     In the batched emulation every replica computes the identical shared
-    conflict verdict (ack_flags row r = the flags for replica r's slots),
-    and an acker's only per-replica contribution is its aliveness, so the
-    gathered-ack bitmap for a valid slot is exactly the alive-replica mask.
-    Failure injection stays faithful: frozen replicas contribute no bits,
-    and membership changes act through the live_mask quorum test as always.
-    (The sharded engine keeps the real ACK collective — on a mesh the
-    verdicts genuinely travel.)"""
-    R, C = out_inv.valid.shape
+    conflict verdict, and an acker's only per-replica contribution is its
+    aliveness, so the gathered-ack bitmap for a broadcast lane is exactly
+    the alive-replica mask.  The conflict verdict for a lane is read
+    straight off the post-scatter arbiter: its pts survived iff it still
+    equals vpts[key] — ONE (R, L) gather replaces the wire path's per-slot
+    post0 gather AND the slot->lane ack scatter.  Failure injection stays
+    faithful: frozen replicas contribute no bits, and membership changes
+    act through the live_mask quorum test as always.  (The sharded engine
+    keeps the real ACK collective — on a mesh the verdicts genuinely
+    travel.)
+
+    Returns (gained, nacked, win_lane, post_lane), all (R, L)."""
+    R = taken_lane.shape[0]
     abits = jnp.sum(
         jnp.where(~ctl.frozen, jnp.int32(1) << jnp.arange(R, dtype=jnp.int32), 0)
     ).astype(jnp.int32)
-    gained_slot = jnp.where(out_inv.valid, abits, 0)
-    nacked_slot = out_inv.valid & ~ack_flags & (abits != 0)
-    return gained_slot, nacked_slot
+    post_lane = table.kv[pend_key, KV_VPTS]  # (R, L) post-scatter arbiter
+    survived = post_lane == pend_pts
+    gained = jnp.where(taken_lane, abits, 0)
+    nacked = taken_lane & ~survived & (abits != 0)
+    win_lane = taken_lane & survived
+    return gained, nacked, win_lane, post_lane
 
 
 def _wire_acks(cfg: HermesConfig, ctl: FastCtl, inv_src: FastInv, ack_flags,
@@ -592,23 +696,37 @@ def _wire_acks(cfg: HermesConfig, ctl: FastCtl, inv_src: FastInv, ack_flags,
     return gained_slot, nacked_slot
 
 
-def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
-                  gained_slot, nacked_slot, slot_lane, lane_elig, read_done):
-    """Coordinator-side ``poll_acks()`` + commit + VAL build
-    (BASELINE.json:5).  Per-slot ack bits (derived or wired) scatter back to
-    lanes through slot_lane; commit = ack bitmap covers live_mask (the
-    linearization point, SURVEY.md §3.1); RMW aborts on any nack."""
-    table, sess, replay, meta = fs.table, fs.sess, fs.replay, fs.meta
+def _slot_to_lane_acks(cfg: HermesConfig, gained_slot, nacked_slot, slot_lane):
+    """Sharded-mode adapter: wire acks arrive per SLOT; route them back to
+    lanes through slot_lane — ONE scatter, the gained bitmap and the nack
+    bit packed in one word (uint32: gained can use all 31 mask bits;
+    slot_lane is injective per replica, so set/max are equivalent)."""
     R, C = gained_slot.shape
+    L = cfg.n_lanes
+    packed_slot = (
+        (gained_slot.astype(jnp.uint32) << 1)
+        | nacked_slot.astype(jnp.uint32)
+    )
+    lz = jnp.zeros((R * L,), jnp.uint32)
+    lanes = lz.at[_gkey(lz, slot_lane)].max(packed_slot, mode="drop").reshape(R, L)
+    return (lanes >> 1).astype(jnp.int32), (lanes & 1) != 0
+
+
+def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
+                  gained, nacked, taken_lane, slot_lane, read_done,
+                  post_lane=None):
+    """Coordinator-side ``poll_acks()`` + commit + VAL build
+    (BASELINE.json:5).  ``gained``/``nacked`` are per-LANE (R, L): derived
+    directly there in batched mode (_derived_acks), routed back from the
+    wire slots in sharded mode (_slot_to_lane_acks).  commit = ack bitmap
+    covers live_mask (the linearization point, SURVEY.md §3.1); RMW aborts
+    on any nack."""
+    table, sess, replay, meta = fs.table, fs.sess, fs.replay, fs.meta
+    R = gained.shape[0]
     Rs = cfg.n_replicas
     S, RS, L = cfg.n_sessions, cfg.replay_slots, cfg.n_lanes
     step = ctl.step
     frozen = ctl.frozen[:, None]
-
-    lz = jnp.zeros((R * L,), jnp.int32)
-    gained = lz.at[_gkey(lz, slot_lane)].max(gained_slot, mode="drop").reshape(R, L)
-    nacked = lz.at[_gkey(lz, slot_lane)].max(
-        nacked_slot.astype(jnp.int32), mode="drop").reshape(R, L).astype(jnp.bool_)
 
     full = jnp.int32((1 << Rs) - 1)
     live = ctl.live_mask[:, None]
@@ -623,16 +741,20 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # shrink) while it is in rebroadcast backoff simply commits at its next
     # broadcast round instead — acks persist in the bitmap, so nothing is
     # lost, and the VAL is never silently dropped.
-    commit = infl & covered & lane_elig[:, :S] & ~frozen & ~abort
+    commit = infl & covered & taken_lane[:, :S] & ~frozen & ~abort
 
     # Replay-slot release: a slot whose key's shared arbiter moved past the
     # slot's ts was taken over by a newer write — that writer's VAL will
-    # validate the key.
-    rowns = replay.pts == table.vpts[replay.key]
+    # validate the key.  (post_lane already holds vpts[key] per lane in
+    # batched mode; the sharded path gathers it here.)
+    if post_lane is not None:
+        rowns = replay.pts == post_lane[:, S:]
+    else:
+        rowns = replay.pts == table.kv[replay.key, KV_VPTS]
 
     racks = jnp.where(replay.active, replay.acks | gained[:, S:], replay.acks)
     rcovered = ((racks | ~live) & full) == full
-    rcommit = replay.active & rcovered & lane_elig[:, S:] & ~frozen
+    rcommit = replay.active & rcovered & taken_lane[:, S:] & ~frozen
     rsuper = replay.active & ~rowns & ~frozen
     replay = replay._replace(acks=racks, active=replay.active & ~rcommit & ~rsuper)
 
@@ -641,8 +763,8 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # (acks answer this round's INVs), so every committing lane holds a slot
     # in THIS round's compaction.  The VAL is then just a per-slot bit —
     # receivers reconstruct (key, pts) from the INV block they already hold;
-    # its shared Valid write (with the vpts ownership check) also covers the
-    # committer's own table, so no separate commit scatter exists.
+    # the winner's single [sst|val] write (_apply_commit) covers the
+    # committer's own table too, so no separate commit scatter exists.
     commit_lane = jnp.concatenate([commit, rcommit & rowns], axis=1)
     commit_at_slot = jnp.take_along_axis(commit_lane, slot_lane, axis=1)
     out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
@@ -680,37 +802,25 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     return fs._replace(table=table, sess=sess, replay=replay, meta=meta), out_val, comp
 
 
-def _apply_val(cfg: HermesConfig, ctl: FastCtl, fs: FastState, val_bits,
-               val_epochs, inv_src: FastInv):
-    """VAL apply (SURVEY.md §3.1 tail): ts-matching keys go Valid.  VALs are
-    slot-aligned bits ((Rsrc, C)) over the same round's INV block; the write
-    lands once in the shared state table, guarded by the shared arbiter so a
-    VAL whose write was superseded this round is a no-op."""
-    table = fs.table
-    key0 = inv_src.key
-    ok0 = (
-        val_bits
-        & inv_src.valid
-        & (val_epochs == ctl.epoch[0])[..., None]
-        & (inv_src.pts == table.vpts[key0])
-    )
-    sst = table.sst.at[jnp.where(ok0, key0, table.sst.shape[0])].set(
-        pack_sst(ctl.step, jnp.full(key0.shape, t.VALID, jnp.int32)), mode="drop"
-    )
-    return fs._replace(table=table._replace(sst=sst))
-
-
 def fast_round_batched(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     """One protocol round, batched lockstep emulation: the broadcast IS the
     outbound block (every replica sees the same source-shaped tensors), and
     the ACK bitmap derives from the shared verdicts (_derived_acks) — no
-    exchange ops at all on a single chip."""
-    fs, out_inv, slot_lane, lane_elig, read_done = _coordinate(cfg, ctl, fs, stream)
-    fs, ack_flags = _apply_inv(cfg, ctl, fs, out_inv)
-    gained_slot, nacked_slot = _derived_acks(ctl, out_inv, ack_flags)
-    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained_slot, nacked_slot,
-                                      slot_lane, lane_elig, read_done)
-    fs = _apply_val(cfg, ctl, fs, out_val.valid, out_val.epoch, out_inv)
+    exchange ops at all on a single chip.  The commit decision lands in the
+    same round, so the winner table write (_apply_commit) happens once with
+    the final state — the separate VAL phase does not exist here."""
+    fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done = (
+        _coordinate(cfg, ctl, fs, stream)
+    )
+    fs = _apply_inv_arb(cfg, ctl, fs, out_inv)
+    gained, nacked, win_lane, post_lane = _derived_acks(
+        ctl, fs.table, taken_lane, pend_key, pend_pts
+    )
+    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
+                                      taken_lane, slot_lane, read_done,
+                                      post_lane=post_lane)
+    win0 = jnp.take_along_axis(win_lane, slot_lane, axis=1)
+    fs = _apply_commit(cfg, ctl, fs, out_inv, win0, out_val.valid, out_val.epoch)
     return fs, comp
 
 
@@ -718,17 +828,20 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     """One protocol round on the mesh (transport=tpu_ici, BASELINE.json:5):
     INV and VAL blocks ride ``all_gather`` and the ACK verdicts ride
     ``all_to_all`` over the 'replica' ICI axis."""
-    fs, out_inv, slot_lane, lane_elig, read_done = _coordinate(cfg, ctl, fs, stream)
+    fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done = (
+        _coordinate(cfg, ctl, fs, stream)
+    )
     inv_src = jax.tree.map(_ici_gather_src, out_inv)
-    fs, ack_flags = _apply_inv(cfg, ctl, fs, inv_src)
+    fs, ack_flags, win0 = _apply_inv(cfg, ctl, fs, inv_src)
     gained_slot, nacked_slot = _wire_acks(
         cfg, ctl, inv_src, ack_flags, out_inv, _ici_route_back
     )
-    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained_slot, nacked_slot,
-                                      slot_lane, lane_elig, read_done)
+    gained, nacked = _slot_to_lane_acks(cfg, gained_slot, nacked_slot, slot_lane)
+    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
+                                      taken_lane, slot_lane, read_done)
     val_bits = _ici_gather_src(out_val.valid)
     val_epochs = _ici_gather_src(out_val.epoch)
-    fs = _apply_val(cfg, ctl, fs, val_bits, val_epochs, inv_src)
+    fs = _apply_commit(cfg, ctl, fs, inv_src, win0, val_bits, val_epochs)
     return fs, comp
 
 

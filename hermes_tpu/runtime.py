@@ -315,31 +315,29 @@ class FastRuntime:
         fst = self._fst
         tbl = self.fs.table
         K = self.cfg.n_keys
-        if tbl.val.shape[0] != K:
-            # sharded: each shard owns its tables — transfer the donor's,
-            # folding its in-flight coordination states to Invalid (the live
-            # coordinator's VAL or the replay scan re-validates them)
+        if tbl.kv.shape[0] != K:
+            # sharded: each shard owns its table — transfer the donor's kv
+            # rows, folding its in-flight coordination states to Invalid (the
+            # live coordinator's VAL or the replay scan re-validates them)
             dst, dsrc = replica * K, from_replica * K
-            d_state = fst.sst_state(jax.lax.dynamic_slice_in_dim(tbl.sst, dsrc, K))
+            d_kv = jax.lax.dynamic_slice_in_dim(tbl.kv, dsrc, K)
+            d_state = fst.sst_state(d_kv[:, fst.KV_SST])
             j_state = jnp.where(
                 (d_state == t.WRITE) | (d_state == t.TRANS) | (d_state == t.REPLAY),
                 t.INVALID, d_state,
             )
-            j_sst = fst.pack_sst(jnp.int32(self.step_idx), j_state)
-            upd = lambda col, rows: jax.lax.dynamic_update_slice_in_dim(col, rows, dst, 0)
-            # NOTE: the per-replica issue ledger (tbl.pts) is deliberately
-            # NOT transferred — it records the JOINER's own issued (possibly
-            # not-yet-broadcast) writes, which must keep blocking same-key
-            # re-issues after the rejoin (dup-ts guard); the donor's ledger
-            # entries are meaningless to the joiner.
+            j_kv = d_kv.at[:, fst.KV_SST].set(
+                fst.pack_sst(jnp.int32(self.step_idx), j_state)
+            )
+            # (No issue-ledger transfer exists: a faststep write always
+            # broadcasts — and so invalidates its key — in its own round,
+            # so the joiner's in-flight writes are visible in the table
+            # itself; see faststep._coordinate's revert rule.)
             self.fs = self.fs._replace(table=tbl._replace(
-                sst=upd(tbl.sst, j_sst),
-                vpts=upd(tbl.vpts, jax.lax.dynamic_slice_in_dim(tbl.vpts, dsrc, K)),
-                val=upd(tbl.val, jax.lax.dynamic_slice_in_dim(tbl.val, dsrc, K)),
+                kv=jax.lax.dynamic_update_slice_in_dim(tbl.kv, j_kv, dst, 0),
             ))
-        # batched: the authoritative tables are shared — they already ARE
-        # the joiner's state, and its own issue ledger (pts) survived the
-        # fencing, so no table transfer is needed.
+        # batched: the authoritative table is shared — it already IS the
+        # joiner's state, so no transfer is needed.
         self.frozen[replica] = False
         self.set_live(int(self.live[0]) | (1 << replica))
         if self.membership is not None:

@@ -1,0 +1,66 @@
+"""Sweep (n_sessions, lane_budget) of the bench config on the real chip.
+
+Same measurement protocol as bench.py (warmup readback forces the tunneled
+runtime into synchronous mode; then timed scan-chunks).  Usage:
+
+    python scripts/sweep_bench.py S:C [S:C ...]   # C may be 'full'
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import faststep as fst
+from hermes_tpu.workload import ycsb
+
+ROUNDS = 50
+CHUNKS = 2
+
+
+def run(S, C):
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=S,
+        replay_slots=256, ops_per_session=256, wrap_stream=True,
+        device_stream=True,
+        lane_budget_cfg=None if C == "full" else C,
+        rebroadcast_every=4, replay_scan_every=32,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+    fs = jax.device_put(fst.init_fast_state(cfg))
+    stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
+    chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
+
+    def counters(x):
+        m = jax.device_get(x.meta)
+        return int(m.n_write.sum() + m.n_rmw.sum())
+
+    fs = chunk(fs, stream, fst.make_fast_ctl(cfg, 0))
+    jax.block_until_ready(fs)
+    c0 = counters(fs)
+
+    t0 = time.perf_counter()
+    for c in range(1, 1 + CHUNKS):
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * ROUNDS))
+    jax.block_until_ready(fs)
+    t1 = time.perf_counter()
+
+    commits = counters(fs) - c0
+    wall = t1 - t0
+    rounds = CHUNKS * ROUNDS
+    print(
+        f"S={S:7d} C={cfg.lane_budget:7d}  "
+        f"round={wall / rounds * 1e3:8.2f} ms  "
+        f"commits/round={commits / rounds:9.0f}  "
+        f"wps={commits / wall / 1e6:6.2f} M/s",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    for spec in sys.argv[1:]:
+        s, c = spec.split(":")
+        run(int(s), c if c == "full" else int(c))

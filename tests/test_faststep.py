@@ -137,7 +137,8 @@ def test_sharded_matches_batched():
     b = FastRuntime(cfg, backend="sharded", mesh=mesh)
     assert a.drain(300)
     assert b.drain(300)
-    np.testing.assert_array_equal(get(a.fs.table.pts), get(b.fs.table.pts))
+    # sessions end with identical issued timestamps under both executions
+    np.testing.assert_array_equal(get(a.fs.sess.pts), get(b.fs.sess.pts))
     # batched shares one value table; each drained shard must equal it
     bval = get(b.fs.table.val).reshape(cfg.n_replicas, cfg.n_keys, -1)
     for r in range(cfg.n_replicas):
