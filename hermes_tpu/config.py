@@ -83,6 +83,11 @@ class HermesConfig:
                 "n_replicas must be in [1, 31] (live mask is an int32 bitmap and"
                 " (1<<32)-1 overflows int32)"
             )
+        if self.n_keys > (1 << 29):
+            raise ValueError(
+                "n_keys must fit 29 bits (faststep packs key|fresh|valid "
+                "into one int32 INV word)"
+            )
         if self.value_words < 2:
             raise ValueError("value_words >= 2 (words 0-1 carry the unique write id)")
         # Unique write ids are (hi=replica, lo=session*G+op) int32 pairs.
@@ -120,8 +125,10 @@ class HermesConfig:
     @property
     def arb_slots(self) -> int:
         """Hash-slot count for same-replica same-key issue arbitration
-        (faststep): power of two, >= 4x sessions, capped at 64Ki."""
+        (faststep): power of two, >= 8x sessions (false-collision rate
+        ~S/2HS per issue), capped at 512Ki (scatter cost scales with the
+        session count, not the table size)."""
         hs = 1
-        while hs < min(4 * self.n_sessions, 1 << 16):
+        while hs < min(8 * self.n_sessions, 1 << 19):
             hs <<= 1
         return hs

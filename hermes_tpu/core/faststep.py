@@ -86,6 +86,14 @@ KV_VPTS = 0
 KV_SST = 1
 KV_VAL = 2
 
+# FastInv.pkf packing: key | fresh-bit | valid-bit (keys fit 29 bits — HBM
+# bounds n_keys far below 2^29; config validates).  One packed word means
+# the compaction needs ONE take_along for (valid, fresh, key) and the
+# sharded all_gather moves one tensor instead of three.
+INV_KEY_MASK = (1 << 29) - 1
+INV_FRESH = jnp.int32(1 << 29)
+INV_VALID = jnp.int32(1 << 30)
+
 
 def pack_pts(ver, fc):
     return (ver << PTS_FC_BITS) | fc
@@ -186,20 +194,31 @@ class FastReplay(NamedTuple):
 
 class FastInv(NamedTuple):
     """Compacted INV block.  Outbound (R, C, ...); inbound (R, Rsrc, C, ...).
-    ``fresh`` marks first-broadcast slots (a NEW timestamp — unique per
-    (key, ts), since only the issuing session ever broadcasts a ts for the
-    first time); re-broadcast slots carry a ts whose row the table already
-    holds.  _apply_commit uses this to keep its one set-scatter free of
-    conflicting duplicate rows.  ``epoch``/``alive`` are per-block scalars
-    (a replica's whole batch shares one epoch — SURVEY.md §1 L4)."""
+    ``pkf`` packs (valid-bit << 30) | (fresh-bit << 29) | key: the fresh bit
+    marks first-broadcast slots (a NEW timestamp — unique per (key, ts),
+    since only the issuing session ever broadcasts a ts for the first
+    time); re-broadcast slots carry a ts whose row the table already holds.
+    _apply_commit uses fresh to keep its one set-scatter free of conflicting
+    duplicate rows.  ``epoch``/``alive`` are per-block scalars (a replica's
+    whole batch shares one epoch — SURVEY.md §1 L4)."""
 
-    valid: jnp.ndarray
-    fresh: jnp.ndarray
-    key: jnp.ndarray
+    pkf: jnp.ndarray  # (valid << 30) | (fresh << 29) | key
     pts: jnp.ndarray
     val: jnp.ndarray  # (..., C, V)
     epoch: jnp.ndarray  # (R,) / (R, Rsrc)
     alive: jnp.ndarray
+
+    @property
+    def valid(self):
+        return (self.pkf & INV_VALID) != 0
+
+    @property
+    def fresh(self):
+        return (self.pkf & INV_FRESH) != 0
+
+    @property
+    def key(self):
+        return self.pkf & INV_KEY_MASK
 
 
 class FastAck(NamedTuple):
@@ -487,33 +506,29 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         [fresh_s, jnp.zeros_like(replay.active)], axis=1
     )
     lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
-    rot = (lane_idx + step * 127) % L  # rotating tie-break
-    prio = jnp.where(
-        lane_elig, jnp.where(lane_fresh, L + rot, rot), 2 * L + rot
-    )
     if C == L:
         # budget covers every lane: slots ARE lanes, no compaction sort
         slot_lane = lane_idx
         taken_lane = lane_elig
-    elif 3 * L <= (1 << 16):
-        # single-operand sort: pack (prio, lane) into one word — one sort
-        # buffer instead of two, fewer layout copies.  prio < 3L and
-        # lane < L <= 2^15, so (prio << 15) | lane stays positive int32.
-        # Which lanes hold a slot falls out of a THRESHOLD test against the
-        # C-th smallest packed priority (packed values are unique) — no
-        # inverse scatter.
-        packed_own = (prio << 15) | lane_idx
-        packed = jax.lax.sort(packed_own, dimension=1)
-        slot_lane = packed[:, :C] & ((1 << 15) - 1)  # (R, C) lane id per slot
-        taken_lane = lane_elig & (packed_own <= packed[:, C - 1 : C])
     else:
-        _, perm = jax.lax.sort((prio, lane_idx), dimension=1, num_keys=1,
-                               is_stable=True)
-        slot_lane = perm[:, :C]
-        tk = jnp.zeros((R * L,), jnp.int32)
-        taken_slot = jnp.take_along_axis(lane_elig, slot_lane, axis=1)
-        tk = tk.at[_gkey(tk, slot_lane, taken_slot)].max(1, mode="drop")
-        taken_lane = tk.reshape(R, L) != 0
+        # Single-operand sort: one int32 packs (band | rotation | lane) —
+        # one sort buffer, and which lanes hold a slot falls out of a
+        # THRESHOLD test against the C-th smallest packed value (values are
+        # unique — the lane id is the low bits) instead of an inverse
+        # scatter.  Band (2b): 0 = waiting/replay, 1 = fresh, 2 = ineligible.
+        # The rotating anti-starvation tie-break is coarsened to the bits
+        # left between band and lane: rotation granularity 2^(lb-rb) lanes,
+        # with membership shifting by 127 lanes per round, so every lane
+        # still reaches the front of its band within O(L) rounds.
+        lb = max(1, (L - 1).bit_length())  # lane bits
+        rb = max(0, 31 - 2 - lb)  # rotation bits
+        rot = (lane_idx + step * 127) % L
+        rotp = rot >> max(0, lb - rb)
+        band = jnp.where(lane_elig, jnp.where(lane_fresh, 1, 0), 2)
+        packed_own = (((band << min(rb, lb)) | rotp) << lb) | lane_idx
+        packed = jax.lax.sort(packed_own, dimension=1)
+        slot_lane = packed[:, :C] & ((1 << lb) - 1)  # (R, C) lane id per slot
+        taken_lane = lane_elig & (packed_own <= packed[:, C - 1 : C])
 
     # fresh issues that won arbitration AND hold a slot actually happen;
     # the rest revert (stay S_ISSUE) and retry next round
@@ -529,10 +544,13 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
     pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
     pend_val = jnp.concatenate([sess.val, replay.val], axis=1)
+    lane_pkf = (
+        pend_key
+        | jnp.where(lane_fresh, INV_FRESH, 0)
+        | jnp.where(taken_lane, INV_VALID, 0)
+    )
     out_inv = FastInv(
-        valid=jnp.take_along_axis(taken_lane, slot_lane, axis=1),
-        fresh=jnp.take_along_axis(lane_fresh, slot_lane, axis=1),
-        key=jnp.take_along_axis(pend_key, slot_lane, axis=1),
+        pkf=jnp.take_along_axis(lane_pkf, slot_lane, axis=1),
         pts=jnp.take_along_axis(pend_pts, slot_lane, axis=1),
         val=jnp.take_along_axis(
             pend_val, slot_lane[..., None], axis=1
