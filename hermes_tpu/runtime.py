@@ -315,18 +315,19 @@ class FastRuntime:
         fst = self._fst
         tbl = self.fs.table
         K = self.cfg.n_keys
-        if tbl.kv.shape[0] != K:
-            # sharded: each shard owns its table — transfer the donor's kv
+        if tbl.vpts.shape[0] != K:
+            # sharded: each shard owns its table — transfer the donor's
             # rows, folding its in-flight coordination states to Invalid (the
             # live coordinator's VAL or the replay scan re-validates them)
             dst, dsrc = replica * K, from_replica * K
-            d_kv = jax.lax.dynamic_slice_in_dim(tbl.kv, dsrc, K)
-            d_state = fst.sst_state(d_kv[:, fst.KV_SST])
+            d_rows = fst._bank_to_i32(
+                jax.lax.dynamic_slice_in_dim(tbl.bank, dsrc, K))
+            d_state = fst.sst_state(d_rows[:, fst.BANK_SST])
             j_state = jnp.where(
                 (d_state == t.WRITE) | (d_state == t.TRANS) | (d_state == t.REPLAY),
                 t.INVALID, d_state,
             )
-            j_kv = d_kv.at[:, fst.KV_SST].set(
+            j_rows = d_rows.at[:, fst.BANK_SST].set(
                 fst.pack_sst(jnp.int32(self.step_idx), j_state)
             )
             # (No issue-ledger transfer exists: a faststep write always
@@ -334,7 +335,11 @@ class FastRuntime:
             # so the joiner's in-flight writes are visible in the table
             # itself; see faststep._coordinate's revert rule.)
             self.fs = self.fs._replace(table=tbl._replace(
-                kv=jax.lax.dynamic_update_slice_in_dim(tbl.kv, j_kv, dst, 0),
+                vpts=jax.lax.dynamic_update_slice_in_dim(
+                    tbl.vpts, jax.lax.dynamic_slice_in_dim(tbl.vpts, dsrc, K),
+                    dst, 0),
+                bank=jax.lax.dynamic_update_slice_in_dim(
+                    tbl.bank, fst._i32_to_bank(j_rows), dst, 0),
             ))
         # batched: the authoritative table is shared — it already IS the
         # joiner's state, so no transfer is needed.

@@ -21,11 +21,13 @@ def test_snapshot_resume_deterministic(tmp_path):
     b = FastRuntime(cfg)
     snapshot.load(p, b)
     assert b.step_idx == 7
-    np.testing.assert_array_equal(get(a.fs.table.kv), get(b.fs.table.kv))
+    np.testing.assert_array_equal(get(a.fs.table.vpts), get(b.fs.table.vpts))
+    np.testing.assert_array_equal(get(a.fs.table.bank), get(b.fs.table.bank))
 
     a.run(10)
     b.run(10)
-    np.testing.assert_array_equal(get(a.fs.table.kv), get(b.fs.table.kv))
+    np.testing.assert_array_equal(get(a.fs.table.vpts), get(b.fs.table.vpts))
+    np.testing.assert_array_equal(get(a.fs.table.bank), get(b.fs.table.bank))
     np.testing.assert_array_equal(get(a.fs.table.val), get(b.fs.table.val))
     np.testing.assert_array_equal(get(a.fs.sess.status), get(b.fs.sess.status))
 
