@@ -68,6 +68,11 @@ class HermesConfig:
     # The full-table stuck-key replay scan (SURVEY.md §3.4) runs every this
     # many rounds (it only matters after failures/drops).
     replay_scan_every: int = 8
+    # Override the issue-arbitration hash-table size (power of two).  None
+    # = auto (arb_slots property).  Smaller tables scatter faster on this
+    # chip but raise the false-collision deferral rate (~S/2HS per issue).
+    arb_slots_cfg: Optional[int] = None
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform keys only
@@ -83,6 +88,11 @@ class HermesConfig:
                 "n_replicas must be in [1, 31] (live mask is an int32 bitmap and"
                 " (1<<32)-1 overflows int32)"
             )
+        if self.arb_slots_cfg is not None and (
+            self.arb_slots_cfg <= 0
+            or self.arb_slots_cfg & (self.arb_slots_cfg - 1)
+        ):
+            raise ValueError("arb_slots_cfg must be a positive power of two")
         if self.n_keys > (1 << 29):
             raise ValueError(
                 "n_keys must fit 29 bits (faststep packs key|fresh|valid "
@@ -126,8 +136,11 @@ class HermesConfig:
     def arb_slots(self) -> int:
         """Hash-slot count for same-replica same-key issue arbitration
         (faststep): power of two, >= 8x sessions (false-collision rate
-        ~S/2HS per issue), capped at 512Ki (scatter cost scales with the
-        session count, not the table size)."""
+        ~S/2HS per issue), capped at 512Ki; scatter cost grows with BOTH
+        the update count and the table size on this chip, so the sweet
+        spot is workload-dependent — override with arb_slots_cfg."""
+        if self.arb_slots_cfg is not None:
+            return self.arb_slots_cfg
         hs = 1
         while hs < min(8 * self.n_sessions, 1 << 19):
             hs <<= 1

@@ -433,7 +433,6 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     sess = sess._replace(
         status=jnp.where(read_done, t.S_IDLE, sess.status),
         op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
-        rd_val=jnp.where(read_done[..., None], rd_val, sess.rd_val),
     )
 
     # Same-key same-replica issue arbitration via a small hash-slot race:
@@ -453,7 +452,6 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
     fc = (flag << 8) | ctl.my_cid[:, None]
     new_pts = pack_pts(pts_ver(k_vpts) + 1, fc)
-    old_val = rd_val  # RMW read-part observes the pre-issue value
 
     # --- replay scan, cond-gated (SURVEY.md §3.4; only matters after
     # failures, so it runs every replay_scan_every rounds) ------------------
@@ -557,12 +555,17 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # fresh issues that won arbitration AND hold a slot actually happen;
     # the rest revert (stay S_ISSUE) and retry next round
     win_eff = win & taken_lane[:, :S]
+    # one rd_val write serves both completions: finished reads and the RMW
+    # read-part snapshot write the same gathered row (masks are disjoint —
+    # S_READ vs S_ISSUE sessions)
     is_rmw_issue = win_eff & (sess.op == t.OP_RMW)
     sess = sess._replace(
         status=jnp.where(win_eff, t.S_INFL, sess.status),
         pts=jnp.where(win_eff, new_pts, sess.pts),
         acks=jnp.where(win_eff, 0, sess.acks),
-        rd_val=jnp.where(is_rmw_issue[..., None], old_val, sess.rd_val),
+        rd_val=jnp.where(
+            (read_done | is_rmw_issue)[..., None], rd_val, sess.rd_val
+        ),
     )
 
     pend_key = jnp.concatenate([sess.key, replay.key], axis=1)

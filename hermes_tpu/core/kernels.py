@@ -8,8 +8,10 @@ target runtime (~0.5 ms marginal per launch measured).
 
 ``stats_block`` fuses the per-round completion-code computation, the op
 counters, and the commit-latency histogram (collect_acks' tail: ~6 separate
-XLA fusions) into a single VMEM-resident kernel over the (R, S) session
-arrays (a few MB — comfortably VMEM-sized for bench shapes).
+XLA fusions) into a single kernel over the (R, S) session arrays, gridded
+over session blocks (<= 32Ki lanes per block) so the VMEM working set stays
+bounded at any session count; the counter/histogram outputs revisit one
+block across grid steps and accumulate.
 
 The kernel runs ``interpret=True`` on non-TPU backends, so the same code
 runs under the CPU test suite (tests/test_kernels.py pins equivalence
@@ -37,6 +39,13 @@ def _interpret() -> bool:
 def _stats_kernel(step_ref, op_ref, invoke_ref, commit_ref, abort_ref,
                   read_ref, code_ref, ctr_ref, hist_ref):
     step = step_ref[0, 0]
+    blk = pl.program_id(0)
+
+    @pl.when(blk == 0)
+    def _init():
+        ctr_ref[:] = jnp.zeros_like(ctr_ref)
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
     op = op_ref[:]
     commit = commit_ref[:] != 0
     abort = abort_ref[:] != 0
@@ -56,7 +65,7 @@ def _stats_kernel(step_ref, op_ref, invoke_ref, commit_ref, abort_ref,
     # Mosaic lowers reliably (validated on the target TPU via bench.py)
     red = lambda x: jnp.sum(x, axis=1, keepdims=True)
     zero = jnp.zeros((op.shape[0], 1), jnp.int32)
-    ctr_ref[:] = jnp.concatenate([
+    ctr_ref[:] += jnp.concatenate([
         red(read_done.astype(jnp.int32)),
         red(ci * (1 - is_rmw.astype(jnp.int32))),
         red(ci * is_rmw.astype(jnp.int32)),
@@ -69,7 +78,7 @@ def _stats_kernel(step_ref, op_ref, invoke_ref, commit_ref, abort_ref,
     # histogram: one reduction per bin (static unroll; all inside this kernel)
     nbin = st.LAT_BINS
     clat = jnp.clip(lat, 0, nbin - 1)
-    hist_ref[:] = jnp.concatenate(
+    hist_ref[:] += jnp.concatenate(
         [red(((clat == b) & commit).astype(jnp.int32)) for b in range(nbin)],
         axis=1,
     )
@@ -84,16 +93,27 @@ def stats_block(step, sess_op, invoke_step, commit, abort, read_done):
     """
     R, S = sess_op.shape
     nbin = st.LAT_BINS
-    vm = pl.BlockSpec(memory_space=pltpu.VMEM)
+    bs = min(S, 1 << 15)
+    nblk = -(-S // bs)
+    pad = nblk * bs - S
+    if pad:
+        # neutral padding: commit/abort/read all zero contributes nothing
+        # to any counter or histogram bin; the code output is sliced back
+        padit = lambda x: jnp.pad(x, ((0, 0), (0, pad)))
+        sess_op, invoke_step = padit(sess_op), padit(invoke_step)
+        commit, abort, read_done = padit(commit), padit(abort), padit(read_done)
+    sblk = pl.BlockSpec((R, bs), lambda j: (0, j))
+    fixed = lambda shape: pl.BlockSpec(shape, lambda j: (0, 0))
     code, ctr, hist = pl.pallas_call(
         _stats_kernel,
+        grid=(nblk,),
         in_specs=[
-            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
-            vm, vm, vm, vm, vm,
+            pl.BlockSpec((1, 1), lambda j: (0, 0), memory_space=pltpu.SMEM),
+            sblk, sblk, sblk, sblk, sblk,
         ],
-        out_specs=[vm, vm, vm],
+        out_specs=[sblk, fixed((R, 8)), fixed((R, nbin))],
         out_shape=[
-            jax.ShapeDtypeStruct((R, S), jnp.int32),
+            jax.ShapeDtypeStruct((R, nblk * bs), jnp.int32),
             jax.ShapeDtypeStruct((R, 8), jnp.int32),
             jax.ShapeDtypeStruct((R, nbin), jnp.int32),
         ],
@@ -104,4 +124,4 @@ def stats_block(step, sess_op, invoke_step, commit, abort, read_done):
         commit.astype(jnp.int32), abort.astype(jnp.int32),
         read_done.astype(jnp.int32),
     )
-    return code, ctr, hist
+    return code[:, :S], ctr, hist

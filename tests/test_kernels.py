@@ -43,3 +43,37 @@ def test_stats_block_matches_reference():
             if commit[r, s]:
                 ref_hist[r, min(lat[r, s], st.LAT_BINS - 1)] += 1
     np.testing.assert_array_equal(np.asarray(hist), ref_hist)
+
+
+def test_stats_block_multi_block_grid():
+    """S > 32Ki exercises the gridded accumulation path (nblk > 1), and a
+    non-multiple S exercises the neutral padding; both must match the
+    single-block reference formulation exactly."""
+    import numpy as np
+    import jax.numpy as jnp
+    from hermes_tpu.core import kernels, state as st, types as t
+
+    rng = np.random.default_rng(5)
+    for S in (1 << 16, 40000):  # multiple of 32Ki and a ragged size
+        R = 2
+        op = jnp.asarray(rng.integers(0, 3, (R, S), dtype=np.int32))
+        invoke = jnp.asarray(rng.integers(0, 50, (R, S), dtype=np.int32))
+        commit = jnp.asarray(rng.random((R, S)) < 0.3)
+        abort = jnp.asarray((rng.random((R, S)) < 0.05)) & ~commit
+        read_done = jnp.asarray(rng.random((R, S)) < 0.2) & ~commit & ~abort
+        step = 57
+        code, ctr, hist = kernels.stats_block(step, op, invoke, commit, abort, read_done)
+
+        is_rmw = np.asarray(op) == t.OP_RMW
+        cm, ab, rd = map(np.asarray, (commit, abort, read_done))
+        lat = np.where(cm, step - np.asarray(invoke), 0)
+        assert int(ctr[:, kernels.CTR_READ].sum()) == int(rd.sum())
+        assert int(ctr[:, kernels.CTR_WRITE].sum()) == int((cm & ~is_rmw).sum())
+        assert int(ctr[:, kernels.CTR_RMW].sum()) == int((cm & is_rmw).sum())
+        assert int(ctr[:, kernels.CTR_ABORT].sum()) == int(ab.sum())
+        assert int(ctr[:, kernels.CTR_LATSUM].sum()) == int(lat.sum())
+        assert int(ctr[:, kernels.CTR_LATCNT].sum()) == int(cm.sum())
+        clat = np.clip(lat, 0, st.LAT_BINS - 1)
+        for b in range(st.LAT_BINS):
+            assert int(hist[:, b].sum()) == int(((clat == b) & cm).sum())
+        assert code.shape == (R, S)
