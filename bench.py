@@ -86,6 +86,7 @@ def main() -> None:
     # round time (commit latency = 1 round for an uncontended write)
     hist = jax.device_get(fs.meta.lat_hist).sum(axis=0) - lat0
     p50_rounds = percentile_from_hist(hist, 0.5)
+    p99_rounds = percentile_from_hist(hist, 0.99)
     step_us = wall / measure * 1e6
 
     meta = {
@@ -94,7 +95,9 @@ def main() -> None:
         "wall_s": round(wall, 4),
         "round_us": round(step_us, 1),
         "p50_commit_rounds": p50_rounds,
+        "p99_commit_rounds": p99_rounds,
         "p50_commit_us_est": round((p50_rounds + 1) * step_us, 1),
+        "p99_commit_us_est": round((p99_rounds + 1) * step_us, 1),
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
         "replicas_on_chip": cfg.n_replicas,

@@ -173,19 +173,26 @@ class FastTable(NamedTuple):
 
 
 def _bank_to_i32(rows8):
-    """Bitcast int8 bank rows (..., 4*W) -> int32 words (..., W)."""
-    w = rows8.shape[-1] // 4
-    return jax.lax.bitcast_convert_type(
-        rows8.reshape(rows8.shape[:-1] + (w, 4)), jnp.int32
-    )
+    """int8 byte rows (..., 4*W) -> int32 words (..., W), via strided byte
+    arithmetic: pure slice+elementwise, which XLA fuses into the consumer.
+    (bitcast_convert_type forces a byte-plane relayout COPY of the whole
+    array — measured 13 MB/round at bench shape — so it is banned from the
+    hot path; this formulation defines the byte order everywhere.)"""
+    u = rows8.astype(jnp.uint8).astype(jnp.uint32)
+    w = (u[..., 0::4] | (u[..., 1::4] << 8)
+         | (u[..., 2::4] << 16) | (u[..., 3::4] << 24))
+    return w.astype(jnp.int32)
 
 
 def _i32_to_bank(rows32):
-    """Bitcast int32 words (..., W) -> int8 bank rows (..., 4*W)."""
-    w = rows32.shape[-1]
-    return jax.lax.bitcast_convert_type(rows32, jnp.int8).reshape(
-        rows32.shape[:-1] + (4 * w,)
+    """int32 words (..., W) -> int8 byte rows (..., 4*W); inverse of
+    _bank_to_i32 (same byte order), fusable elementwise."""
+    u = rows32.astype(jnp.uint32)
+    parts = jnp.stack(
+        [((u >> (8 * k)) & 0xFF).astype(jnp.uint8) for k in range(4)],
+        axis=-1,
     )
+    return parts.reshape(rows32.shape[:-1] + (4 * rows32.shape[-1],)).astype(jnp.int8)
 
 
 class FastSess(NamedTuple):
@@ -195,10 +202,10 @@ class FastSess(NamedTuple):
     op: jnp.ndarray
     op_idx: jnp.ndarray
     key: jnp.ndarray
-    val: jnp.ndarray  # (R, S, V)
+    val: jnp.ndarray  # (R, S, 4V) int8 — values are opaque BYTE payloads
     pts: jnp.ndarray  # packed pending-update ts
     acks: jnp.ndarray  # gathered-ack replica bitmap
-    rd_val: jnp.ndarray  # (R, S, V)
+    rd_val: jnp.ndarray  # (R, S, 4V) int8
     invoke_step: jnp.ndarray
 
 
@@ -208,7 +215,7 @@ class FastReplay(NamedTuple):
     active: jnp.ndarray  # (R, RS) bool
     key: jnp.ndarray
     pts: jnp.ndarray
-    val: jnp.ndarray  # (R, RS, V)
+    val: jnp.ndarray  # (R, RS, 4V) int8 byte payload
     acks: jnp.ndarray
 
 
@@ -224,7 +231,7 @@ class FastInv(NamedTuple):
 
     pkf: jnp.ndarray  # (valid << 30) | (fresh << 29) | key
     pts: jnp.ndarray
-    val: jnp.ndarray  # (..., C, V)
+    val: jnp.ndarray  # (..., C, 4V) int8 byte payload
     epoch: jnp.ndarray  # (R,) / (R, Rsrc)
     alive: jnp.ndarray
 
@@ -293,17 +300,18 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
         lat_cnt=z(r),
         lat_hist=z(r, st.LAT_BINS),
     )
+    z8 = lambda *sh: jnp.zeros(sh, jnp.int8)
     return FastState(
         table=FastTable(vpts=jnp.zeros((nv * k,), jnp.int32),
                         bank=_i32_to_bank(rows32)),
         sess=FastSess(
             status=z(r, s), op=z(r, s), op_idx=z(r, s), key=z(r, s),
-            val=z(r, s, v), pts=z(r, s), acks=z(r, s),
-            rd_val=z(r, s, v), invoke_step=z(r, s),
+            val=z8(r, s, 4 * v), pts=z(r, s), acks=z(r, s),
+            rd_val=z8(r, s, 4 * v), invoke_step=z(r, s),
         ),
         replay=FastReplay(
             active=jnp.zeros((r, rs), jnp.bool_), key=z(r, rs), pts=z(r, rs),
-            val=z(r, rs, v), acks=z(r, rs),
+            val=z8(r, rs, 4 * v), acks=z(r, rs),
         ),
         meta=meta,
     )
@@ -397,12 +405,13 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     else:
         new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
         new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
-    new_val = _write_value(cfg, ctl.my_cid, sess.op_idx)
+    new_val = _i32_to_bank(_write_value(cfg, ctl.my_cid, sess.op_idx))
     if stream.uval is not None:
         # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry the
-        # user value; words 0-1 keep the derived unique write id.
+        # user value; words 0-1 keep the derived unique write id.  uval is
+        # pre-converted to bytes by prep_stream.
         uval = jnp.take_along_axis(stream.uval, g[..., None, None], axis=2)[:, :, 0]
-        new_val = jnp.concatenate([new_val[..., :2], uval], axis=-1)
+        new_val = jnp.concatenate([new_val[..., :8], uval], axis=-1)
     is_nop = can_load & (new_op == t.OP_NOP)
     status = jnp.where(
         can_load,
@@ -424,10 +433,12 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # --- reads + issue -----------------------------------------------------
     # One bank-row gather serves the Valid check and the read value; the
     # arbiter rides a second, 1-word gather (gathers are near-free here).
-    krow = _bank_to_i32(table.bank[sess.key])  # (R, S, 1+V) int32 view
+    # Everything stays BYTES: the state is the low 3 bits of byte 0, and
+    # the value is an opaque payload — no int32 assembly on the hot path.
+    krow8 = table.bank[sess.key]  # (R, S, 4*(1+V)) int8
     k_vpts = table.vpts[sess.key]
-    k_valid = sst_state(krow[..., BANK_SST]) == t.VALID
-    rd_val = krow[..., BANK_VAL:]
+    k_valid = (krow8[..., 0] & 7) == t.VALID
+    rd_val = krow8[..., 4:]
 
     read_done = (sess.status == t.S_READ) & k_valid & ~frozen
     sess = sess._replace(
@@ -461,7 +472,7 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         # same-ts re-INVs are idempotent (SURVEY.md §3.4), and any live
         # replica alone suffices to finish a dead coordinator's write.
         table, replay = args
-        sstK = _bank_to_i32(table.bank)[:, BANK_SST].reshape(1, -1)  # (1, nv*K)
+        sstK = _bank_to_i32(table.bank[:, :4]).reshape(1, -1)  # (1, nv*K)
         age = step - sst_step(sstK)
         state = sst_state(sstK)
         # REPLAY is included: the shared mark means SOME replica snapshotted
@@ -485,20 +496,21 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             jnp.pad(cand_ok, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1
         )
         ck = jnp.take_along_axis(jnp.pad(cand, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1)
-        ckrow = _bank_to_i32(table.bank[ck])  # (R, RS, 1+V) snapshot rows
+        ckrow8 = table.bank[ck]  # (R, RS, 4*(1+V)) snapshot byte rows
         new_replay = FastReplay(
             active=jnp.where(take_ok, True, replay.active),
             key=jnp.where(take_ok, ck, replay.key),
             pts=jnp.where(take_ok, table.vpts[ck], replay.pts),
-            val=jnp.where(take_ok[..., None], ckrow[..., BANK_VAL:], replay.val),
+            val=jnp.where(take_ok[..., None], ckrow8[..., 4:], replay.val),
             acks=jnp.where(take_ok, 0, replay.acks),
         )
-        mark = ckrow.at[..., BANK_SST].set(
-            pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32))
+        mark_sst = _i32_to_bank(
+            pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32))[..., None]
         )
+        mark = jnp.concatenate([mark_sst, ckrow8[..., 4:]], axis=-1)
         new_bank = table.bank.at[
             jnp.where(take_ok, ck, table.bank.shape[0])
-        ].set(_i32_to_bank(mark), mode="drop")
+        ].set(mark, mode="drop")
         return table._replace(bank=new_bank), new_replay
 
     table, replay = jax.lax.cond(
@@ -673,13 +685,11 @@ def _apply_commit(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     key0 = inv_src.key
     vbit = val_bits & (val_epochs == ctl.epoch[0])[..., None]
     state_new = jnp.where(vbit, t.VALID, t.INVALID)
-    sstv = pack_sst(ctl.step, state_new)
-    upd = jnp.concatenate(
-        [sstv[..., None], inv_src.val], axis=-1
-    )  # (..., 1+V): [sst | val]
+    sstv8 = _i32_to_bank(pack_sst(ctl.step, state_new)[..., None])
+    upd8 = jnp.concatenate([sstv8, inv_src.val], axis=-1)  # byte row [sst|val]
     write0 = win0 & (inv_src.fresh | vbit)
     rows = jnp.where(write0, key0, table.bank.shape[0])
-    bank = table.bank.at[rows].set(_i32_to_bank(upd), mode="drop")
+    bank = table.bank.at[rows].set(upd8, mode="drop")
     return fs._replace(table=table._replace(bank=bank))
 
 
@@ -822,8 +832,8 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     comp = st.Completions(
         code=code,
         key=sess.key,
-        wval=sess.val,
-        rval=sess.rd_val,
+        wval=_bank_to_i32(sess.val),
+        rval=_bank_to_i32(sess.rd_val),
         ver=pts_ver(sess.pts),
         fc=pts_fc(sess.pts),
         invoke_step=sess.invoke_step,
@@ -897,12 +907,16 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
 
 
 def prep_stream(stream):
-    """Device-place an (R, S, G[, U]) op stream for the fast engines.
-    (A G-major transpose was tried here and measured slower.)"""
+    """Device-place an (R, S, G[, U]) op stream for the fast engines,
+    converting client value payloads to the engine's byte form.  (A G-major
+    transpose was tried here and measured slower.)"""
+    uval = stream.uval
+    if uval is not None:
+        uval = _i32_to_bank(jnp.asarray(uval, jnp.int32))
     return st.OpStream(
         op=jnp.asarray(stream.op),
         key=jnp.asarray(stream.key),
-        uval=None if stream.uval is None else jnp.asarray(stream.uval),
+        uval=uval,
     )
 
 

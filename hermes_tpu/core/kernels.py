@@ -93,7 +93,13 @@ def stats_block(step, sess_op, invoke_step, commit, abort, read_done):
     """
     R, S = sess_op.shape
     nbin = st.LAT_BINS
-    bs = min(S, 1 << 15)
+    # Block size bounds the VMEM working set across BOTH dims: ~7 R-wide
+    # int32 arrays live per grid step, kept under ~12 MB, additionally
+    # capped at 32Ki lanes; block is a multiple of 128 and sized to the
+    # smallest cover of S so the common shapes need no padding at all.
+    bs_cap = min(1 << 15, max(128, (3 << 20) // (7 * R) // 128 * 128))
+    nblk = -(-S // bs_cap)
+    bs = min(-(-(-(-S // nblk)) // 128) * 128, bs_cap)
     nblk = -(-S // bs)
     pad = nblk * bs - S
     if pad:
