@@ -46,12 +46,13 @@ def main() -> None:
         n_replicas=8,
         n_keys=1 << 20,  # 1M keys (BASELINE.json:7)
         value_words=8,  # 32B values, the reference's typical small-value shape
-        n_sessions=45056,  # in-flight ops per replica (tuned on-chip)
+        n_sessions=32768,  # in-flight ops per replica (tuned on-chip)
         replay_slots=256,
         ops_per_session=256,
         wrap_stream=True,  # stream cycles; write uids stay unique (config.py)
         device_stream=True,  # counter-hash op stream (no stream gathers)
-        lane_budget_cfg=22528,
+        lane_budget_cfg=24576,
+        read_unroll=2,  # local-read drain depth (reference read batching)
         rebroadcast_every=4,
         replay_scan_every=32,
         workload=WorkloadConfig(read_frac=0.5, seed=0),  # YCSB-A; metric counts writes

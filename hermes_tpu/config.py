@@ -68,6 +68,14 @@ class HermesConfig:
     # The full-table stuck-key replay scan (SURVEY.md §3.4) runs every this
     # many rounds (it only matters after failures/drops).
     replay_scan_every: int = 8
+    # Local-read drain depth: each protocol round runs this many
+    # intake+read sub-steps before the issue path, so a session completes
+    # up to read_unroll consecutive LOCAL reads per round and an update is
+    # issued the same round it is drawn — the reference worker loop's
+    # read-batching (reads never touch the network, SURVEY.md §3.2).
+    # Sub-step completions are recorded in program order.
+    read_unroll: int = 1
+
     # Override the issue-arbitration hash-table size (power of two).  None
     # = auto (arb_slots property).  Smaller tables scatter faster on this
     # chip but raise the false-collision deferral rate (~S/2HS per issue).
@@ -88,6 +96,8 @@ class HermesConfig:
                 "n_replicas must be in [1, 31] (live mask is an int32 bitmap and"
                 " (1<<32)-1 overflows int32)"
             )
+        if self.read_unroll < 1:
+            raise ValueError("read_unroll must be >= 1")
         if self.arb_slots_cfg is not None and (
             self.arb_slots_cfg <= 0
             or self.arb_slots_cfg & (self.arb_slots_cfg - 1)

@@ -377,70 +377,106 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     frozen = ctl.frozen[:, None]
     step = ctl.step
 
-    # --- intake -----------------------------------------------------------
-    if cfg.wrap_stream:
-        can_load = (sess.status == t.S_IDLE) & ~frozen
-        g = sess.op_idx % G
-    else:
-        can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~frozen
-        g = jnp.clip(sess.op_idx, 0, G - 1)
-    if cfg.device_stream:
-        # counter-hash op stream (SURVEY.md §2 "in-kernel PRNG"): ONE shared
-        # formula with the host twin (workload.ycsb.stream_hash)
-        from hermes_tpu.workload.ycsb import device_stream_params, stream_hash
+    # --- intake + local-read drain (unrolled read_unroll times) -------------
+    # A replica drains several LOCAL reads per protocol round — exactly the
+    # reference worker loop's behavior: reads never leave the machine
+    # (SURVEY.md §3.2), so only updates are bound to the network round,
+    # while the per-op loop serves reads back-to-back.  Each sub-step loads
+    # the session's next op and completes it if it is a read against a
+    # Valid key; a loaded update ends the drain for that session and enters
+    # the issue path below.  All sub-steps observe the same table state
+    # (this round's writes apply later), so same-round reads of a key
+    # return the same value and any linearization order works; sub-step
+    # completions are recorded in program order (sub_comps).
 
-        read_t, rmw_t = device_stream_params(cfg)
-        import numpy as _np
+    def _intake(sess):
+        if cfg.wrap_stream:
+            can_load = (sess.status == t.S_IDLE) & ~frozen
+            g = sess.op_idx % G
+        else:
+            can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~frozen
+            g = jnp.clip(sess.op_idx, 0, G - 1)
+        if cfg.device_stream:
+            # counter-hash op stream (SURVEY.md §2 "in-kernel PRNG"): ONE
+            # shared formula with the host twin (workload.ycsb.stream_hash)
+            from hermes_tpu.workload.ycsb import device_stream_params, stream_hash
 
-        u_op, u_rmw, hkey = stream_hash(
-            cfg,
-            ctl.my_cid[:, None].astype(jnp.uint32),
-            jnp.arange(S, dtype=jnp.uint32)[None, :],
-            sess.op_idx.astype(jnp.uint32),
+            read_t, rmw_t = device_stream_params(cfg)
+            import numpy as _np
+
+            u_op, u_rmw, hkey = stream_hash(
+                cfg,
+                ctl.my_cid[:, None].astype(jnp.uint32),
+                jnp.arange(S, dtype=jnp.uint32)[None, :],
+                sess.op_idx.astype(jnp.uint32),
+            )
+            new_op = jnp.where(u_op < _np.uint32(read_t), t.OP_READ,
+                               jnp.where(u_rmw < _np.uint32(rmw_t), t.OP_RMW,
+                                         t.OP_WRITE)).astype(jnp.int32)
+            new_key = hkey.astype(jnp.int32)
+        else:
+            new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
+            new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
+        new_val = _i32_to_bank(_write_value(cfg, ctl.my_cid, sess.op_idx))
+        if stream.uval is not None:
+            # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry
+            # the user value; words 0-1 keep the derived unique write id.
+            # uval is pre-converted to bytes by prep_stream.
+            uval = jnp.take_along_axis(stream.uval, g[..., None, None], axis=2)[:, :, 0]
+            new_val = jnp.concatenate([new_val[..., :8], uval], axis=-1)
+        is_nop = can_load & (new_op == t.OP_NOP)
+        status = jnp.where(
+            can_load,
+            jnp.where(new_op == t.OP_READ, t.S_READ,
+                      jnp.where(new_op == t.OP_NOP, t.S_IDLE, t.S_ISSUE)),
+            sess.status,
         )
-        new_op = jnp.where(u_op < _np.uint32(read_t), t.OP_READ,
-                           jnp.where(u_rmw < _np.uint32(rmw_t), t.OP_RMW,
-                                     t.OP_WRITE)).astype(jnp.int32)
-        new_key = hkey.astype(jnp.int32)
-    else:
-        new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
-        new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
-    new_val = _i32_to_bank(_write_value(cfg, ctl.my_cid, sess.op_idx))
-    if stream.uval is not None:
-        # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry the
-        # user value; words 0-1 keep the derived unique write id.  uval is
-        # pre-converted to bytes by prep_stream.
-        uval = jnp.take_along_axis(stream.uval, g[..., None, None], axis=2)[:, :, 0]
-        new_val = jnp.concatenate([new_val[..., :8], uval], axis=-1)
-    is_nop = can_load & (new_op == t.OP_NOP)
-    status = jnp.where(
-        can_load,
-        jnp.where(new_op == t.OP_READ, t.S_READ,
-                  jnp.where(new_op == t.OP_NOP, t.S_IDLE, t.S_ISSUE)),
-        sess.status,
-    )
-    if not cfg.wrap_stream:
-        status = jnp.where((status == t.S_IDLE) & (sess.op_idx >= G), t.S_DONE, status)
-    sess = sess._replace(
-        status=status,
-        op=jnp.where(can_load, new_op, sess.op),
-        key=jnp.where(can_load, new_key, sess.key),
-        val=jnp.where(can_load[..., None], new_val, sess.val),
-        invoke_step=jnp.where(can_load, step, sess.invoke_step),
-        op_idx=jnp.where(is_nop, sess.op_idx + 1, sess.op_idx),
-    )
+        if not cfg.wrap_stream:
+            status = jnp.where((status == t.S_IDLE) & (sess.op_idx >= G), t.S_DONE, status)
+        return sess._replace(
+            status=status,
+            op=jnp.where(can_load, new_op, sess.op),
+            key=jnp.where(can_load, new_key, sess.key),
+            val=jnp.where(can_load[..., None], new_val, sess.val),
+            invoke_step=jnp.where(can_load, step, sess.invoke_step),
+            op_idx=jnp.where(is_nop, sess.op_idx + 1, sess.op_idx),
+        )
 
-    # --- reads + issue -----------------------------------------------------
-    # One bank-row gather serves the Valid check and the read value; the
-    # arbiter rides a second, 1-word gather (gathers are near-free here).
-    # Everything stays BYTES: the state is the low 3 bits of byte 0, and
-    # the value is an opaque payload — no int32 assembly on the hot path.
-    krow8 = table.bank[sess.key]  # (R, S, 4*(1+V)) int8
-    k_vpts = table.vpts[sess.key]
-    k_valid = (krow8[..., 0] & 7) == t.VALID
-    rd_val = krow8[..., 4:]
+    sub_comps = []
+    read_extra = jnp.zeros((R, S), jnp.int32)
+    for sub in range(cfg.read_unroll):
+        sess = _intake(sess)
+        # One bank-row gather serves the Valid check and the read value; the
+        # arbiter rides a second, 1-word gather (gathers are near-free
+        # here).  Everything stays BYTES: the state is the low 3 bits of
+        # byte 0, and the value is an opaque payload.
+        krow8 = table.bank[sess.key]  # (R, S, 4*(1+V)) int8
+        k_vpts = table.vpts[sess.key]
+        k_valid = (krow8[..., 0] & 7) == t.VALID
+        rd_val = krow8[..., 4:]
+        read_done = (sess.status == t.S_READ) & k_valid & ~frozen
+        if sub < cfg.read_unroll - 1:
+            sess = sess._replace(
+                status=jnp.where(read_done, t.S_IDLE, sess.status),
+                op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
+                rd_val=jnp.where(read_done[..., None], rd_val, sess.rd_val),
+            )
+            # program-order completion record for this sub-step (reads only;
+            # discarded by the bench scan, consumed by recorders/clients)
+            sub_comps.append(st.Completions(
+                code=jnp.where(read_done, t.C_READ, t.C_NONE).astype(jnp.int32),
+                key=sess.key,
+                wval=_bank_to_i32(sess.val),
+                rval=_bank_to_i32(sess.rd_val),
+                ver=pts_ver(sess.pts),
+                fc=pts_fc(sess.pts),
+                invoke_step=sess.invoke_step,
+                commit_step=jnp.broadcast_to(step, (R, S)).astype(jnp.int32),
+            ))
+            read_extra = read_extra + read_done.astype(jnp.int32)
 
-    read_done = (sess.status == t.S_READ) & k_valid & ~frozen
+    # final sub-step: status/op_idx advance here; the rd_val write is merged
+    # with the RMW read-part snapshot below (disjoint masks)
     sess = sess._replace(
         status=jnp.where(read_done, t.S_IDLE, sess.status),
         op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
@@ -599,7 +635,8 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
 
     fs = fs._replace(table=table, sess=sess, replay=replay)
-    return fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done
+    return (fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done,
+            read_extra, sub_comps)
 
 
 def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv):
@@ -770,7 +807,7 @@ def _slot_to_lane_acks(cfg: HermesConfig, gained_slot, nacked_slot, slot_lane):
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
                   gained, nacked, taken_lane, slot_lane, read_done,
-                  post_lane=None):
+                  read_extra, post_lane=None):
     """Coordinator-side ``poll_acks()`` + commit + VAL build
     (BASELINE.json:5).  ``gained``/``nacked`` are per-LANE (R, L): derived
     directly there in batched mode (_derived_acks), routed back from the
@@ -840,7 +877,8 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
         commit_step=jnp.broadcast_to(step, (R, S)).astype(jnp.int32),
     )
     meta = meta._replace(
-        n_read=meta.n_read + ctr[:, kernels.CTR_READ],
+        n_read=meta.n_read + ctr[:, kernels.CTR_READ]
+        + jnp.sum(read_extra, axis=1),
         n_write=meta.n_write + ctr[:, kernels.CTR_WRITE],
         n_rmw=meta.n_rmw + ctr[:, kernels.CTR_RMW],
         n_abort=meta.n_abort + ctr[:, kernels.CTR_ABORT],
@@ -865,18 +903,19 @@ def fast_round_batched(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     exchange ops at all on a single chip.  The commit decision lands in the
     same round, so the winner table write (_apply_commit) happens once with
     the final state — the separate VAL phase does not exist here."""
-    fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done = (
-        _coordinate(cfg, ctl, fs, stream)
-    )
+    (fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done,
+     read_extra, sub_comps) = _coordinate(cfg, ctl, fs, stream)
     fs = _apply_inv_arb(cfg, ctl, fs, out_inv)
     gained, nacked, win_lane, post_lane = _derived_acks(
         ctl, fs.table, taken_lane, pend_key, pend_pts
     )
     fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
                                       taken_lane, slot_lane, read_done,
-                                      post_lane=post_lane)
+                                      read_extra, post_lane=post_lane)
     win0 = jnp.take_along_axis(win_lane, slot_lane, axis=1)
     fs = _apply_commit(cfg, ctl, fs, out_inv, win0, out_val.valid, out_val.epoch)
+    if sub_comps:
+        comp = tuple(sub_comps) + (comp,)
     return fs, comp
 
 
@@ -884,9 +923,8 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     """One protocol round on the mesh (transport=tpu_ici, BASELINE.json:5):
     INV and VAL blocks ride ``all_gather`` and the ACK verdicts ride
     ``all_to_all`` over the 'replica' ICI axis."""
-    fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done = (
-        _coordinate(cfg, ctl, fs, stream)
-    )
+    (fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done,
+     read_extra, sub_comps) = _coordinate(cfg, ctl, fs, stream)
     inv_src = jax.tree.map(_ici_gather_src, out_inv)
     fs, ack_flags, win0 = _apply_inv(cfg, ctl, fs, inv_src)
     gained_slot, nacked_slot = _wire_acks(
@@ -894,10 +932,13 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
     gained, nacked = _slot_to_lane_acks(cfg, gained_slot, nacked_slot, slot_lane)
     fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
-                                      taken_lane, slot_lane, read_done)
+                                      taken_lane, slot_lane, read_done,
+                                      read_extra)
     val_bits = _ici_gather_src(out_val.valid)
     val_epochs = _ici_gather_src(out_val.epoch)
     fs = _apply_commit(cfg, ctl, fs, inv_src, win0, val_bits, val_epochs)
+    if sub_comps:
+        comp = tuple(sub_comps) + (comp,)
     return fs, comp
 
 

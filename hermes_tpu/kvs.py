@@ -75,6 +75,12 @@ class KVS:
                  record: bool = False):
         if cfg.value_words < 3:
             raise ValueError("KVS needs value_words >= 3 (2 uid words + payload)")
+        if cfg.read_unroll != 1:
+            raise ValueError(
+                "KVS uses a one-deep rewritable stream (one client op per "
+                "session in flight); read_unroll > 1 would re-execute the "
+                "same op within a round — drive throughput with more "
+                "sessions instead")
         if cfg.device_stream:
             raise ValueError("KVS drives ops through the stream; device_stream "
                              "would replace client requests with hash-generated ops")

@@ -366,7 +366,12 @@ class FastRuntime:
             return None
         comp_np = jax.device_get(comp)
         if self.recorder is not None:
-            self.recorder.record_step(comp_np)
+            # read_unroll > 1 yields one Completions per sub-step, in
+            # program order; record each
+            multi = isinstance(comp_np, tuple) and not isinstance(comp_np, st.Completions)
+            subs = comp_np if multi else (comp_np,)
+            for c in subs:
+                self.recorder.record_step(c)
         self.step_idx += 1
         if self.membership is not None:
             self.membership.poll(self)
@@ -415,8 +420,10 @@ class FastRuntime:
     def _sess_view(self):
         fst = self._fst
         sess = jax.device_get(self.fs.sess)
+        # sess.val holds int8 value BYTES; recorders read uid WORDS 0-1
+        val32 = np.asarray(jax.device_get(fst._bank_to_i32(jnp.asarray(sess.val))))
         return type("SessView", (), dict(
-            status=sess.status, op=sess.op, key=sess.key, val=sess.val,
+            status=sess.status, op=sess.op, key=sess.key, val=val32,
             ver=np.asarray(fst.pts_ver(jnp.asarray(sess.pts))),
             fc=np.asarray(fst.pts_fc(jnp.asarray(sess.pts))),
             invoke_step=sess.invoke_step,

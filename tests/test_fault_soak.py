@@ -1,0 +1,80 @@
+"""Randomized fault-injection soak over the fast engine (SURVEY.md §4.4).
+
+A seeded scheduler drives freeze / lease-style remove / rejoin-with-state-
+transfer / spontaneous-thaw events against a running workload, then heals
+the cluster, drains, and gates the whole history on the linearizability
+checker.  This stresses exactly the paths the optimized engine treats
+specially: replay of dead coordinators' writes, commit-during-backoff after
+live-mask shrinks, duplicate (key, ts) slots from replay rebroadcasts, and
+join state transfer — under arbitrary interleavings rather than the
+hand-written drills.
+"""
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import types as t
+from hermes_tpu.runtime import FastRuntime
+
+from helpers import get
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_random_fault_soak_checked(seed):
+    R = 5
+    cfg = HermesConfig(
+        n_replicas=R, n_keys=96, n_sessions=6, replay_slots=6,
+        ops_per_session=30, replay_age=6, replay_scan_every=4,
+        rebroadcast_every=2,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=seed),
+    )
+    rt = FastRuntime(cfg, record=True)
+    rng = np.random.default_rng(seed)
+
+    frozen_since = {}  # replica -> step frozen (still in live mask)
+    removed = set()
+
+    for step in range(260):
+        rt.step_once()
+        live = int(rt.live[0])
+        alive_ok = [r for r in range(R) if (live >> r) & 1 and not rt.frozen[r]]
+
+        # lease-style detection: a replica frozen too long gets removed
+        for r, since in list(frozen_since.items()):
+            if step - since > 5:
+                rt.remove(r)
+                removed.add(r)
+                del frozen_since[r]
+
+        u = rng.random()
+        if u < 0.06 and len(alive_ok) > 3:
+            r = int(rng.choice(alive_ok))
+            rt.freeze(r)
+            frozen_since[r] = step
+        elif u < 0.10 and frozen_since:
+            # spontaneous recovery before the lease fires
+            r = int(rng.choice(list(frozen_since)))
+            rt.thaw(r)
+            del frozen_since[r]
+        elif u < 0.16 and removed:
+            r = removed.pop()
+            donor = int(rng.choice([d for d in range(R) if (int(rt.live[0]) >> d) & 1
+                                    and not rt.frozen[d]]))
+            rt.join(r, from_replica=donor)
+
+    # heal: thaw stragglers, rejoin everyone, let the workload finish
+    for r in list(frozen_since):
+        rt.thaw(r)
+    for r in list(removed):
+        donor = next(d for d in range(R) if (int(rt.live[0]) >> d) & 1 and not rt.frozen[d])
+        rt.join(r, from_replica=donor)
+    assert rt.drain(4000), "cluster did not drain after healing"
+
+    v = rt.check()
+    assert v.ok, (v.failures[:3], v.undecided[:3])
+    # every key readable again and totals conserved
+    sst = get(rt.fs.table.sst)
+    assert ((sst & 7) == t.VALID).all()
+    c = rt.counters()
+    assert c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"] == R * 6 * 30
