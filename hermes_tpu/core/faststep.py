@@ -354,6 +354,12 @@ class FastCtl(NamedTuple):
     frozen: jnp.ndarray  # (R,) bool
 
 
+def _stream_idx(cfg: HermesConfig, op_idx):
+    """Stream slot addressed by a session's op counter (wrap vs clip)."""
+    G = cfg.ops_per_session
+    return op_idx % G if cfg.wrap_stream else jnp.clip(op_idx, 0, G - 1)
+
+
 def _write_value(cfg: HermesConfig, my_cid, op_idx):
     """Unique write values (checker witness): words 0/1 = (lo, hi) uid,
     identical formula to phases._write_value."""
@@ -392,10 +398,9 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     def _intake(sess):
         if cfg.wrap_stream:
             can_load = (sess.status == t.S_IDLE) & ~frozen
-            g = sess.op_idx % G
         else:
             can_load = (sess.status == t.S_IDLE) & (sess.op_idx < G) & ~frozen
-            g = jnp.clip(sess.op_idx, 0, G - 1)
+        g = _stream_idx(cfg, sess.op_idx)
         if cfg.device_stream:
             # counter-hash op stream (SURVEY.md §2 "in-kernel PRNG"): ONE
             # shared formula with the host twin (workload.ycsb.stream_hash)
@@ -417,13 +422,6 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         else:
             new_op = jnp.take_along_axis(stream.op, g[..., None], axis=2)[..., 0]
             new_key = jnp.take_along_axis(stream.key, g[..., None], axis=2)[..., 0]
-        new_val = _i32_to_bank(_write_value(cfg, ctl.my_cid, sess.op_idx))
-        if stream.uval is not None:
-            # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry
-            # the user value; words 0-1 keep the derived unique write id.
-            # uval is pre-converted to bytes by prep_stream.
-            uval = jnp.take_along_axis(stream.uval, g[..., None, None], axis=2)[:, :, 0]
-            new_val = jnp.concatenate([new_val[..., :8], uval], axis=-1)
         is_nop = can_load & (new_op == t.OP_NOP)
         status = jnp.where(
             can_load,
@@ -437,7 +435,6 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             status=status,
             op=jnp.where(can_load, new_op, sess.op),
             key=jnp.where(can_load, new_key, sess.key),
-            val=jnp.where(can_load[..., None], new_val, sess.val),
             invoke_step=jnp.where(can_load, step, sess.invoke_step),
             op_idx=jnp.where(is_nop, sess.op_idx + 1, sess.op_idx),
         )
@@ -451,7 +448,6 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         # here).  Everything stays BYTES: the state is the low 3 bits of
         # byte 0, and the value is an opaque payload.
         krow8 = table.bank[sess.key]  # (R, S, 4*(1+V)) int8
-        k_vpts = table.vpts[sess.key]
         k_valid = (krow8[..., 0] & 7) == t.VALID
         rd_val = krow8[..., 4:]
         read_done = (sess.status == t.S_READ) & k_valid & ~frozen
@@ -480,6 +476,24 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     sess = sess._replace(
         status=jnp.where(read_done, t.S_IDLE, sess.status),
         op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
+    )
+
+    # The arbiter ts is only consumed by the issue path, and write values
+    # only exist for updates loaded this round — both are materialized ONCE
+    # here rather than per sub-step (the value formula depends only on
+    # (cid, session, op_idx), which still addresses the loaded update).
+    k_vpts = table.vpts[sess.key]
+    w_loaded = (sess.status == t.S_ISSUE) & (sess.invoke_step == step)
+    new_wval = _i32_to_bank(_write_value(cfg, ctl.my_cid, sess.op_idx))
+    if stream.uval is not None:
+        # client-supplied payload (hermes_tpu/kvs.py): words 2.. carry the
+        # user value; words 0-1 keep the derived unique write id.  uval is
+        # pre-converted to bytes by prep_stream.
+        gw = _stream_idx(cfg, sess.op_idx)
+        uval = jnp.take_along_axis(stream.uval, gw[..., None, None], axis=2)[:, :, 0]
+        new_wval = jnp.concatenate([new_wval[..., :8], uval], axis=-1)
+    sess = sess._replace(
+        val=jnp.where(w_loaded[..., None], new_wval, sess.val)
     )
 
     # Same-key same-replica issue arbitration via a small hash-slot race:
