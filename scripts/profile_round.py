@@ -63,12 +63,12 @@ def full(ctl, fs, stream):
 
 
 def coordinate_only(ctl, fs, stream):
-    fs2, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done = fst._coordinate(cfg, ctl, fs, stream)
+    fs2, out_inv, *_ = fst._coordinate(cfg, ctl, fs, stream)
     return fs2
 
 
 def through_apply_inv(ctl, fs, stream):
-    fs2, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done = fst._coordinate(cfg, ctl, fs, stream)
+    fs2, out_inv, *_ = fst._coordinate(cfg, ctl, fs, stream)
     fs3 = fst._apply_inv_arb(cfg, ctl, fs2, out_inv)
     return fs3
 
