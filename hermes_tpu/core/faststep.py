@@ -248,6 +248,19 @@ class FastInv(NamedTuple):
         return self.pkf & INV_KEY_MASK
 
 
+class LaneBlock(NamedTuple):
+    """Per-LANE pending-update view (R, L, ...): every session and replay
+    slot's (key, ts, value) plus the fresh bit.  The batched engine applies
+    the protocol straight from this block (mask = which lanes broadcast);
+    the sharded engine compacts it to the C-slot wire block
+    (_compact_out_inv) first."""
+
+    key: jnp.ndarray  # (R, L)
+    pts: jnp.ndarray  # (R, L)
+    val: jnp.ndarray  # (R, L, 4V) int8
+    fresh: jnp.ndarray  # (R, L) bool
+
+
 class FastAck(NamedTuple):
     """ACK block, slot-aligned with the acked INV block.  ``pkf`` packs
     (key << 2) | (ok << 1) | valid into one word — the echoed key plus the
@@ -630,27 +643,37 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         ),
     )
 
-    pend_key = jnp.concatenate([sess.key, replay.key], axis=1)
-    pend_pts = jnp.concatenate([sess.pts, replay.pts], axis=1)
-    pend_val = jnp.concatenate([sess.val, replay.val], axis=1)
-    lane_pkf = (
-        pend_key
-        | jnp.where(lane_fresh, INV_FRESH, 0)
-        | jnp.where(taken_lane, INV_VALID, 0)
-    )
-    out_inv = FastInv(
-        pkf=jnp.take_along_axis(lane_pkf, slot_lane, axis=1),
-        pts=jnp.take_along_axis(pend_pts, slot_lane, axis=1),
-        val=jnp.take_along_axis(
-            pend_val, slot_lane[..., None], axis=1
-        ),
-        epoch=ctl.epoch,
-        alive=~ctl.frozen,
+    lanes = LaneBlock(
+        key=jnp.concatenate([sess.key, replay.key], axis=1),
+        pts=jnp.concatenate([sess.pts, replay.pts], axis=1),
+        val=jnp.concatenate([sess.val, replay.val], axis=1),
+        fresh=lane_fresh,
     )
 
     fs = fs._replace(table=table, sess=sess, replay=replay)
-    return (fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done,
-            read_extra, sub_comps)
+    return (fs, lanes, slot_lane, taken_lane, read_done, read_extra, sub_comps)
+
+
+def _compact_out_inv(ctl: FastCtl, lanes: "LaneBlock", slot_lane, taken_lane):
+    """Lane block -> wire-shaped INV block (the C-slot broadcast batch,
+    SURVEY.md §1 L1).  Only the sharded path pays these take_alongs: the
+    batched emulation scatters straight from the lane arrays
+    (fast_round_batched) — each take_along here costs ~1.5-2 ms of nearly
+    size-independent sparse-op overhead on the target runtime, so routing
+    lanes->slots->table was measured strictly worse than lanes->table when
+    no physical wire exists."""
+    lane_pkf = (
+        lanes.key
+        | jnp.where(lanes.fresh, INV_FRESH, 0)
+        | jnp.where(taken_lane, INV_VALID, 0)
+    )
+    return FastInv(
+        pkf=jnp.take_along_axis(lane_pkf, slot_lane, axis=1),
+        pts=jnp.take_along_axis(lanes.pts, slot_lane, axis=1),
+        val=jnp.take_along_axis(lanes.val, slot_lane[..., None], axis=1),
+        epoch=ctl.epoch,
+        alive=~ctl.frozen,
+    )
 
 
 def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv):
@@ -690,18 +713,71 @@ def _apply_inv_arb(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     (win/ack/nack) are derived per LANE afterwards from a single vpts gather
     (_derived_acks) — gathers are near-free on this runtime while the
     per-slot post0 gather + slot->lane scatter of the wire path are not."""
-    table = fs.table
-    key0, pts0 = inv_src.key, inv_src.pts
     v_ok = inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
-    oob = table.vpts.shape[0]
-    vpts = table.vpts.at[jnp.where(v_ok, key0, oob)].max(pts0, mode="drop")
+    table = _ts_scatter_max(fs.table, inv_src.key, inv_src.pts, v_ok)
     meta = fs.meta._replace(
         last_seen=jnp.where(
             inv_src.alive[None, :] & ~ctl.frozen[:, None], ctl.step,
             fs.meta.last_seen,
         )
     )
-    return fs._replace(table=table._replace(vpts=vpts), meta=meta)
+    return fs._replace(table=table, meta=meta)
+
+
+def _ts_scatter_max(table: FastTable, keys, pts, mask):
+    """The shared arbitration core: scatter-MAX of packed timestamps into
+    the vpts column for every masked (key, ts) row.  Both engines route
+    here — slots (_apply_inv_arb) and lanes (_apply_inv_lanes) differ only
+    in which rows the mask admits."""
+    oob = table.vpts.shape[0]
+    vpts = table.vpts.at[jnp.where(mask, keys, oob)].max(pts, mode="drop")
+    return table._replace(vpts=vpts)
+
+
+def _winner_row_scatter(ctl: FastCtl, table: FastTable, keys, vals,
+                        win, vbit, fresh):
+    """The shared winner-write core (the round's single [sst|val] table
+    scatter): every winning row lands with its state chosen by the commit
+    bit; the write mask admits only rows deterministic under duplicate
+    indices — FRESH rows (unique per (key, ts)) or committing rows (all
+    duplicates produce the identical VALID row).  Both engines route here —
+    per-slot (_apply_commit) and per-lane (_apply_commit_lanes) inputs
+    produce the same written-row multiset."""
+    state_new = jnp.where(vbit, t.VALID, t.INVALID)
+    sstv8 = _i32_to_bank(pack_sst(ctl.step, state_new)[..., None])
+    upd8 = jnp.concatenate([sstv8, vals], axis=-1)
+    write0 = win & (fresh | vbit)
+    rows = jnp.where(write0, keys, table.bank.shape[0])
+    return table._replace(bank=table.bank.at[rows].set(upd8, mode="drop"))
+
+
+def _apply_inv_lanes(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
+                     lanes: LaneBlock, taken_lane):
+    """Batched-mode ``apply_inv`` scattering straight from the LANE block:
+    identical row multiset to _apply_inv_arb over the compacted slots
+    (taken_lane marks exactly the lanes holding a slot; OOB-masked rows cost
+    the same as live rows on this chip, so the wider lane extent is free),
+    minus the lane->slot take_along routing."""
+    v_ok = taken_lane & (ctl.epoch == ctl.epoch[0])[:, None]
+    table = _ts_scatter_max(fs.table, lanes.key, lanes.pts, v_ok)
+    meta = fs.meta._replace(
+        last_seen=jnp.where(
+            ~ctl.frozen[None, :] & ~ctl.frozen[:, None], ctl.step,
+            fs.meta.last_seen,
+        )
+    )
+    return fs._replace(table=table, meta=meta)
+
+
+def _apply_commit_lanes(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
+                        lanes: LaneBlock, win_lane, commit_lane):
+    """Batched-mode winner table write from the LANE block (vbit = the lane
+    committed this round).  win_lane already implies taken_lane
+    (_derived_acks), so the written row multiset is exactly the slot path's."""
+    vbit = commit_lane & (ctl.epoch == ctl.epoch[0])[:, None]
+    table = _winner_row_scatter(ctl, fs.table, lanes.key, lanes.val,
+                                win_lane, vbit, lanes.fresh)
+    return fs._replace(table=table)
 
 
 def _apply_commit(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
@@ -732,16 +808,10 @@ def _apply_commit(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     _apply_inv scatter-max already placed the winner's ts.  Full-row
     windows are the fast TPU scatter path; an offset window was measured
     50x slower."""
-    table = fs.table
-    key0 = inv_src.key
     vbit = val_bits & (val_epochs == ctl.epoch[0])[..., None]
-    state_new = jnp.where(vbit, t.VALID, t.INVALID)
-    sstv8 = _i32_to_bank(pack_sst(ctl.step, state_new)[..., None])
-    upd8 = jnp.concatenate([sstv8, inv_src.val], axis=-1)  # byte row [sst|val]
-    write0 = win0 & (inv_src.fresh | vbit)
-    rows = jnp.where(write0, key0, table.bank.shape[0])
-    bank = table.bank.at[rows].set(upd8, mode="drop")
-    return fs._replace(table=table._replace(bank=bank))
+    table = _winner_row_scatter(ctl, fs.table, inv_src.key, inv_src.val,
+                                win0, vbit, inv_src.fresh)
+    return fs._replace(table=table)
 
 
 def _derived_acks(ctl: FastCtl, table: FastTable, taken_lane, pend_key,
@@ -820,7 +890,7 @@ def _slot_to_lane_acks(cfg: HermesConfig, gained_slot, nacked_slot, slot_lane):
 
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
-                  gained, nacked, taken_lane, slot_lane, read_done,
+                  gained, nacked, taken_lane, read_done,
                   read_extra, post_lane=None):
     """Coordinator-side ``poll_acks()`` + commit + VAL build
     (BASELINE.json:5).  ``gained``/``nacked`` are per-LANE (R, L): derived
@@ -872,9 +942,9 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # receivers reconstruct (key, pts) from the INV block they already hold;
     # the winner's single [sst|val] write (_apply_commit) covers the
     # committer's own table too, so no separate commit scatter exists.
+    # Returned per LANE; the sharded caller routes it to slots
+    # (take_along over slot_lane) to put it on the wire.
     commit_lane = jnp.concatenate([commit, rcommit & rowns], axis=1)
-    commit_at_slot = jnp.take_along_axis(commit_lane, slot_lane, axis=1)
-    out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
 
     # --- session completion + stats (fused Pallas kernel) -----------------
     code, ctr, hist_add = kernels.stats_block(
@@ -907,27 +977,33 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
         status=jnp.where(done, t.S_IDLE, sess.status),
         op_idx=jnp.where(done, sess.op_idx + 1, sess.op_idx),
     )
-    return fs._replace(table=table, sess=sess, replay=replay, meta=meta), out_val, comp
+    fs = fs._replace(table=table, sess=sess, replay=replay, meta=meta)
+    return fs, commit_lane, comp
 
 
 def fast_round_batched(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     """One protocol round, batched lockstep emulation: the broadcast IS the
-    outbound block (every replica sees the same source-shaped tensors), and
-    the ACK bitmap derives from the shared verdicts (_derived_acks) — no
-    exchange ops at all on a single chip.  The commit decision lands in the
-    same round, so the winner table write (_apply_commit) happens once with
-    the final state — the separate VAL phase does not exist here."""
-    (fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done,
+    lane block (every replica sees the same source-shaped tensors), and the
+    ACK bitmap derives from the shared verdicts (_derived_acks) — no
+    exchange ops at all on a single chip.  The protocol applies STRAIGHT
+    from the lane arrays: compaction (slot_lane) only decides WHICH lanes
+    broadcast (taken_lane); the per-slot wire tensors are never built —
+    every lane->slot take_along costs ~1.5-2 ms of size-independent
+    sparse-op overhead on this runtime (measured; see _compact_out_inv),
+    and scatters cost the same over the wider OOB-masked lane extent.  The
+    commit decision lands in the same round, so the winner table write
+    (_apply_commit_lanes) happens once with the final state — the separate
+    VAL phase does not exist here."""
+    (fs, lanes, slot_lane, taken_lane, read_done,
      read_extra, sub_comps) = _coordinate(cfg, ctl, fs, stream)
-    fs = _apply_inv_arb(cfg, ctl, fs, out_inv)
+    fs = _apply_inv_lanes(cfg, ctl, fs, lanes, taken_lane)
     gained, nacked, win_lane, post_lane = _derived_acks(
-        ctl, fs.table, taken_lane, pend_key, pend_pts
+        ctl, fs.table, taken_lane, lanes.key, lanes.pts
     )
-    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
-                                      taken_lane, slot_lane, read_done,
-                                      read_extra, post_lane=post_lane)
-    win0 = jnp.take_along_axis(win_lane, slot_lane, axis=1)
-    fs = _apply_commit(cfg, ctl, fs, out_inv, win0, out_val.valid, out_val.epoch)
+    fs, commit_lane, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
+                                          taken_lane, read_done,
+                                          read_extra, post_lane=post_lane)
+    fs = _apply_commit_lanes(cfg, ctl, fs, lanes, win_lane, commit_lane)
     if sub_comps:
         comp = tuple(sub_comps) + (comp,)
     return fs, comp
@@ -937,17 +1013,20 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     """One protocol round on the mesh (transport=tpu_ici, BASELINE.json:5):
     INV and VAL blocks ride ``all_gather`` and the ACK verdicts ride
     ``all_to_all`` over the 'replica' ICI axis."""
-    (fs, out_inv, slot_lane, taken_lane, pend_key, pend_pts, read_done,
+    (fs, lanes, slot_lane, taken_lane, read_done,
      read_extra, sub_comps) = _coordinate(cfg, ctl, fs, stream)
+    out_inv = _compact_out_inv(ctl, lanes, slot_lane, taken_lane)
     inv_src = jax.tree.map(_ici_gather_src, out_inv)
     fs, ack_flags, win0 = _apply_inv(cfg, ctl, fs, inv_src)
     gained_slot, nacked_slot = _wire_acks(
         cfg, ctl, inv_src, ack_flags, out_inv, _ici_route_back
     )
     gained, nacked = _slot_to_lane_acks(cfg, gained_slot, nacked_slot, slot_lane)
-    fs, out_val, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
-                                      taken_lane, slot_lane, read_done,
-                                      read_extra)
+    fs, commit_lane, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
+                                          taken_lane, read_done,
+                                          read_extra)
+    commit_at_slot = jnp.take_along_axis(commit_lane, slot_lane, axis=1)
+    out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
     val_bits = _ici_gather_src(out_val.valid)
     val_epochs = _ici_gather_src(out_val.epoch)
     fs = _apply_commit(cfg, ctl, fs, inv_src, win0, val_bits, val_epochs)

@@ -1,0 +1,120 @@
+"""Ablation profiling of the fast round at the BENCH configuration.
+
+Methodology (ARCHITECTURE.md): monkeypatch one phase at a time to a shape-
+preserving no-op inside a donated scan chunk, force synchronous mode with a
+readback, and attribute the full-vs-ablated difference to the phase.  The
+ablated programs compute WRONG protocol results — this is a timing harness
+only.  Run:
+
+    python scripts/profile_ablate.py [S] [C] [rounds]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import faststep as fst
+from hermes_tpu.core import kernels
+from hermes_tpu.workload import ycsb
+
+jax.device_get(jnp.zeros(8) + 1)  # force synchronous (honest) mode
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+C = int(sys.argv[2]) if len(sys.argv) > 2 else 24576
+ROUNDS = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+
+cfg = HermesConfig(
+    n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=S,
+    replay_slots=256, ops_per_session=256, wrap_stream=True,
+    device_stream=True, lane_budget_cfg=C, read_unroll=2,
+    rebroadcast_every=4, replay_scan_every=32,
+    workload=WorkloadConfig(read_frac=0.5, seed=0),
+)
+
+
+def timed(reps=3):
+    fs0 = jax.device_put(fst.init_fast_state(cfg))
+    stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
+    chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
+    fs = chunk(fs0, stream, fst.make_fast_ctl(cfg, 0))
+    jax.block_until_ready(fs)
+    jax.device_get(jax.tree.map(lambda x: x.ravel()[0], fs))
+    t0 = time.perf_counter()
+    for c in range(1, 1 + reps):
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * ROUNDS))
+    jax.block_until_ready(fs)
+    jax.device_get(jax.tree.map(lambda x: x.ravel()[0], fs))
+    dt = (time.perf_counter() - t0) / reps / ROUNDS * 1e3
+    m = jax.device_get(fs.meta)
+    commits = int(m.n_write.sum() + m.n_rmw.sum()) / (1 + reps) / ROUNDS
+    return dt, commits
+
+
+orig = {
+    "_apply_commit_lanes": fst._apply_commit_lanes,
+    "_apply_inv_lanes": fst._apply_inv_lanes,
+    "stats_block": kernels.stats_block,
+    "sort": jax.lax.sort,
+    "_write_value": fst._write_value,
+}
+
+
+def restore():
+    fst._apply_commit_lanes = orig["_apply_commit_lanes"]
+    fst._apply_inv_lanes = orig["_apply_inv_lanes"]
+    kernels.stats_block = orig["stats_block"]
+    jax.lax.sort = orig["sort"]
+    fst._write_value = orig["_write_value"]
+
+
+def run(name, patch=None):
+    restore()
+    if patch:
+        patch()
+    dt, commits = timed()
+    print(f"  {name:28s}: {dt:7.2f} ms/round   ({commits:8.0f} commits/round)")
+    restore()
+    return dt
+
+
+base = run("full round")
+
+run("no commit row-scatter", lambda: setattr(
+    fst, "_apply_commit_lanes",
+    lambda cfg, ctl, fs, lanes, win_lane, commit_lane: fs))
+
+run("no vpts scatter-max", lambda: setattr(
+    fst, "_apply_inv_lanes", lambda cfg, ctl, fs, lanes, taken_lane: fs))
+
+
+def _no_stats():
+    from hermes_tpu.core import state as st
+    from hermes_tpu.core import types as t
+
+    def fake(step, op, invoke_step, commit, abort, read_done):
+        R, Sd = op.shape
+        code = jnp.zeros((R, Sd), jnp.int32)
+        ctr = jnp.zeros((R, 8), jnp.int32)
+        ctr = ctr.at[:, kernels.CTR_WRITE].set(
+            jnp.sum((commit & (op == t.OP_WRITE)).astype(jnp.int32), axis=1))
+        ctr = ctr.at[:, kernels.CTR_RMW].set(
+            jnp.sum((commit & (op == t.OP_RMW)).astype(jnp.int32), axis=1))
+        hist = jnp.zeros((R, st.LAT_BINS), jnp.int32)
+        return code, ctr, hist
+    kernels.stats_block = fake
+
+
+run("no stats kernel", _no_stats)
+
+run("no compaction sort", lambda: setattr(
+    jax.lax, "sort", lambda x, dimension=-1: x))
+
+run("no write-value materialize", lambda: setattr(
+    fst, "_write_value",
+    lambda cfg, my_cid, op_idx: jnp.zeros(
+        op_idx.shape + (cfg.value_words,), jnp.int32)))

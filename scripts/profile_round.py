@@ -63,13 +63,13 @@ def full(ctl, fs, stream):
 
 
 def coordinate_only(ctl, fs, stream):
-    fs2, out_inv, *_ = fst._coordinate(cfg, ctl, fs, stream)
+    fs2, *_ = fst._coordinate(cfg, ctl, fs, stream)
     return fs2
 
 
 def through_apply_inv(ctl, fs, stream):
-    fs2, out_inv, *_ = fst._coordinate(cfg, ctl, fs, stream)
-    fs3 = fst._apply_inv_arb(cfg, ctl, fs2, out_inv)
+    fs2, lanes, slot_lane, taken_lane, *_ = fst._coordinate(cfg, ctl, fs, stream)
+    fs3 = fst._apply_inv_lanes(cfg, ctl, fs2, lanes, taken_lane)
     return fs3
 
 
