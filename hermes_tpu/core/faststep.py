@@ -30,10 +30,10 @@ The key engineering moves, mapped to the reference:
   2. **Packed state+age** ``sst = (last_change_step << 3) | state``: the
      per-key state machine word and the replay age (SURVEY.md §3.4) travel
      in one scatter.
-  3. **One fused key-state row** ``kv = [vpts | sst | val]`` (K, 2+V): the
-     authoritative per-key columns live in ONE array, so the session-side
-     read (arbiter ts + Valid check + read value) is ONE gather, and the
-     winner apply (state + value) is ONE scatter.  The round writes each
+  3. **One fused key-state row** ``bank = [pts | sst | val]`` (K, 2+V): the
+     per-key columns the session side touches live in ONE array, so the
+     session-side read (arbiter ts + Valid check + read value) is ONE
+     gather, and the winner apply (ts + state + value) is ONE scatter.  The round writes each
      key's final state ONCE: the commit decision is made before the table
      write, so a winner lands directly as VALID (committed this round) or
      INVALID (awaiting acks) — the reference's separate apply_inv/apply_val
@@ -81,9 +81,15 @@ PTS_FC_BITS = 10  # fc = (flag << 8) | cid fits 10 bits (flag 2b, cid 8b)
 FC_MASK = (1 << PTS_FC_BITS) - 1
 I32_MIN = jnp.iinfo(jnp.int32).min
 
-# bank row layout (FastTable.bank, int8): bytes of [sst | val words]
-BANK_SST = 0  # int32-word index of sst within a bank row
-BANK_VAL = 1  # first int32-word index of the value
+# bank row layout (FastTable.bank, int8): bytes of [pts | sst | val words].
+# The pts word mirrors vpts for every key whose row was written by its
+# current winner — in particular for every VALID key (see FastTable): the
+# issue path reads its arbiter ts from the same row gather that serves the
+# Valid check and the read value, replacing a separate vpts gather (~1.9 ms
+# of flat sparse-op cost on this runtime).
+BANK_PTS = 0  # int32-word index of the mirrored packed-ts
+BANK_SST = 1  # int32-word index of sst within a bank row
+BANK_VAL = 2  # first int32-word index of the value
 
 # FastInv.pkf packing: key | fresh-bit | valid-bit (keys fit 29 bits — HBM
 # bounds n_keys far below 2^29; config validates).  One packed word means
@@ -136,15 +142,21 @@ class FastTable(NamedTuple):
       ``vpts`` (K,) int32 — max applied packed-ts, the Lamport conflict
         arbiter.  Its only write is the per-round scatter-MAX, which needs
         int32 compare semantics.
-      ``bank`` (K, 4*(1+V)) int8 — the BYTES of [sst | val words], where
-        sst packs (age_step << 3) | state.  Its only write is the winner
-        row SET-scatter, and int8 set-scatters move the same bytes ~2.3x
-        faster than int32 on this chip (measured: 16.2 ms -> 7.2 ms at
-        bench shape, including the vpts max) — a set is a pure byte move,
-        so the element type is free to be whatever scatters fastest.
+      ``bank`` (K, 4*(2+V)) int8 — the BYTES of [pts | sst | val words],
+        where sst packs (age_step << 3) | state and pts mirrors the winner's
+        packed ts (== vpts whenever the key is VALID: a key turns VALID only
+        through a winner-row write, which carries its own ts).  Its only
+        write is the winner row SET-scatter, and int8 set-scatters move the
+        same bytes ~2.3x faster than int32 on this chip (measured: 16.2 ms
+        -> 7.2 ms at bench shape, including the vpts max) — a set is a pure
+        byte move, so the element type is free to be whatever scatters
+        fastest.
 
-    The round reads the session row in one bank gather (+ a cheap vpts
-    gather) and writes each winner once: state and value land together,
+    The round reads the session row in ONE bank gather — Valid check, read
+    value, and the issue path's arbiter ts all from the same row (no
+    separate vpts gather; vpts is gathered only post-scatter for ack
+    derivation and in the gated replay scan) — and writes each winner once:
+    ts, state and value land together,
     with the commit decision made first, so there is no separate
     apply_inv/apply_val write pair (and no vpts rewrite — the scatter-max
     already placed it).  Two replicas can only disagree on these cells
@@ -160,7 +172,7 @@ class FastTable(NamedTuple):
     """
 
     vpts: jnp.ndarray  # (K,) int32 batched / (R*K,) sharded-global
-    bank: jnp.ndarray  # (K, 4*(1+V)) int8 rows [sst | val] as bytes
+    bank: jnp.ndarray  # (K, 4*(2+V)) int8 rows [pts | sst | val] as bytes
 
     # Read-only int32 views (tests/tools; traced code works on rows).
     @property
@@ -170,6 +182,10 @@ class FastTable(NamedTuple):
     @property
     def val(self):
         return _bank_to_i32(self.bank)[:, BANK_VAL:]
+
+    @property
+    def row_pts(self):
+        return _bank_to_i32(self.bank)[:, BANK_PTS]
 
 
 def _bank_to_i32(rows8):
@@ -299,7 +315,7 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
     # batched mode shares the authoritative table across the shard's
     # replicas; sharded init (n_local=r) allocates one set per future shard
     nv = 1 if n_local is None else r
-    rows32 = jnp.zeros((nv * k, 1 + v), jnp.int32)
+    rows32 = jnp.zeros((nv * k, 2 + v), jnp.int32)
     rows32 = rows32.at[:, BANK_VAL].set(jnp.tile(jnp.arange(k, dtype=jnp.int32), nv))
     rows32 = rows32.at[:, BANK_VAL + 1].set(-1)
     z = lambda *sh: jnp.zeros(sh, jnp.int32)
@@ -407,6 +423,13 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # (this round's writes apply later), so same-round reads of a key
     # return the same value and any linearization order works; sub-step
     # completions are recorded in program order (sub_comps).
+    #
+    # (A one-gather variant — stack the U candidate keys per session and
+    # gather (R,S,U) rows at once, then run the sub-steps as dense selects —
+    # was measured SLOWER at bench shape (17.5 vs 16.6 ms/round): the 2x-row
+    # gather plus the per-sub-step U-way dense row selects cost more than
+    # the second sequential row gather.  Sequential per-sub-step gathers
+    # stay.)
 
     def _intake(sess):
         if cfg.wrap_stream:
@@ -456,13 +479,14 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     read_extra = jnp.zeros((R, S), jnp.int32)
     for sub in range(cfg.read_unroll):
         sess = _intake(sess)
-        # One bank-row gather serves the Valid check and the read value; the
-        # arbiter rides a second, 1-word gather (gathers are near-free
-        # here).  Everything stays BYTES: the state is the low 3 bits of
-        # byte 0, and the value is an opaque payload.
-        krow8 = table.bank[sess.key]  # (R, S, 4*(1+V)) int8
-        k_valid = (krow8[..., 0] & 7) == t.VALID
-        rd_val = krow8[..., 4:]
+        # One bank-row gather serves the Valid check, the read value AND the
+        # issue-path arbiter ts (the row's pts word mirrors vpts for VALID
+        # keys — the only keys the issue path may act on).  Everything stays
+        # BYTES: the state is the low 3 bits of the sst word's first byte,
+        # and the value is an opaque payload.
+        krow8 = table.bank[sess.key]  # (R, S, 4*(2+V)) int8
+        k_valid = (krow8[..., 4 * BANK_SST] & 7) == t.VALID
+        rd_val = krow8[..., 4 * BANK_VAL:]
         read_done = (sess.status == t.S_READ) & k_valid & ~frozen
         if sub < cfg.read_unroll - 1:
             sess = sess._replace(
@@ -491,11 +515,13 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         op_idx=jnp.where(read_done, sess.op_idx + 1, sess.op_idx),
     )
 
-    # The arbiter ts is only consumed by the issue path, and write values
-    # only exist for updates loaded this round — both are materialized ONCE
-    # here rather than per sub-step (the value formula depends only on
-    # (cid, session, op_idx), which still addresses the loaded update).
-    k_vpts = table.vpts[sess.key]
+    # The arbiter ts is only consumed by the issue path — which requires the
+    # key VALID, so the final sub-step's row gather already delivered it (the
+    # row pts word; no separate vpts gather).  Write values only exist for
+    # updates loaded this round — materialized ONCE here rather than per
+    # sub-step (the value formula depends only on (cid, session, op_idx),
+    # which still addresses the loaded update).
+    k_vpts = _bank_to_i32(krow8[..., 4 * BANK_PTS: 4 * BANK_PTS + 4])[..., 0]
     w_loaded = (sess.status == t.S_ISSUE) & (sess.invoke_step == step)
     new_wval = _i32_to_bank(_write_value(cfg, ctl.my_cid, sess.op_idx))
     if stream.uval is not None:
@@ -535,7 +561,9 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         # same-ts re-INVs are idempotent (SURVEY.md §3.4), and any live
         # replica alone suffices to finish a dead coordinator's write.
         table, replay = args
-        sstK = _bank_to_i32(table.bank[:, :4]).reshape(1, -1)  # (1, nv*K)
+        sstK = _bank_to_i32(
+            table.bank[:, 4 * BANK_SST: 4 * BANK_SST + 4]
+        ).reshape(1, -1)  # (1, nv*K)
         age = step - sst_step(sstK)
         state = sst_state(sstK)
         # REPLAY is included: the shared mark means SOME replica snapshotted
@@ -559,18 +587,20 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             jnp.pad(cand_ok, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1
         )
         ck = jnp.take_along_axis(jnp.pad(cand, ((0, 0), (0, 1))), jnp.minimum(take, RS), axis=1)
-        ckrow8 = table.bank[ck]  # (R, RS, 4*(1+V)) snapshot byte rows
+        ckrow8 = table.bank[ck]  # (R, RS, 4*(2+V)) snapshot byte rows
+        ckval8 = ckrow8[..., 4 * BANK_VAL:]
         new_replay = FastReplay(
             active=jnp.where(take_ok, True, replay.active),
             key=jnp.where(take_ok, ck, replay.key),
             pts=jnp.where(take_ok, table.vpts[ck], replay.pts),
-            val=jnp.where(take_ok[..., None], ckrow8[..., 4:], replay.val),
+            val=jnp.where(take_ok[..., None], ckval8, replay.val),
             acks=jnp.where(take_ok, 0, replay.acks),
         )
         mark_sst = _i32_to_bank(
             pack_sst(step, jnp.full(ck.shape, t.REPLAY, jnp.int32))[..., None]
         )
-        mark = jnp.concatenate([mark_sst, ckrow8[..., 4:]], axis=-1)
+        mark = jnp.concatenate(
+            [ckrow8[..., : 4 * BANK_SST], mark_sst, ckval8], axis=-1)
         new_bank = table.bank.at[
             jnp.where(take_ok, ck, table.bank.shape[0])
         ].set(mark, mode="drop")
@@ -683,7 +713,7 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv)
 
     Arbitration ONLY — the winner's state+value table write is deferred to
     ``_apply_commit`` at the end of the round, once the commit decision is
-    known, so each key row is written once per round (fused [sst|val]
+    known, so each key row is written once per round (fused [pts|sst|val]
     scatter) instead of the reference's separate apply_inv/apply_val writes.
 
     Soundness of the shared table under lockstep: a key Valid at ts p on any
@@ -734,18 +764,19 @@ def _ts_scatter_max(table: FastTable, keys, pts, mask):
     return table._replace(vpts=vpts)
 
 
-def _winner_row_scatter(ctl: FastCtl, table: FastTable, keys, vals,
+def _winner_row_scatter(ctl: FastCtl, table: FastTable, keys, pts, vals,
                         win, vbit, fresh):
-    """The shared winner-write core (the round's single [sst|val] table
-    scatter): every winning row lands with its state chosen by the commit
-    bit; the write mask admits only rows deterministic under duplicate
-    indices — FRESH rows (unique per (key, ts)) or committing rows (all
-    duplicates produce the identical VALID row).  Both engines route here —
-    per-slot (_apply_commit) and per-lane (_apply_commit_lanes) inputs
-    produce the same written-row multiset."""
+    """The shared winner-write core (the round's single [pts|sst|val] table
+    scatter): every winning row lands with its own ts, its state chosen by
+    the commit bit; the write mask admits only rows deterministic under
+    duplicate indices — FRESH rows (unique per (key, ts)) or committing rows
+    (all duplicates produce the identical VALID row).  Both engines route
+    here — per-slot (_apply_commit) and per-lane (_apply_commit_lanes)
+    inputs produce the same written-row multiset."""
     state_new = jnp.where(vbit, t.VALID, t.INVALID)
-    sstv8 = _i32_to_bank(pack_sst(ctl.step, state_new)[..., None])
-    upd8 = jnp.concatenate([sstv8, vals], axis=-1)
+    head8 = _i32_to_bank(
+        jnp.stack([pts, pack_sst(ctl.step, state_new)], axis=-1))
+    upd8 = jnp.concatenate([head8, vals], axis=-1)
     write0 = win & (fresh | vbit)
     rows = jnp.where(write0, keys, table.bank.shape[0])
     return table._replace(bank=table.bank.at[rows].set(upd8, mode="drop"))
@@ -775,8 +806,8 @@ def _apply_commit_lanes(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     committed this round).  win_lane already implies taken_lane
     (_derived_acks), so the written row multiset is exactly the slot path's."""
     vbit = commit_lane & (ctl.epoch == ctl.epoch[0])[:, None]
-    table = _winner_row_scatter(ctl, fs.table, lanes.key, lanes.val,
-                                win_lane, vbit, lanes.fresh)
+    table = _winner_row_scatter(ctl, fs.table, lanes.key, lanes.pts,
+                                lanes.val, win_lane, vbit, lanes.fresh)
     return fs._replace(table=table)
 
 
@@ -784,7 +815,7 @@ def _apply_commit(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
                   inv_src: FastInv, win0, val_bits, val_epochs):
     """The round's single table write (replaces the reference's separate
     apply_inv value write + apply_val state write): every winning INV slot
-    lands its [sst | val] row in one scatter, with the state chosen by the
+    lands its [pts | sst | val] row in one scatter, with the state chosen by the
     slot's VAL bit — VALID if its write committed this round (SURVEY.md §3.1
     tail), INVALID if it is still gathering acks.  A superseded slot (not
     win0) writes nothing: its key belongs to the higher-ts winner, whose own
@@ -802,15 +833,15 @@ def _apply_commit(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     ts's value, and a key VALID at this ts stays readable: VALID means the
     ts committed somewhere, so an idempotent re-INV need not re-invalidate).
 
-    The scatter writes the full [sst | val] bank row as int8 BYTES — a set
+    The scatter writes the full [pts | sst | val] bank row as int8 BYTES — a set
     is a pure byte move, and int8 set-scatters move the same bytes ~2.3x
     faster than int32 on this chip.  vpts is not rewritten at all: the
     _apply_inv scatter-max already placed the winner's ts.  Full-row
     windows are the fast TPU scatter path; an offset window was measured
     50x slower."""
     vbit = val_bits & (val_epochs == ctl.epoch[0])[..., None]
-    table = _winner_row_scatter(ctl, fs.table, inv_src.key, inv_src.val,
-                                win0, vbit, inv_src.fresh)
+    table = _winner_row_scatter(ctl, fs.table, inv_src.key, inv_src.pts,
+                                inv_src.val, win0, vbit, inv_src.fresh)
     return fs._replace(table=table)
 
 
@@ -940,7 +971,7 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # (acks answer this round's INVs), so every committing lane holds a slot
     # in THIS round's compaction.  The VAL is then just a per-slot bit —
     # receivers reconstruct (key, pts) from the INV block they already hold;
-    # the winner's single [sst|val] write (_apply_commit) covers the
+    # the winner's single [pts|sst|val] write (_apply_commit) covers the
     # committer's own table too, so no separate commit scatter exists.
     # Returned per LANE; the sharded caller routes it to slots
     # (take_along over slot_lane) to put it on the wire.
