@@ -10,8 +10,20 @@ on-chip copies, so the single-chip number lower-bounds the real-mesh
 aggregate.
 
 Runs the TPU-optimized round (core/faststep.py: packed-ts scatter-max
-conflict resolution, lane compaction, cond-gated replay scan), scan-chunked
-so one dispatch executes ROUNDS protocol rounds (SURVEY.md §7 M6).
+conflict resolution, lane-direct applies, cond-gated replay scan),
+scan-chunked so one dispatch executes ROUNDS protocol rounds (SURVEY.md §7
+M6).
+
+Workload mixes (BASELINE.json:7-9):
+  * ``a``       — YCSB-A 50/50 read/write, uniform (config 1; the primary
+                  metric the driver records)
+  * ``rmw``     — YCSB-F-shaped write-heavy read-modify-write, uniform
+                  (config 2)
+  * ``zipfian`` — YCSB-A mix over scrambled Zipfian(0.99) keys (config 3;
+                  contended hot keys)
+``python bench.py`` prints the primary (YCSB-A) line on stdout — the driver
+contract.  ``python bench.py --mix all`` additionally measures the other
+mixes, prints one line each to stderr, and writes BENCH_MIXES.json.
 
 Measurement protocol for this runtime (measured, see faststep.py header):
 execution through the tunneled PJRT link is DEFERRED until the first
@@ -24,6 +36,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
 vs_baseline = value / 1e7 (the north-star aggregate target).
 """
 
+import argparse
 import json
 import sys
 import time
@@ -35,14 +48,20 @@ ROUNDS = 50  # protocol rounds per dispatch
 CHUNKS = 4  # measured dispatches
 WARMUP_CHUNKS = 1
 
+MIXES = ("a", "rmw", "zipfian")
 
-def main() -> None:
+
+def _cfg(mix: str):
     from hermes_tpu.config import HermesConfig, WorkloadConfig
-    from hermes_tpu.core import faststep as fst
-    from hermes_tpu.stats import percentile_from_hist
-    from hermes_tpu.workload import ycsb
 
-    cfg = HermesConfig(
+    wl = {
+        "a": WorkloadConfig(read_frac=0.5, seed=0),
+        "rmw": WorkloadConfig(read_frac=0.5, rmw_frac=1.0, seed=0),
+        "zipfian": WorkloadConfig(
+            read_frac=0.5, seed=0, distribution="zipfian", zipf_theta=0.99
+        ),
+    }[mix]
+    return HermesConfig(
         n_replicas=8,
         n_keys=1 << 20,  # 1M keys (BASELINE.json:7)
         value_words=8,  # 32B values, the reference's typical small-value shape
@@ -55,9 +74,16 @@ def main() -> None:
         read_unroll=2,  # local-read drain depth (reference read batching)
         rebroadcast_every=4,
         replay_scan_every=32,
-        workload=WorkloadConfig(read_frac=0.5, seed=0),  # YCSB-A; metric counts writes
+        workload=wl,
     )
 
+
+def run_mix(mix: str) -> dict:
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.stats import percentile_from_hist
+    from hermes_tpu.workload import ycsb
+
+    cfg = _cfg(mix)
     fs = jax.device_put(fst.init_fast_state(cfg))
     stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
     chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
@@ -71,6 +97,7 @@ def main() -> None:
     jax.block_until_ready(fs)
     c0 = counters(fs)  # drains warmup; switches the link to synchronous mode
     lat0 = jax.device_get(fs.meta.lat_hist).sum(axis=0)
+    abort0 = int(jax.device_get(fs.meta.n_abort).sum())
 
     t0 = time.perf_counter()
     for c in range(WARMUP_CHUNKS, WARMUP_CHUNKS + CHUNKS):
@@ -90,8 +117,11 @@ def main() -> None:
     p99_rounds = percentile_from_hist(hist, 0.99)
     step_us = wall / measure * 1e6
 
-    meta = {
+    return {
+        "mix": mix,
+        "writes_per_sec": round(wps, 1),
         "commits": commits,
+        "aborts": int(jax.device_get(fs.meta.n_abort).sum()) - abort0,
         "rounds": measure,
         "wall_s": round(wall, 4),
         "round_us": round(step_us, 1),
@@ -106,17 +136,116 @@ def main() -> None:
         "n_sessions": cfg.n_sessions,
         "lane_budget": cfg.lane_budget,
     }
-    print(json.dumps(meta), file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": "committed_writes_per_sec",
-                "value": round(wps, 1),
-                "unit": "writes/s",
-                "vs_baseline": round(wps / 1e7, 4),
-            }
-        )
+
+
+def run_latency() -> dict:
+    """The latency-optimized operating point (BASELINE.json:2's p50 metric):
+    ONE protocol round per dispatch at small scale, so a write commits in
+    one round whose wall time IS the commit latency — no scan amortization.
+    The BSP design trades latency for throughput; this measures the other
+    end of that curve (the throughput mixes above amortize ROUNDS rounds
+    per dispatch)."""
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.workload import ycsb
+
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=1024,
+        replay_slots=64, ops_per_session=256, wrap_stream=True,
+        device_stream=True, read_unroll=1, rebroadcast_every=4,
+        replay_scan_every=32, workload=WorkloadConfig(read_frac=0.5, seed=0),
     )
+    warm, samples = 5, 50
+    fs = jax.device_put(fst.init_fast_state(cfg))
+    stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
+    step = fst.build_fast_batched(cfg, donate=True)
+    # pre-place every round's ctl: a per-dispatch host->device transfer
+    # would otherwise dominate the measured latency on this tunneled link
+    ctls = [jax.device_put(fst.make_fast_ctl(cfg, i))
+            for i in range(warm + samples)]
+
+    def one(i):
+        nonlocal fs
+        t0 = time.perf_counter()
+        fs, _comp = step(fs, stream, ctls[i])
+        jax.block_until_ready(fs)
+        return time.perf_counter() - t0
+
+    for i in range(warm):
+        one(i)
+    jax.device_get(fs.meta.n_write)  # force synchronous link mode
+    times = sorted(one(warm + i) for i in range(samples))
+    commits = int(jax.device_get(fs.meta.n_write).sum()
+                  + jax.device_get(fs.meta.n_rmw).sum())
+    p50 = times[len(times) // 2]
+    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+
+    # Per-dispatch floor of this tunneled runtime: a trivial one-op program
+    # dispatched+awaited the same way.  The measured commit latency includes
+    # this link handshake on every round; on an untunneled v5e the floor is
+    # tens of microseconds, so p50 - floor estimates the program's own
+    # latency.
+    triv = jax.jit(lambda x: x + 1)
+    y = jnp.zeros((8,), jnp.int32)
+    y = triv(y)
+    jax.block_until_ready(y)
+    fl = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        y = triv(y)
+        jax.block_until_ready(y)
+        fl.append(time.perf_counter() - t0)
+    floor = sorted(fl)[len(fl) // 2]
+
+    return {
+        "mix": "latency",
+        "round_us": round(p50 * 1e6, 1),
+        "p50_commit_us": round(p50 * 1e6, 1),
+        "p99_commit_us": round(p99 * 1e6, 1),
+        "dispatch_floor_us": round(floor * 1e6, 1),
+        "p50_minus_floor_us": round((p50 - floor) * 1e6, 1),
+        "commits_per_round": commits // (warm + samples),
+        "n_sessions": 1024,
+        "rounds_per_dispatch": 1,
+        "note": "1 round/dispatch: commit latency = round wall; floor = "
+                "per-dispatch link handshake of this tunneled runtime",
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mix", choices=MIXES + ("all", "latency"), default="a")
+    args = ap.parse_args()
+
+    if args.mix == "latency":
+        print(json.dumps(run_latency()))
+        return
+
+    mixes = MIXES if args.mix == "all" else (args.mix,)
+    results = {}
+    for mix in mixes:
+        r = run_mix(mix)
+        results[mix] = r
+        print(json.dumps(r), file=sys.stderr)
+
+    if args.mix == "all":
+        results["latency"] = run_latency()
+        print(json.dumps(results["latency"]), file=sys.stderr)
+        with open("BENCH_MIXES.json", "w") as f:
+            json.dump(results, f, indent=1)
+
+    primary = results.get("a") or results[mixes[0]]
+    line = {
+        "metric": "committed_writes_per_sec",
+        "value": primary["writes_per_sec"],
+        "unit": "writes/s",
+        "vs_baseline": round(primary["writes_per_sec"] / 1e7, 4),
+    }
+    if primary["mix"] != "a":
+        # never let a non-primary mix masquerade as the driver's YCSB-A
+        # metric: tag the stdout line so scrapers can tell them apart
+        line["metric"] = f"committed_writes_per_sec_{primary['mix']}"
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

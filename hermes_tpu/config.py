@@ -27,6 +27,12 @@ class WorkloadConfig:
     zipf_theta: float = 0.99
     seed: int = 0
 
+    def __post_init__(self) -> None:
+        if self.distribution == "zipfian" and not (0.0 < self.zipf_theta < 1.0):
+            # the YCSB analytic inverse (ycsb._zipf_consts) divides by
+            # 1-theta; theta >= 1 needs a different sampler entirely
+            raise ValueError("zipf_theta must be in (0, 1)")
+
 
 @dataclasses.dataclass(frozen=True)
 class HermesConfig:
@@ -83,9 +89,11 @@ class HermesConfig:
 
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
-    # removes the stream-gather ops from the hot round.  Uniform keys only
-    # (n_keys must be a power of two); workload.rmw_frac/read_frac honored;
-    # ycsb.device_stream_host reproduces the exact stream host-side.
+    # removes the stream-gather ops from the hot round.  Uniform or
+    # scrambled-Zipfian keys (analytic inverse, no CDF table; n_keys must
+    # be a power of two); workload.rmw_frac/read_frac honored;
+    # ycsb.device_stream_host reproduces the stream host-side (bit-exact
+    # for uniform; statistically for zipfian — f32 pow ULPs).
     device_stream: bool = False
 
     workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)
@@ -114,8 +122,10 @@ class HermesConfig:
         if self.n_sessions * self.ops_per_session >= 2**31:
             raise ValueError("n_sessions * ops_per_session must fit int32")
         if self.device_stream:
-            if self.workload.distribution != "uniform":
-                raise ValueError("device_stream supports uniform keys only")
+            if self.workload.distribution not in ("uniform", "zipfian"):
+                raise ValueError(
+                    "device_stream supports uniform or zipfian keys"
+                )
             if self.n_keys & (self.n_keys - 1):
                 raise ValueError("device_stream needs power-of-two n_keys")
 

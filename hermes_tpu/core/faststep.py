@@ -328,6 +328,7 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
         lat_sum=z(r),
         lat_cnt=z(r),
         lat_hist=z(r, st.LAT_BINS),
+        max_pts=z(r),
     )
     z8 = lambda *sh: jnp.zeros(sh, jnp.int8)
     return FastState(
@@ -1000,6 +1001,10 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
         lat_sum=meta.lat_sum + ctr[:, kernels.CTR_LATSUM],
         lat_cnt=meta.lat_cnt + ctr[:, kernels.CTR_LATCNT],
         lat_hist=meta.lat_hist + hist_add,
+        # packed-ts overflow watermark (HermesConfig.max_key_versions): a
+        # dense per-round max that the host checks at counter polls —
+        # detection instead of silent compare corruption past the limit
+        max_pts=jnp.maximum(meta.max_pts, jnp.max(sess.pts, axis=1)),
     )
 
     done = commit | abort

@@ -193,6 +193,11 @@ class Meta(NamedTuple):
     ``n_read`` / ``n_write`` / ``n_rmw`` / ``n_abort`` () completed-op counts
     ``lat_sum`` / ``lat_cnt`` () commit-latency accumulator (update ops)
     ``lat_hist`` (LAT_BINS,) latency histogram
+    ``max_pts`` () high-water mark of issued packed timestamps — the
+        faststep overflow guard (HermesConfig.max_key_versions): polled
+        host-side so a key nearing the int32 packed-ts version limit fails
+        loudly instead of silently corrupting the Lamport compare.  The
+        phases engine has no packed ts and leaves it 0.
     """
 
     last_seen: jnp.ndarray
@@ -203,6 +208,7 @@ class Meta(NamedTuple):
     lat_sum: jnp.ndarray
     lat_cnt: jnp.ndarray
     lat_hist: jnp.ndarray
+    max_pts: jnp.ndarray
 
 
 LAT_BINS = 64
@@ -278,6 +284,7 @@ def init_meta(cfg: config_lib.HermesConfig) -> Meta:
         lat_sum=z,
         lat_cnt=z,
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        max_pts=z,
     )
 
 

@@ -1,0 +1,126 @@
+"""Arbitrary-key hash index: sparse 64-bit client keys -> dense table slots
+(SURVEY.md §1 L2 / §2 "KVS store" — the MICA-style index of the reference's
+store, rebuilt for this architecture).
+
+Where it sits (and why host-side): the reference's MICA-derived hash index
+lives in the data plane because clients address the store by arbitrary key
+bytes directly.  In this rebuild the data plane is the dense SoA key-state
+table stepped on-device (core/faststep.py) — dense slot ids are what make
+the protocol a scatter/gather program, and keeping the index out of the
+round costs nothing because the client API path (hermes_tpu/kvs.py) is
+host-mediated per round anyway: ops are injected into the device stream by
+the host, which is exactly where a sparse key must become a slot.  A
+device-side probe loop would add serial sparse gathers (~1.5-2 ms each,
+measured) to every round for work the host does in nanoseconds per op.
+
+Structure: open addressing with linear probing over a power-of-two bucket
+array (capacity >= 2x n_keys, load factor <= 0.5 against the dense-slot
+budget), splitmix64 hash.  Unlike MICA's lossy index (which may evict
+under pressure and re-fetch from the log), this index is EXACT: the dense
+slots are the store, so eviction would lose data.
+
+Collision / full policy (documented contract):
+  * hash collisions probe linearly; a lookup stops at the first empty
+    bucket (keys are never deleted — the KVS API has no delete op, so no
+    tombstones exist and probes cannot be broken by removal);
+  * inserting beyond ``n_keys`` distinct keys raises ``KeyspaceFull`` —
+    the dense table is exactly the key budget; callers size ``n_keys`` to
+    their working set the same way the reference sizes its store.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EMPTY = np.uint64(0xFFFFFFFFFFFFFFFF)  # reserved bucket sentinel
+
+
+class KeyspaceFull(RuntimeError):
+    """More distinct keys inserted than the dense table has slots."""
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — the 64-bit analog of the stream hash's
+    avalanche; vectorized over uint64 arrays (wraparound intended)."""
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+class KeyIndex:
+    """Exact sparse->dense key index (open addressing, linear probing).
+
+    ``get_slots(keys, insert=...)`` accepts batches as a convenience (the
+    probe itself runs per element in Python — fine for the KVS API path,
+    which injects a handful of ops per round; a stream-scale bulk loader
+    would want a numpy-probed batch insert).  Slots are allocated densely
+    in insertion order (0, 1, 2, ...), so the device table never sees a
+    hole."""
+
+    def __init__(self, n_keys: int):
+        self.n_keys = n_keys
+        cap = 1
+        while cap < 2 * n_keys:
+            cap *= 2
+        self._cap = cap
+        self._mask = np.uint64(cap - 1)
+        self._bucket_key = np.full(cap, _EMPTY, np.uint64)
+        self._bucket_slot = np.zeros(cap, np.int32)
+        self._rev = np.zeros(n_keys, np.uint64)  # slot -> client key
+        self.n_used = 0
+
+    # -- core probe ---------------------------------------------------------
+
+    def _probe_one(self, key: np.uint64, insert: bool) -> int:
+        """Slot of ``key``; -1 if absent and not inserting."""
+        if key == _EMPTY:
+            raise ValueError("key 0xFFFF...FF is reserved")
+        b = int(_splitmix64(np.uint64(key)) & self._mask)
+        while True:
+            k = self._bucket_key[b]
+            if k == key:
+                return int(self._bucket_slot[b])
+            if k == _EMPTY:
+                if not insert:
+                    return -1
+                if self.n_used >= self.n_keys:
+                    raise KeyspaceFull(
+                        f"{self.n_used} distinct keys inserted; dense table "
+                        f"holds n_keys={self.n_keys} — size n_keys to the "
+                        f"working set (the index is exact, not lossy)"
+                    )
+                slot = self.n_used
+                self._bucket_key[b] = key
+                self._bucket_slot[b] = slot
+                self._rev[slot] = key
+                self.n_used += 1
+                return slot
+            b = (b + 1) & int(self._mask)
+
+    # -- public API ---------------------------------------------------------
+
+    def get_slots(self, keys, insert: bool = True) -> np.ndarray:
+        """Dense slots for a batch of 64-bit client keys (int32 array,
+        -1 marks absent keys when ``insert=False``)."""
+        flat = np.atleast_1d(np.asarray(keys, np.uint64))
+        out = np.empty(flat.shape, np.int32)
+        for i, k in enumerate(flat.ravel()):
+            out.ravel()[i] = self._probe_one(k, insert)
+        return out.reshape(np.shape(keys)) if np.shape(keys) else out[0]
+
+    def slot(self, key: int, insert: bool = True) -> int:
+        return int(self.get_slots(np.uint64(key), insert=insert))
+
+    def key_of(self, slot: int) -> int:
+        """Client key stored at a dense slot (inverse mapping)."""
+        if not (0 <= slot < self.n_used):
+            raise KeyError(f"slot {slot} unallocated")
+        return int(self._rev[slot])
+
+    def __len__(self) -> int:
+        return self.n_used
+
+    def __contains__(self, key: int) -> bool:
+        return self.slot(key, insert=False) >= 0

@@ -72,7 +72,7 @@ class KVS:
     """
 
     def __init__(self, cfg: HermesConfig, backend: str = "batched", mesh=None,
-                 record: bool = False):
+                 record: bool = False, sparse_keys: bool = False):
         if cfg.value_words < 3:
             raise ValueError("KVS needs value_words >= 3 (2 uid words + payload)")
         if cfg.read_unroll != 1:
@@ -100,8 +100,19 @@ class KVS:
         self._queues: Dict[Tuple[int, int], collections.deque] = (
             collections.defaultdict(collections.deque)
         )
-        self._inflight: Dict[Tuple[int, int], Tuple[str, Future]] = {}
+        self._inflight: Dict[Tuple[int, int], Tuple[str, Future, int]] = {}
         self._dirty = True
+        # sparse-key mode (SURVEY.md §1 L2, MICA-index parity): arbitrary
+        # 64-bit client keys map to dense device slots through an exact
+        # open-addressing index (hermes_tpu/keyindex.py); completions
+        # report the client key.  Inserting more distinct keys than n_keys
+        # raises keyindex.KeyspaceFull.
+        if sparse_keys:
+            from hermes_tpu.keyindex import KeyIndex
+
+            self.index: Optional[KeyIndex] = KeyIndex(cfg.n_keys)
+        else:
+            self.index = None
 
     # -- client ops ----------------------------------------------------------
 
@@ -111,10 +122,20 @@ class KVS:
             raise ValueError(f"replica {replica} out of range [0, {cfg.n_replicas})")
         if not (0 <= session < cfg.n_sessions):
             raise ValueError(f"session {session} out of range [0, {cfg.n_sessions})")
-        if not (0 <= key < cfg.n_keys):
-            raise ValueError(f"key {key} out of range [0, {cfg.n_keys})")
+        if self.index is not None:
+            client_key = int(key)
+            if not (0 <= client_key < (1 << 64) - 1):
+                raise ValueError("sparse keys are unsigned 64-bit "
+                                 "(0xFFFF...FF reserved)")
+            # gets allocate too: the KVS has no delete, so an unseen key's
+            # first touch — read or write — claims its dense slot for good
+            slot = self.index.slot(client_key, insert=True)
+        else:
+            if not (0 <= key < cfg.n_keys):
+                raise ValueError(f"key {key} out of range [0, {cfg.n_keys})")
+            client_key, slot = int(key), int(key)
         fut = Future()
-        self._queues[(replica, session)].append((kind, key, value, fut))
+        self._queues[(replica, session)].append((kind, slot, client_key, value, fut))
         return fut
 
     def get(self, replica: int, session: int, key: int) -> Future:
@@ -154,13 +175,13 @@ class KVS:
         for rs_key, q in list(self._queues.items()):
             if rs_key in self._inflight or not q:
                 continue
-            kind, key, value, fut = q.popleft()
+            kind, slot, client_key, value, fut = q.popleft()
             r, s = rs_key
             self._op[r, s, 0] = self._OPC[kind]
-            self._key[r, s, 0] = key
+            self._key[r, s, 0] = slot
             if value is not None:
                 self._uval[r, s, 0] = value
-            self._inflight[rs_key] = (kind, fut)
+            self._inflight[rs_key] = (kind, fut, client_key)
             self._dirty = True
         if self._dirty:
             from hermes_tpu.core import faststep as fst
@@ -176,7 +197,7 @@ class KVS:
         wval = np.asarray(comp.wval)
         ckey = np.asarray(comp.key)
         ndone = 0
-        for (r, s), (kind, fut) in list(self._inflight.items()):
+        for (r, s), (kind, fut, client_key) in list(self._inflight.items()):
             c = int(code[r, s])
             if c == t.C_NONE or int(ckey[r, s]) != self._key[r, s, 0]:
                 continue
@@ -187,7 +208,7 @@ class KVS:
                 continue
             done = Completion(
                 kind="rmw_abort" if c == t.C_RMW_ABORT else kind,
-                key=int(ckey[r, s]),
+                key=client_key,
                 step=self.rt.step_idx - 1,
             )
             if c in (t.C_READ, t.C_RMW):

@@ -90,7 +90,8 @@ def _main():
     rt = run(cfg, args.steps)
     counters = rt.counters()  # collective (allgather) — every process joins
     if jax.process_index() == 0:
-        print(counters)
+        print({k: int(v) for k, v in counters.items()
+               if np.ndim(v) == 0})  # scalar counters as a parseable dict
 
 
 if __name__ == "__main__":

@@ -404,9 +404,13 @@ class FastRuntime:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            m = multihost_utils.process_allgather(self.fs.meta)
+            # meta leaves are (R, ...) sharded over the global 'replica'
+            # axis; tiled=True reassembles the global value on every host
+            # (non-fully-addressable arrays reject the stacking default)
+            m = multihost_utils.process_allgather(self.fs.meta, tiled=True)
         else:
             m = jax.device_get(self.fs.meta)
+        max_ver = self._check_version_headroom(m)
         return dict(
             n_read=np.asarray(m.n_read).sum(),
             n_write=np.asarray(m.n_write).sum(),
@@ -415,7 +419,28 @@ class FastRuntime:
             lat_sum=np.asarray(m.lat_sum).sum(),
             lat_cnt=np.asarray(m.lat_cnt).sum(),
             lat_hist=np.asarray(m.lat_hist).sum(axis=0),
+            max_ver=max_ver,
         )
+
+    def _check_version_headroom(self, m) -> int:
+        """Packed-ts overflow guard (HermesConfig.max_key_versions): the
+        engine tracks the max issued packed ts (Meta.max_pts); past the
+        documented limit the int32 Lamport compare would corrupt silently,
+        so fail LOUDLY here (counter polls) and direct long key-rotation
+        runs to the phases engine, whose (ver, fc) columns have int32
+        version headroom.  Returns the high-water version."""
+        from hermes_tpu.core import faststep as fst
+
+        max_ver = int(np.asarray(m.max_pts).max()) >> fst.PTS_FC_BITS
+        if max_ver >= self.cfg.max_key_versions:
+            raise RuntimeError(
+                f"packed-timestamp overflow: a key reached version "
+                f"{max_ver} >= max_key_versions={self.cfg.max_key_versions};"
+                f" faststep's int32 packed ts cannot represent further "
+                f"versions of this key — use the phases engine (Runtime) "
+                f"for runs that rotate single keys this long"
+            )
+        return max_ver
 
     def _sess_view(self):
         fst = self._fst

@@ -14,6 +14,8 @@ Mixes map to the acceptance configs:
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from hermes_tpu.config import HermesConfig
@@ -93,18 +95,67 @@ def device_stream_params(cfg: HermesConfig):
     return read_t, rmw_t
 
 
+@functools.lru_cache(maxsize=None)
+def _zipf_consts(n: int, theta: float):
+    """Constants of the YCSB analytic Zipfian inverse (Gray et al.,
+    "Quickly generating billion-record synthetic databases"): rank(u) =
+    n * (eta*u - eta + 1)^(1/(1-theta)) with small-rank special cases.
+    Host-side float64 precompute (zeta(n) is a 1-time O(n) sum, cached)."""
+    zetan = float(np.sum(1.0 / np.power(
+        np.arange(1, n + 1, dtype=np.float64), theta)))
+    zeta2 = 1.0 + 0.5 ** theta
+    alpha = 1.0 / (1.0 - theta)
+    eta = (1.0 - (2.0 / n) ** (1.0 - theta)) / (1.0 - zeta2 / zetan)
+    return (np.float32(zetan), np.float32(zeta2), np.float32(eta),
+            np.float32(alpha))
+
+
+def _zipf_rank(cfg: HermesConfig, kh):
+    """uint32 hash -> Zipfian rank (0 = hottest), pure elementwise float32
+    math — the TPU-native sampling path: no CDF table, no gathers (a
+    searchsorted/alias lookup would add ~1.5-2 ms of flat sparse-op cost
+    per intake sub-step on this runtime; transcendentals are dense VPU
+    work).  Backend-agnostic like the rest of the hash."""
+    if isinstance(kh, (np.ndarray, np.generic)):
+        xp = np
+    else:  # jax tracer/array — np.where would force __array__ on tracers
+        import jax.numpy as xp
+    zetan, zeta2, eta, alpha = _zipf_consts(cfg.n_keys, cfg.workload.zipf_theta)
+    one = np.float32(1.0)
+    u = (kh >> np.uint32(8)).astype(np.float32) * np.float32(2.0 ** -24)
+    uz = u * zetan
+    # eta < 1 for theta < 1, so the pow base 1 - eta*(1-u) is always > 0
+    tail = (np.float32(cfg.n_keys) * (eta * u - eta + one) ** alpha)
+    rank = xp.where(uz < one, np.float32(0.0),
+                    xp.where(uz < zeta2, one, tail))
+    rank = xp.minimum(rank, np.float32(cfg.n_keys - 1))
+    return rank.astype(np.uint32)
+
+
 def stream_hash(cfg: HermesConfig, replica, session, op_idx):
     """The counter-hash op stream, backend-agnostic: works on numpy AND jax
-    uint32 arrays (pure ^ * >> & arithmetic), so the device engine
-    (core/faststep._coordinate) and the host twin call ONE implementation —
-    the two cannot drift.  Returns (u_op, u_rmw, key) as uint32."""
+    uint32 arrays (pure ^ * >> & arithmetic; the zipfian branch adds f32
+    elementwise math), so the device engine (core/faststep._coordinate) and
+    the host twin call ONE implementation — the two cannot drift (uniform
+    is bit-exact; zipfian may differ on rank-boundary ULPs between numpy
+    and XLA pow, so zipfian agreement is statistical, not per-element).
+    Returns (u_op, u_rmw, key) as uint32."""
     seed_mixed = np.uint32((cfg.workload.seed * 0x9E3779B9) & 0xFFFFFFFF)
     base = _mix32(seed_mixed ^ _mix32(
         replica * np.uint32(0x85EBCA6B)
         ^ _mix32(session * np.uint32(0xC2B2AE35) ^ op_idx)))
     u_op = base & np.uint32(0xFFFF)
     u_rmw = (base >> np.uint32(16)) & np.uint32(0xFFFF)
-    key = _mix32(base ^ np.uint32(0x27220A95)) & np.uint32(cfg.n_keys - 1)
+    kh = _mix32(base ^ np.uint32(0x27220A95))
+    if cfg.workload.distribution == "zipfian":
+        # scrambled zipfian (YCSB): hash the rank over the key space so hot
+        # ranks spread out; the power-of-two mask folds ranks onto keys
+        # (collisions merge ranks — acceptable for a workload generator)
+        rank = _zipf_rank(cfg, kh)
+        key = _mix32(rank * np.uint32(0x9E3779B1)
+                     ^ np.uint32(0x1B873593)) & np.uint32(cfg.n_keys - 1)
+    else:
+        key = kh & np.uint32(cfg.n_keys - 1)
     return u_op, u_rmw, key
 
 

@@ -1,0 +1,70 @@
+"""Multi-host DCN smoke test (SURVEY.md §5.8; VERDICT round-1 item 8): two
+OS processes, each exposing 4 virtual CPU devices, joined by
+``jax.distributed.initialize`` into one 8-device global mesh driving
+``hermes_tpu.launch`` — the sharded faststep round's INV/ACK/VAL
+collectives then genuinely cross the process boundary (the DCN path of the
+tpu_ici transport).  This is the jax.distributed analog of
+test_tcp_distributed.py's C++ socket run."""
+
+import ast
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("n_hosts,devs_per_host", [(2, 4)])
+def test_two_process_dcn_launch(n_hosts, devs_per_host):
+    steps = 25
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for h in range(n_hosts):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devs_per_host}"
+        )
+        env["PALLAS_AXON_POOL_IPS"] = ""  # never claim the tunneled TPU
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "hermes_tpu.launch",
+                    "--coordinator", f"localhost:{port}",
+                    "--num-hosts", str(n_hosts),
+                    "--host-id", str(h),
+                    "--replicas", str(n_hosts * devs_per_host),
+                    "--keys", "4096",
+                    "--sessions", "8",
+                    "--steps", str(steps),
+                ],
+                env=env,
+                cwd=repo,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=420)
+        assert p.returncode == 0, stderr.decode()[-3000:]
+        outs.append(stdout.decode())
+
+    # rank 0 prints the allgathered counters dict; the run must have
+    # completed ops on every replica through cross-process collectives
+    printed = [o for o in outs if o.strip()]
+    assert printed, outs
+    counters = ast.literal_eval(printed[0].strip().splitlines()[-1])
+    total = (int(counters["n_read"]) + int(counters["n_write"])
+             + int(counters["n_rmw"]) + int(counters["n_abort"]))
+    assert total > 0, counters
+    assert int(counters["n_write"]) > 0, counters

@@ -295,3 +295,80 @@ def test_pending_write_uids_recorded_after_failure():
         # byte-level misread would leave hi as a mangled byte pattern
         assert 0 <= o.wuid[1] < cfg.n_replicas, o
     assert rt.check().ok
+
+
+def test_device_stream_zipfian_skew_and_checks():
+    """Config-3-shaped (BASELINE.json:9) on the DEVICE stream: the analytic
+    Zipfian inverse (ycsb._zipf_rank, no CDF table) must produce the
+    YCSB-grade skew — a small set of hot keys absorbing a large op share —
+    and the contended run must stay checker-clean.  Device/host agreement
+    for zipfian is statistical (f32 pow ULPs can flip rank boundaries), so
+    this asserts distribution properties, not per-element equality."""
+    from hermes_tpu.workload import ycsb
+
+    cfg = HermesConfig(
+        n_replicas=7, n_keys=1 << 14, n_sessions=8, replay_slots=4,
+        ops_per_session=16, device_stream=True,
+        workload=WorkloadConfig(
+            read_frac=0.5, seed=7, distribution="zipfian", zipf_theta=0.99),
+    )
+    # distribution shape: top-64 of 16384 scrambled-zipfian keys should
+    # carry >25% of samples (uniform would give ~0.4%)
+    n = 1 << 16
+    _, _, keys = ycsb.stream_hash(
+        cfg, np.uint32(0), np.arange(n, dtype=np.uint32), np.uint32(0))
+    counts = np.bincount(keys.astype(np.int64), minlength=cfg.n_keys)
+    top = np.sort(counts)[::-1]
+    assert top[:64].sum() > 0.25 * n, top[:8]
+    assert counts.max() < 0.5 * n  # scrambling spread the head
+
+    # the device engine agrees with the host twin on the op MIX and runs
+    # checker-clean under contention
+    rt = FastRuntime(cfg, record=True)
+    assert rt.drain(600)
+    assert rt.check().ok
+    c = rt.counters()
+    total = c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"]
+    assert total == cfg.n_replicas * cfg.n_sessions * cfg.ops_per_session
+    assert 0.35 < c["n_read"] / total < 0.65
+
+
+def test_packed_ts_overflow_guard_detects():
+    """Packed-ts overflow guard (HermesConfig.max_key_versions): rotating a
+    key to the version limit must be DETECTED at a counter poll (loud
+    RuntimeError pointing at the phases engine), not silently corrupt the
+    int32 Lamport compare.  The limit is ~1M versions — unreachable in test
+    time by actually writing — so the soak seeds the key near the limit
+    (vpts + the mirrored bank pts word) and rotates it across the boundary."""
+    import jax.numpy as jnp
+    import pytest
+    from hermes_tpu.core import faststep as fst
+
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=64, n_sessions=4, replay_slots=2,
+        ops_per_session=64, wrap_stream=True,
+        workload=WorkloadConfig(read_frac=0.0, seed=13),
+    )
+    rt = FastRuntime(cfg)
+    # seed key 0 at (limit - 4) versions, VALID, consistent row mirror
+    near = cfg.max_key_versions - 4
+    seeded_pts = fst.pack_pts(jnp.int32(near), jnp.int32(0))
+    tbl = rt.fs.table
+    rows32 = fst._bank_to_i32(tbl.bank)
+    rows32 = rows32.at[0, fst.BANK_PTS].set(seeded_pts)
+    tbl = tbl._replace(
+        vpts=tbl.vpts.at[0].set(seeded_pts),
+        bank=fst._i32_to_bank(rows32),
+    )
+    # every session hammers key 0 with writes
+    stream = rt.stream._replace(
+        op=jnp.full_like(rt.stream.op, t.OP_WRITE),
+        key=jnp.zeros_like(rt.stream.key),
+    )
+    rt.fs = rt.fs._replace(table=tbl)
+    rt.stream = stream
+    rt.run(2)
+    assert rt.counters()["max_ver"] >= near  # watermark tracks the rotation
+    rt.run(16)  # crosses the limit (~1 version/round, 4 of headroom)
+    with pytest.raises(RuntimeError, match="packed-timestamp overflow"):
+        rt.counters()
