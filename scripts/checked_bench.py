@@ -1,0 +1,74 @@
+"""Checked bench window (VERDICT round-1 item 4, second half): run the
+bench-shaped workload WITH the columnar history recorder, then run the
+native witness linearizability check over the full >=10M-op history, and
+report both the recording overhead and the checking rate.
+
+    python scripts/checked_bench.py [--rounds 30] [--out CHECKED_BENCH.json]
+
+The throughput bench (bench.py) runs scan-chunked with recording off; this
+harness answers "does the engine stay linearizable at bench scale, and how
+fast can we prove it" — completions are fetched per round (recording
+requires them), so the per-round link handshake dominates wall time here.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--sessions", type=int, default=32768)
+    ap.add_argument("--out", default="CHECKED_BENCH.json")
+    args = ap.parse_args()
+
+    import jax
+
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.runtime import FastRuntime
+
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=1 << 20, value_words=8,
+        n_sessions=args.sessions, replay_slots=256, ops_per_session=256,
+        wrap_stream=True, device_stream=True, lane_budget_cfg=24576,
+        read_unroll=2, rebroadcast_every=4, replay_scan_every=32,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+    rt = FastRuntime(cfg, record="array")
+
+    t0 = time.perf_counter()
+    rt.run(args.rounds)
+    jax.block_until_ready(rt.fs)
+    counters = rt.counters()  # forces the deferred tunnel work
+    run_wall = time.perf_counter() - t0
+
+    n_ops = int(sum(c["code"].shape[0] for c in rt.recorder._chunks))
+    t1 = time.perf_counter()
+    verdict = rt.check()  # ALL keys, native witness core (checker/fast.py)
+    check_wall = time.perf_counter() - t1
+
+    out = {
+        "rounds": args.rounds,
+        "ops_recorded": n_ops,
+        "writes_committed": int(counters["n_write"] + counters["n_rmw"]),
+        "run_wall_s": round(run_wall, 2),
+        "recorded_ops_per_sec": round(n_ops / run_wall, 1),
+        "check_wall_s": round(check_wall, 2),
+        "check_ops_per_sec": round(n_ops / check_wall, 1),
+        "verdict_ok": bool(verdict.ok),
+        "keys_checked": int(verdict.keys_checked),
+        "failures": [repr(f) for f in verdict.failures[:3]],
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Full-scale acceptance artifact (VERDICT round-1 item 4).
+
+Runs all five BASELINE acceptance configs (BASELINE.json:7-11) at
+``--scale 1.0`` — the real 1M-key shape — on the available chip, with the
+columnar recorder + native witness checker gating every run, and writes
+``ACCEPTANCE_FULL.json`` with counters, verdicts, and wall times.
+
+    python scripts/full_acceptance.py [--scale 1.0] [--max-steps 20000]
+
+Config 3 (Zipfian-0.99 hotspot) is the long pole by design: hot-key writes
+serialize at n_replicas per round (BASELINE.md "Zipfian note"), so draining
+S*G ops per session through contended keys takes thousands of rounds.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--max-steps", type=int, default=20000)
+    ap.add_argument("--out", default="ACCEPTANCE_FULL.json")
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    args = ap.parse_args()
+
+    import jax
+
+    from hermes_tpu import acceptance
+
+    results = {}
+    for n in [int(x) for x in args.configs.split(",")]:
+        t0 = time.perf_counter()
+        counters, verdict = acceptance.run_config(
+            n, scale=args.scale, max_steps=args.max_steps,
+            log=lambda s: print(f"  {s}", file=sys.stderr),
+        )
+        wall = time.perf_counter() - t0
+        entry = {
+            "counters": counters,
+            "wall_s": round(wall, 1),
+            "verdict_ok": bool(verdict.ok) if verdict else None,
+            "checked_keys": getattr(verdict, "keys_checked", None),
+            "failures": [repr(f) for f in verdict.failures[:3]] if verdict else [],
+        }
+        results[str(n)] = entry
+        print(f"config {n}: ok={entry['verdict_ok']} drained="
+              f"{counters.get('drained')} wall={wall:.1f}s "
+              f"{ {k: v for k, v in counters.items() if k.startswith('n_')} }",
+              file=sys.stderr)
+
+    out = {
+        "scale": args.scale,
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+        "results": results,
+        "all_ok": all(r["verdict_ok"] and r["counters"].get("drained")
+                      for r in results.values()),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"acceptance_all_ok": out["all_ok"]}))
+
+
+if __name__ == "__main__":
+    main()
