@@ -26,6 +26,9 @@ def main() -> None:
     ap.add_argument("--max-steps", type=int, default=20000)
     ap.add_argument("--out", default="ACCEPTANCE_FULL.json")
     ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--check-keys", type=int, default=0,
+                    help="sample size for the checker; 0 = EVERY touched "
+                         "key (the artifact default)")
     args = ap.parse_args()
 
     import jax
@@ -37,6 +40,7 @@ def main() -> None:
         t0 = time.perf_counter()
         counters, verdict = acceptance.run_config(
             n, scale=args.scale, max_steps=args.max_steps,
+            check_keys=args.check_keys or None,
             log=lambda s: print(f"  {s}", file=sys.stderr),
         )
         wall = time.perf_counter() - t0
@@ -46,6 +50,7 @@ def main() -> None:
             "verdict_ok": bool(verdict.ok) if verdict else None,
             "checked_keys": getattr(verdict, "keys_checked", None),
             "failures": [repr(f) for f in verdict.failures[:3]] if verdict else [],
+            "undecided": [repr(u) for u in verdict.undecided[:3]] if verdict else [],
         }
         results[str(n)] = entry
         print(f"config {n}: ok={entry['verdict_ok']} drained="
