@@ -17,6 +17,12 @@ stored value carry the device-derived unique write id (the linearizability
 witness, checker/history.py), so checked runs work unchanged over client
 traffic.
 
+Keys are dense slot ids ``[0, n_keys)`` by default; ``sparse_keys=True``
+accepts arbitrary unsigned 64-bit client keys through the exact
+open-addressing index of ``hermes_tpu/keyindex.py`` (the MICA-index
+analog, SURVEY.md §1 L2) — completions echo the client key, and inserting
+more than ``n_keys`` distinct keys raises ``keyindex.KeyspaceFull``.
+
 Usage::
 
     kvs = KVS(HermesConfig(n_replicas=3, n_keys=1024, value_words=6))

@@ -372,3 +372,20 @@ def test_packed_ts_overflow_guard_detects():
     rt.run(16)  # crosses the limit (~1 version/round, 4 of headroom)
     with pytest.raises(RuntimeError, match="packed-timestamp overflow"):
         rt.counters()
+
+
+def test_bench_mix_configs_construct():
+    """bench.py's mix configs must stay constructible (config validation
+    drift guard — the bench runs on the chip where a late ValueError wastes
+    a driver round); latency-mode config included."""
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location("bench", root / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    for mix in bench.MIXES:
+        cfg = bench._cfg(mix)
+        assert cfg.n_keys == 1 << 20
+        assert cfg.device_stream
