@@ -138,6 +138,17 @@ def run_mix(mix: str) -> dict:
     }
 
 
+def _latency_cfg():
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    return HermesConfig(
+        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=1024,
+        replay_slots=64, ops_per_session=256, wrap_stream=True,
+        device_stream=True, read_unroll=1, rebroadcast_every=4,
+        replay_scan_every=32, workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+
+
 def run_latency() -> dict:
     """The latency-optimized operating point (BASELINE.json:2's p50 metric):
     ONE protocol round per dispatch at small scale, so a write commits in
@@ -145,16 +156,10 @@ def run_latency() -> dict:
     The BSP design trades latency for throughput; this measures the other
     end of that curve (the throughput mixes above amortize ROUNDS rounds
     per dispatch)."""
-    from hermes_tpu.config import HermesConfig, WorkloadConfig
     from hermes_tpu.core import faststep as fst
     from hermes_tpu.workload import ycsb
 
-    cfg = HermesConfig(
-        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=1024,
-        replay_slots=64, ops_per_session=256, wrap_stream=True,
-        device_stream=True, read_unroll=1, rebroadcast_every=4,
-        replay_scan_every=32, workload=WorkloadConfig(read_frac=0.5, seed=0),
-    )
+    cfg = _latency_cfg()
     warm, samples = 5, 50
     fs = jax.device_put(fst.init_fast_state(cfg))
     stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))

@@ -87,6 +87,14 @@ class HermesConfig:
     # chip but raise the false-collision deferral rate (~S/2HS per issue).
     arb_slots_cfg: Optional[int] = None
 
+    # Same-replica same-key issue arbitration strategy (faststep):
+    #   "race" — hash-slot scatter-min + gather (2 sparse ops; false
+    #            collisions defer ~S/2HS of issues one round);
+    #   "sort" — lexicographic (key, session) sort + one win-bit scatter
+    #            (collision-free: every distinct wanted key issues).
+    # Both are protocol-equivalent (lowest eligible session wins a key).
+    arb_mode: Literal["race", "sort"] = "race"
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or
@@ -111,6 +119,8 @@ class HermesConfig:
             or self.arb_slots_cfg & (self.arb_slots_cfg - 1)
         ):
             raise ValueError("arb_slots_cfg must be a positive power of two")
+        if self.arb_mode not in ("race", "sort"):
+            raise ValueError("arb_mode must be 'race' or 'sort'")
         if self.n_keys > (1 << 29):
             raise ValueError(
                 "n_keys must fit 29 bits (faststep packs key|fresh|valid "

@@ -536,19 +536,38 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         val=jnp.where(w_loaded[..., None], new_wval, sess.val)
     )
 
-    # Same-key same-replica issue arbitration via a small hash-slot race:
-    # colliding sessions (same slot) defer to the lowest index; a false
-    # collision (different keys, same slot) only delays an issue one round.
+    # Same-key same-replica issue arbitration: exactly one of a replica's
+    # wanting sessions may issue a key per round (two would mint the SAME
+    # packed ts for different values — cfg.arb_mode picks the strategy).
     # An issue requires the key VALID: any in-flight same-key write (its INV
     # applies the round it issues — see the revert rule below) holds the key
     # un-readable, so no duplicate-ts window exists.
     want = (sess.status == t.S_ISSUE) & k_valid & ~frozen
-    HS = cfg.arb_slots
-    h = sess.key & (HS - 1)
     idxs = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (R, S))
-    arb = jnp.full((R * HS,), jnp.iinfo(jnp.int32).max, jnp.int32)
-    arb = arb.at[_gkey(arb, h, want)].min(idxs, mode="drop")
-    win = want & (arb[_gkey(arb, h)] == idxs)
+    if cfg.arb_mode == "sort":
+        # lexicographic (key, session) sort per replica: the first entry of
+        # each equal-key run (= the lowest wanting session, lax.sort is
+        # stable) wins; ineligible sessions sort past K.  One sort + ONE
+        # win-bit scatter vs the race's scatter-min + gather, and no false
+        # collisions — every distinct wanted key issues every round.
+        skey = jnp.where(want, sess.key, jnp.int32(cfg.n_keys))
+        sk, si = jax.lax.sort((skey, idxs), dimension=1, num_keys=1)
+        first = jnp.concatenate(
+            [jnp.ones((R, 1), bool), sk[:, 1:] != sk[:, :-1]], axis=1)
+        winbit = (first & (sk < cfg.n_keys)).astype(jnp.int32)
+        wz = jnp.zeros((R * S,), jnp.int32)
+        win_flat = wz.at[_gkey(wz, si)].max(winbit, mode="drop")
+        win = want & (win_flat.reshape(R, S) != 0)
+    else:
+        # hash-slot race: scatter-min of the session index into a small
+        # table; colliding sessions (same slot) defer to the lowest index;
+        # a false collision (different keys, same slot) only delays an
+        # issue one round.
+        HS = cfg.arb_slots
+        h = sess.key & (HS - 1)
+        arb = jnp.full((R * HS,), jnp.iinfo(jnp.int32).max, jnp.int32)
+        arb = arb.at[_gkey(arb, h, want)].min(idxs, mode="drop")
+        win = want & (arb[_gkey(arb, h)] == idxs)
 
     flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
     fc = (flag << 8) | ctl.my_cid[:, None]
