@@ -25,7 +25,7 @@ turns it into an explicit "undecided" outcome rather than a hang.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 from hermes_tpu.checker.history import INF, Op, Uid
 

@@ -174,7 +174,6 @@ class KVS:
     def step(self) -> int:
         """Inject queued ops, run one protocol round, resolve completions.
         Returns the number of ops completed this round."""
-        import jax.numpy as jnp
         from hermes_tpu.core import state as st
 
         # clear slots whose op completed last round, then inject new ops

@@ -23,7 +23,7 @@ lost VALs are recovered by the replay scan (SURVEY.md §3.4).
 from __future__ import annotations
 
 import collections
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, Dict, Sequence, Tuple
 
 import numpy as np
 
