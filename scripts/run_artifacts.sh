@@ -1,0 +1,14 @@
+#!/bin/bash
+# Sequential on-chip artifact run (ONE TPU process at a time; no timeouts —
+# killing a claim mid-flight wedges the tunneled chip for an hour+).
+#   bash scripts/run_artifacts.sh
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== bench (all mixes + latency) ===" >&2
+python bench.py --mix all 2>>artifacts_run.log
+echo "=== checked bench window ===" >&2
+python scripts/checked_bench.py --rounds 30 2>>artifacts_run.log
+echo "=== full-scale acceptance (scale=1.0, all keys checked) ===" >&2
+python scripts/full_acceptance.py --scale 1.0 --max-steps 20000 2>>artifacts_run.log
+echo "=== done ===" >&2
