@@ -89,15 +89,16 @@ def run_mix(mix: str) -> dict:
     chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
 
     def counters(x):
+        # ONE meta fetch per poll (each device_get is a link round trip)
         m = jax.device_get(x.meta)
-        return int(m.n_write.sum() + m.n_rmw.sum())
+        return (int(m.n_write.sum() + m.n_rmw.sum()),
+                int(m.n_abort.sum()), m.lat_hist.sum(axis=0))
 
     for c in range(WARMUP_CHUNKS):
         fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * ROUNDS))
     jax.block_until_ready(fs)
-    c0 = counters(fs)  # drains warmup; switches the link to synchronous mode
-    lat0 = jax.device_get(fs.meta.lat_hist).sum(axis=0)
-    abort0 = int(jax.device_get(fs.meta.n_abort).sum())
+    # drains warmup; switches the link to synchronous mode
+    c0, abort0, lat0 = counters(fs)
 
     t0 = time.perf_counter()
     for c in range(WARMUP_CHUNKS, WARMUP_CHUNKS + CHUNKS):
@@ -106,13 +107,14 @@ def run_mix(mix: str) -> dict:
     t1 = time.perf_counter()
 
     measure = CHUNKS * ROUNDS
-    commits = counters(fs) - c0
+    c1, abort1, lat1 = counters(fs)
+    commits = c1 - c0
     wall = t1 - t0
     wps = commits / wall
 
     # p50 commit latency in protocol rounds -> microseconds via measured
     # round time (commit latency = 1 round for an uncontended write)
-    hist = jax.device_get(fs.meta.lat_hist).sum(axis=0) - lat0
+    hist = lat1 - lat0
     p50_rounds = percentile_from_hist(hist, 0.5)
     p99_rounds = percentile_from_hist(hist, 0.99)
     step_us = wall / measure * 1e6
@@ -121,7 +123,7 @@ def run_mix(mix: str) -> dict:
         "mix": mix,
         "writes_per_sec": round(wps, 1),
         "commits": commits,
-        "aborts": int(jax.device_get(fs.meta.n_abort).sum()) - abort0,
+        "aborts": abort1 - abort0,
         "rounds": measure,
         "wall_s": round(wall, 4),
         "round_us": round(step_us, 1),
@@ -180,8 +182,8 @@ def run_latency() -> dict:
         one(i)
     jax.device_get(fs.meta.n_write)  # force synchronous link mode
     times = sorted(one(warm + i) for i in range(samples))
-    commits = int(jax.device_get(fs.meta.n_write).sum()
-                  + jax.device_get(fs.meta.n_rmw).sum())
+    m = jax.device_get(fs.meta)
+    commits = int(m.n_write.sum() + m.n_rmw.sum())
     p50 = times[len(times) // 2]
     p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
 
@@ -210,7 +212,7 @@ def run_latency() -> dict:
         "dispatch_floor_us": round(floor * 1e6, 1),
         "p50_minus_floor_us": round((p50 - floor) * 1e6, 1),
         "commits_per_round": commits // (warm + samples),
-        "n_sessions": 1024,
+        "n_sessions": cfg.n_sessions,
         "rounds_per_dispatch": 1,
         "note": "1 round/dispatch: commit latency = round wall; floor = "
                 "per-dispatch link handshake of this tunneled runtime",

@@ -136,6 +136,12 @@ class ArrayRecorder:
 
     # -- packed views --------------------------------------------------------
 
+    @property
+    def n_recorded(self) -> int:
+        """Raw completion rows recorded so far (pre-finalize; includes NOP
+        and aborted-RMW rows that columns() drops)."""
+        return sum(c["code"].shape[0] for c in self._chunks)
+
     def columns(self) -> dict:
         if not self._chunks:
             return {k: np.zeros(0, np.int64) for k in

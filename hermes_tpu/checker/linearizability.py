@@ -53,6 +53,16 @@ class Verdict:
     def __bool__(self) -> bool:
         return self.ok
 
+    def to_dict(self, max_examples: int = 3) -> dict:
+        """JSON-friendly summary (artifact scripts); a non-ok verdict always
+        carries diagnosable examples — failures or undecided keys."""
+        return {
+            "verdict_ok": self.ok,
+            "keys_checked": self.keys_checked,
+            "failures": [repr(f) for f in self.failures[:max_examples]],
+            "undecided": [repr(u) for u in self.undecided[:max_examples]],
+        }
+
 
 def check_history(
     ops: Sequence[Op],

@@ -69,10 +69,7 @@ def main() -> None:
         "recorded_ops_per_sec": round(n_ops / run_wall, 1),
         "check_wall_s": round(check_wall, 2),
         "check_ops_per_sec": round(n_ops / check_wall, 1),
-        "verdict_ok": bool(verdict.ok),
-        "keys_checked": int(verdict.keys_checked),
-        "failures": [repr(f) for f in verdict.failures[:3]],
-        "undecided": [repr(u) for u in verdict.undecided[:3]],
+        **verdict.to_dict(),
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
     }

@@ -92,16 +92,16 @@ def main():
     t0 = time.perf_counter()
     synthesize(rec, args.keys, args.ops, args.spoil)
     gen = time.perf_counter() - t0
-    n = sum(c["code"].shape[0] for c in rec._chunks)
+    n = rec.n_recorded
     t1 = time.perf_counter()
     v = check_arrays(rec)
     wall = time.perf_counter() - t1
     import json
     print(json.dumps({
         "ops": n, "gen_s": round(gen, 2), "check_s": round(wall, 2),
-        "check_ops_per_sec": round(n / wall, 1), "ok": bool(v.ok),
-        "keys_checked": int(v.keys_checked),
-        "failing_keys": len(v.failures), "undecided": len(v.undecided),
+        "check_ops_per_sec": round(n / wall, 1),
+        "verdict_ok": v.ok, "keys_checked": v.keys_checked,
+        "failing_keys": len(v.failures), "undecided_keys": len(v.undecided),
     }))
 
 

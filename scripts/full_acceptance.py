@@ -44,14 +44,11 @@ def main() -> None:
             log=lambda s: print(f"  {s}", file=sys.stderr),
         )
         wall = time.perf_counter() - t0
-        entry = {
-            "counters": counters,
-            "wall_s": round(wall, 1),
-            "verdict_ok": bool(verdict.ok) if verdict else None,
-            "checked_keys": getattr(verdict, "keys_checked", None),
-            "failures": [repr(f) for f in verdict.failures[:3]] if verdict else [],
-            "undecided": [repr(u) for u in verdict.undecided[:3]] if verdict else [],
-        }
+        entry = {"counters": counters, "wall_s": round(wall, 1)}
+        entry.update(verdict.to_dict() if verdict else {
+            "verdict_ok": None, "keys_checked": None,
+            "failures": [], "undecided": [],
+        })
         results[str(n)] = entry
         print(f"config {n}: ok={entry['verdict_ok']} drained="
               f"{counters.get('drained')} wall={wall:.1f}s "

@@ -4,11 +4,13 @@
 #   bash scripts/run_artifacts.sh
 set -u
 cd "$(dirname "$0")/.."
+rc=0
 
 echo "=== bench (all mixes + latency) ===" >&2
-python bench.py --mix all 2>>artifacts_run.log
+python bench.py --mix all 2>>artifacts_run.log || rc=1
 echo "=== checked bench window ===" >&2
-python scripts/checked_bench.py --rounds 30 2>>artifacts_run.log
+python scripts/checked_bench.py --rounds 30 2>>artifacts_run.log || rc=1
 echo "=== full-scale acceptance (scale=1.0, all keys checked) ===" >&2
-python scripts/full_acceptance.py --scale 1.0 --max-steps 20000 2>>artifacts_run.log
-echo "=== done ===" >&2
+python scripts/full_acceptance.py --scale 1.0 --max-steps 20000 2>>artifacts_run.log || rc=1
+echo "=== done (rc=$rc) ===" >&2
+exit $rc
