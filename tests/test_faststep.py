@@ -422,3 +422,25 @@ def test_arb_mode_sort_checked_and_matches_totals():
     assert c.drain(400)
     # sharded sort-mode equals batched sort-mode (lockstep equality)
     np.testing.assert_array_equal(get(b.fs.sess.pts), get(c.fs.sess.pts))
+
+
+def test_arb_mode_sort_failure_recovery():
+    """The sort arbiter under the config-4 failure drill: stall, membership
+    removal, replay recovery — checker-clean, survivors drain."""
+    cfg = HermesConfig(
+        n_replicas=4, n_keys=128, n_sessions=8, replay_slots=16,
+        ops_per_session=16, replay_age=4, replay_scan_every=4,
+        arb_mode="sort",
+        workload=WorkloadConfig(read_frac=0.4, seed=35),
+    )
+    rt = FastRuntime(cfg, record=True)
+    rt.run(6)
+    rt.freeze(3)
+    rt.run(4)
+    rt.remove(3)
+    assert rt.drain(1500)
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+    status = get(rt.fs.sess.status)
+    for r in range(3):
+        assert (status[r] == t.S_DONE).all()
