@@ -16,9 +16,33 @@ INV/ACK/VAL message batches move between replicas as XLA collectives
 replica (BASELINE.json:5, ``transport=tpu_ici``).
 """
 
-from hermes_tpu.config import HermesConfig
+from hermes_tpu.config import HermesConfig, WorkloadConfig
 from hermes_tpu.core import types
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
-__all__ = ["HermesConfig", "types", "__version__"]
+__all__ = ["HermesConfig", "WorkloadConfig", "types", "KVS", "KeyIndex",
+           "FastRuntime", "Runtime", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy top-level exports: `hermes_tpu.KVS` etc. without importing jax
+    # (and the runtimes behind it) at package import time — config-only
+    # consumers (tooling, tests collecting) stay light.  Resolved names are
+    # cached in module globals, so __getattr__ runs once per name.
+    if name == "KVS":
+        from hermes_tpu.kvs import KVS as obj
+    elif name == "KeyIndex":
+        from hermes_tpu.keyindex import KeyIndex as obj
+    elif name in ("FastRuntime", "Runtime"):
+        from hermes_tpu import runtime
+
+        obj = getattr(runtime, name)
+    else:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    globals()[name] = obj
+    return obj
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

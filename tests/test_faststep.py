@@ -81,13 +81,15 @@ def test_lane_budget_backpressure():
     assert c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"] == 3 * 16 * 16
 
 
-def test_frozen_replica_stall_and_recovery():
+@pytest.mark.parametrize("arb_mode", ["race", "sort"])
+def test_frozen_replica_stall_and_recovery(arb_mode):
     """Config-4-shaped (BASELINE.json:10): a replica stalls mid-run; after
     the membership removes it, waiting writes commit against the shrunken
-    quorum and stuck Invalid keys recover via the (gated) replay scan."""
+    quorum and stuck Invalid keys recover via the (gated) replay scan —
+    under both issue-arbitration strategies."""
     cfg = HermesConfig(
         n_replicas=4, n_keys=128, n_sessions=8, replay_slots=16, ops_per_session=16,
-        replay_age=4, replay_scan_every=4,
+        replay_age=4, replay_scan_every=4, arb_mode=arb_mode,
         workload=WorkloadConfig(read_frac=0.4, seed=35),
     )
     rt = FastRuntime(cfg, record=True)
@@ -424,23 +426,3 @@ def test_arb_mode_sort_checked_and_matches_totals():
     np.testing.assert_array_equal(get(b.fs.sess.pts), get(c.fs.sess.pts))
 
 
-def test_arb_mode_sort_failure_recovery():
-    """The sort arbiter under the config-4 failure drill: stall, membership
-    removal, replay recovery — checker-clean, survivors drain."""
-    cfg = HermesConfig(
-        n_replicas=4, n_keys=128, n_sessions=8, replay_slots=16,
-        ops_per_session=16, replay_age=4, replay_scan_every=4,
-        arb_mode="sort",
-        workload=WorkloadConfig(read_frac=0.4, seed=35),
-    )
-    rt = FastRuntime(cfg, record=True)
-    rt.run(6)
-    rt.freeze(3)
-    rt.run(4)
-    rt.remove(3)
-    assert rt.drain(1500)
-    v = rt.check()
-    assert v.ok, (v.failures[:2], v.undecided[:2])
-    status = get(rt.fs.sess.status)
-    for r in range(3):
-        assert (status[r] == t.S_DONE).all()
