@@ -91,3 +91,27 @@ def test_kvs_sparse_keyspace_full_propagates():
         kvs.put(0, 0, (i + 1) * 10**15, [i])
     with pytest.raises(KeyspaceFull):
         kvs.put(0, 1, 999 * 10**15, [9])
+
+
+def test_keyindex_fuzz_against_dict_model():
+    """Randomized ops vs a dict reference model: interleaved inserts,
+    repeat lookups, and absent probes over a small (high-collision) table
+    must agree with the model exactly."""
+    rng = np.random.default_rng(7)
+    idx = KeyIndex(n_keys=128)
+    model = {}
+    universe = rng.integers(0, 2**63, size=400, dtype=np.uint64)
+    for step in range(2000):
+        k = int(universe[rng.integers(0, len(universe))])
+        if rng.random() < 0.5 and len(model) < 128:
+            s = idx.slot(k, insert=True)
+            if k in model:
+                assert s == model[k]
+            else:
+                assert s == len(model)  # dense, insertion-ordered
+                model[k] = s
+        else:
+            assert idx.slot(k, insert=False) == model.get(k, -1)
+            assert (k in idx) == (k in model)
+    for k, s in model.items():
+        assert idx.key_of(s) == k
