@@ -20,13 +20,14 @@ from hermes_tpu.runtime import FastRuntime
 from helpers import get
 
 
-@pytest.mark.parametrize("seed", [11, 23])
-def test_random_fault_soak_checked(seed):
+@pytest.mark.parametrize("seed,arb_mode", [(11, "race"), (23, "race"),
+                                           (23, "sort")])
+def test_random_fault_soak_checked(seed, arb_mode):
     R = 5
     cfg = HermesConfig(
         n_replicas=R, n_keys=96, n_sessions=6, replay_slots=6,
         ops_per_session=30, replay_age=6, replay_scan_every=4,
-        rebroadcast_every=2,
+        rebroadcast_every=2, arb_mode=arb_mode,
         workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=seed),
     )
     rt = FastRuntime(cfg, record=True)
