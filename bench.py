@@ -38,6 +38,9 @@ vs_baseline = value / 1e7 (the north-star aggregate target).
 
 import argparse
 import json
+import math
+import os
+import subprocess
 import sys
 import time
 
@@ -162,7 +165,7 @@ def run_latency() -> dict:
     from hermes_tpu.workload import ycsb
 
     cfg = _latency_cfg()
-    warm, samples = 5, 50
+    warm, samples = 5, 100
     fs = jax.device_put(fst.init_fast_state(cfg))
     stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
     step = fst.build_fast_batched(cfg, donate=True)
@@ -184,8 +187,13 @@ def run_latency() -> dict:
     times = sorted(one(warm + i) for i in range(samples))
     m = jax.device_get(fs.meta)
     commits = int(m.n_write.sum() + m.n_rmw.sum())
-    p50 = times[len(times) // 2]
-    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+    # nearest-rank percentiles (ceil(q*n)-th order statistic): with 100
+    # samples p99 is the 99th value, not the max — one outlier dispatch no
+    # longer defines the reported tail
+    pctl = lambda q: times[min(len(times) - 1,
+                               max(0, math.ceil(q * len(times)) - 1))]
+    p50 = pctl(0.50)
+    p99 = pctl(0.99)
 
     # Per-dispatch floor of this tunneled runtime: a trivial one-op program
     # dispatched+awaited the same way.  The measured commit latency includes
@@ -219,10 +227,72 @@ def run_latency() -> dict:
     }
 
 
+def probe_backend(timeout_s: float, cmd=None):
+    """Bounded backend-availability probe, run in a SUBPROCESS so this
+    process never initializes a backend that cannot come up (round-2
+    lesson: PJRT init against a wedged tunneled-TPU claim hangs
+    indefinitely and ignores signals — BENCH_r02.json rc=1 was the driver
+    timing out around exactly that).  The probe child initializes the
+    default backend, prints a marker, and exits cleanly (releasing its
+    claim); only then does the parent initialize its own.  On timeout the
+    child is still *waiting* for a grant, not holding one, so killing it
+    is safe where killing a granted process mid-run is not.
+
+    On timeout the child is ABANDONED, never killed: the pool's recorded
+    failure mode is that killing a claim-queue process can leave its grant
+    held pool-side (wedging the chip for an hour), while an abandoned
+    waiter either completes later and exits cleanly (releasing) or idles
+    without blocking new processes (verified against a stuck claimer).
+
+    Returns (ok, info): info is the platform name on success, else a
+    one-line diagnosis.  Skipped (trivially ok) when JAX_PLATFORMS=cpu —
+    CPU init cannot hang."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return True, "cpu"
+    if cmd is None:
+        code = ("import jax; "
+                "print('HERMES_BACKEND_OK', jax.devices()[0].platform)")
+        cmd = [sys.executable, "-c", code]
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as out:
+        p = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
+                             text=True)
+        try:
+            p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return False, (
+                f"backend init did not complete within {timeout_s:.0f}s "
+                f"(TPU claim wedged or pool unreachable); probe child "
+                f"pid={p.pid} left running — do NOT kill it mid-claim")
+        out.seek(0)
+        txt = out.read()
+    if p.returncode != 0 or "HERMES_BACKEND_OK" not in txt:
+        tail = [l for l in txt.strip().splitlines() if l.strip()][-1:]
+        return False, (f"backend init failed rc={p.returncode}: "
+                       f"{tail[0] if tail else 'no output'}")
+    return True, txt.split()[-1]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mix", choices=MIXES + ("all", "latency"), default="a")
+    ap.add_argument("--probe-timeout", type=float, default=float(
+        os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
     args = ap.parse_args()
+
+    ok, info = probe_backend(args.probe_timeout)
+    if not ok:
+        # one diagnosable JSON line + non-zero rc instead of inheriting
+        # whatever the wedged claim does (the driver contract under outage);
+        # latency mode keeps its own record shape so a latency outage can't
+        # be misfiled as a zero throughput sample
+        rec = ({"mix": "latency", "error": info}
+               if args.mix == "latency" else
+               {"metric": "committed_writes_per_sec", "value": 0.0,
+                "unit": "writes/s", "vs_baseline": 0.0, "error": info})
+        print(json.dumps(rec))
+        sys.exit(1)
 
     if args.mix == "latency":
         print(json.dumps(run_latency()))

@@ -55,6 +55,12 @@ class Completion:
     value: Optional[List[int]] = None  # payload read (get / rmw read-part)
     uid: Optional[Tuple[int, int]] = None  # unique id of the written value
     step: int = -1
+    # sparse-key mode only: False when a get probed a key never written
+    # (the read completes immediately, value=None, and does NOT claim a
+    # dense slot — read-only probes cannot exhaust the keyspace).  Dense
+    # mode reads of unwritten slots return the zero-initialized value with
+    # found=True, matching a preloaded-table store.
+    found: bool = True
 
 
 class Future:
@@ -133,9 +139,18 @@ class KVS:
             if not (0 <= client_key < (1 << 64) - 1):
                 raise ValueError("sparse keys are unsigned 64-bit "
                                  "(0xFFFF...FF reserved)")
-            # gets allocate too: the KVS has no delete, so an unseen key's
-            # first touch — read or write — claims its dense slot for good
-            slot = self.index.slot(client_key, insert=True)
+            # writes allocate (no delete: a written key holds its dense slot
+            # for good); gets probe WITHOUT inserting — an absent key's read
+            # completes immediately as not-found instead of burning a slot
+            if kind == "get":
+                slot = self.index.slot(client_key, insert=False)
+                if slot < 0:
+                    fut = Future()
+                    fut._result = Completion(kind="get", key=client_key,
+                                             found=False)
+                    return fut
+            else:
+                slot = self.index.slot(client_key, insert=True)
         else:
             if not (0 <= key < cfg.n_keys):
                 raise ValueError(f"key {key} out of range [0, {cfg.n_keys})")

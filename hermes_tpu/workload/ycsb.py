@@ -100,9 +100,14 @@ def _zipf_consts(n: int, theta: float):
     """Constants of the YCSB analytic Zipfian inverse (Gray et al.,
     "Quickly generating billion-record synthetic databases"): rank(u) =
     n * (eta*u - eta + 1)^(1/(1-theta)) with small-rank special cases.
-    Host-side float64 precompute (zeta(n) is a 1-time O(n) sum, cached)."""
-    zetan = float(np.sum(1.0 / np.power(
-        np.arange(1, n + 1, dtype=np.float64), theta)))
+    Host-side float64 precompute (zeta(n) is a 1-time O(n) sum, cached;
+    accumulated in fixed-size chunks so n up to the 2^29 config bound costs
+    ~32 MiB of temporaries, not two ~4 GiB arrays)."""
+    chunk = 1 << 22
+    zetan = 0.0
+    for lo in range(1, n + 1, chunk):
+        ranks = np.arange(lo, min(n + 1, lo + chunk), dtype=np.float64)
+        zetan += float(np.sum(ranks ** -theta))
     zeta2 = 1.0 + 0.5 ** theta
     alpha = 1.0 / (1.0 - theta)
     eta = (1.0 - (2.0 / n) ** (1.0 - theta)) / (1.0 - zeta2 / zetan)

@@ -1,0 +1,56 @@
+"""bench.py outage behavior (round-2 verdict item 2): when the default
+backend cannot initialize, the bench must fail fast with ONE diagnosable
+JSON line and a non-zero rc — never hang into the driver's timeout."""
+
+import json
+import os
+import subprocess
+import sys
+
+import bench
+
+
+def test_probe_skips_on_cpu(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    ok, info = bench.probe_backend(0.001)  # would time out if it ran
+    assert ok and info == "cpu"
+
+
+def test_probe_times_out_on_hang(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    ok, info = bench.probe_backend(
+        1.0, cmd=[sys.executable, "-c", "import time; time.sleep(60)"])
+    assert not ok
+    assert "did not complete within 1s" in info
+
+
+def test_probe_reports_child_failure(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    ok, info = bench.probe_backend(
+        30.0,
+        cmd=[sys.executable, "-c",
+             "import sys; print('boom: no backend', file=sys.stderr); "
+             "sys.exit(3)"])
+    assert not ok
+    assert "rc=3" in info and "boom: no backend" in info
+
+
+def test_bench_main_outage_contract():
+    """End to end: bench.py under an uninitializable platform prints one
+    JSON error line on stdout and exits non-zero, quickly."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "tpu"  # not installed here -> init fails fast
+    env["PALLAS_AXON_POOL_IPS"] = ""  # never touch the real chip from tests
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert p.returncode == 1, p.stderr
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "committed_writes_per_sec"
+    assert rec["value"] == 0.0 and rec["vs_baseline"] == 0.0
+    assert "backend init failed" in rec["error"]
