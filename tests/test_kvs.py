@@ -117,3 +117,18 @@ def test_kvs_sharded_backend_roundtrip():
     g = kvs.get(7, 1, 17)  # farthest replica reads locally after VAL
     assert kvs.run_until([g], max_steps=200)
     assert g.result().value[:2] == [123, 456]
+
+
+def test_kvs_client_path_at_scale_checked(monkeypatch):
+    """>=10k client ops through the session API complete and check clean
+    (round-2 verdict item 7); the vectorized completion matcher keeps
+    per-round cost flat in the in-flight count.  (Throughput itself is a
+    bench concern — scripts/kvs_scale.py reports it — not asserted here.)"""
+    import os
+    monkeypatch.syspath_prepend(
+        os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import kvs_scale
+
+    rec = kvs_scale.run(ops=10_000, replicas=3, sessions=512, keys=2048)
+    assert rec["completed"] == 10_000 and rec["all_done"]
+    assert rec["checked_ok"] is True
