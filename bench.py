@@ -64,7 +64,16 @@ def _cfg(mix: str):
             read_frac=0.5, seed=0, distribution="zipfian", zipf_theta=0.99
         ),
     }[mix]
+    # Hot-key mitigation (BASELINE.md "Round-3 mitigation"): the contended
+    # mix runs the sort arbiter with intra-round write chaining, lifting the
+    # per-key service rate from n_replicas to n_replicas*chain_writes per
+    # round.  Version burn is ~chain_writes per round for the hottest key
+    # (replicas mint overlapping ranges from one committed base), so 128 *
+    # ~250 bench rounds ~= 32k of the ~1M packed-ts budget (watermark-
+    # guarded).
+    arb = dict(arb_mode="sort", chain_writes=128) if mix == "zipfian" else {}
     return HermesConfig(
+        **arb,
         n_replicas=8,
         n_keys=1 << 20,  # 1M keys (BASELINE.json:7)
         value_words=8,  # 32B values, the reference's typical small-value shape

@@ -95,6 +95,23 @@ class HermesConfig:
     # Both are protocol-equivalent (lowest eligible session wins a key).
     arb_mode: Literal["race", "sort"] = "race"
 
+    # Intra-round same-key write chaining (sort arbiter only): up to this
+    # many of a replica's wanting sessions for ONE key issue per round as a
+    # packed-ts chain (ver+1, ver+2, ..) and commit together — the hot-key
+    # service-rate lever (BASELINE.json:9): per-key throughput becomes
+    # ~n_replicas*chain_writes per round instead of n_replicas.  Chained
+    # writes are superseded in-round by the chain top exactly like
+    # cross-replica same-version writes are today (ordered by ts, value
+    # never observed), so linearizability is unchanged.  Only PLAIN writes
+    # chain: an RMW issues alone at the head of a run and blocks chaining
+    # behind it (its read-part must see the immediately-preceding value).
+    # 0 disables (identical program to the unchained arbiter).  Version
+    # budget: a hot key consumes ~chain_writes versions per round (replicas
+    # mint overlapping ranges from the same committed base version — only
+    # the max survives) against max_key_versions (~1M); the runtime
+    # watermark guard catches a crossing loudly.
+    chain_writes: int = 0
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or
@@ -121,6 +138,13 @@ class HermesConfig:
             raise ValueError("arb_slots_cfg must be a positive power of two")
         if self.arb_mode not in ("race", "sort"):
             raise ValueError("arb_mode must be 'race' or 'sort'")
+        if not (0 <= self.chain_writes <= 4096):
+            raise ValueError("chain_writes must be in [0, 4096]")
+        if self.chain_writes and self.arb_mode != "sort":
+            raise ValueError(
+                "chain_writes needs arb_mode='sort' (chain ranks come from "
+                "the sorted equal-key runs)"
+            )
         if self.n_keys > (1 << 29):
             raise ValueError(
                 "n_keys must fit 29 bits (faststep packs key|fresh|valid "

@@ -543,7 +543,42 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # un-readable, so no duplicate-ts window exists.
     want = (sess.status == t.S_ISSUE) & k_valid & ~frozen
     idxs = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (R, S))
-    if cfg.arb_mode == "sort":
+    chain_rank = jnp.zeros((R, S), jnp.int32)
+    if cfg.arb_mode == "sort" and cfg.chain_writes:
+        # Same sorted equal-key runs as the plain sort arbiter, but up to
+        # chain_writes entries of a run issue TOGETHER as a packed-ts chain:
+        # entry at rank r within its run mints ver+1+r, so a hot key drains
+        # a whole queue of same-replica writes in one round (the chained
+        # writes are superseded in-round by the chain top exactly like
+        # cross-replica same-version losers are — they commit, ordered by
+        # ts, value never observed; see config.chain_writes).  Only plain
+        # writes may follow the run head: an RMW's read-part must observe
+        # the immediately-preceding value, so any RMW in the run blocks
+        # chaining past it (rank computed from two dense cummax scans — no
+        # extra sparse ops on the round's critical chain).
+        skey = jnp.where(want, sess.key, jnp.int32(cfg.n_keys))
+        sop = jnp.where(want, sess.op, 0)
+        sk, si, so = jax.lax.sort((skey, idxs, sop), dimension=1, num_keys=1)
+        first = jnp.concatenate(
+            [jnp.ones((R, 1), bool), sk[:, 1:] != sk[:, :-1]], axis=1)
+        pos = idxs  # iota along the sorted axis
+        start = jax.lax.cummax(jnp.where(first, pos, -1), axis=1)
+        bad = so != t.OP_WRITE  # RMW (or ineligible) blocks chaining after it
+        last_bad = jax.lax.cummax(jnp.where(bad, pos, -1), axis=1)
+        rank = pos - start
+        in_run = sk < cfg.n_keys
+        issue = in_run & (
+            first
+            | (~bad & (last_bad < start) & (rank < cfg.chain_writes))
+        )
+        # pack (issue bit | rank) through the same permutation scatter the
+        # plain sort arbiter uses for its win bit
+        packed = jnp.where(issue, (jnp.int32(1) << 20) | rank, 0)
+        wz = jnp.zeros((R * S,), jnp.int32)
+        p_flat = wz.at[_gkey(wz, si)].max(packed, mode="drop").reshape(R, S)
+        win = want & (p_flat != 0)
+        chain_rank = jnp.where(win, p_flat & 0xFFFF, 0)
+    elif cfg.arb_mode == "sort":
         # lexicographic (key, session) sort per replica: the first entry of
         # each equal-key run (= the lowest wanting session, lax.sort is
         # stable) wins; ineligible sessions sort past K.  One sort + ONE
@@ -570,7 +605,7 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
 
     flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
     fc = (flag << 8) | ctl.my_cid[:, None]
-    new_pts = pack_pts(pts_ver(k_vpts) + 1, fc)
+    new_pts = pack_pts(pts_ver(k_vpts) + 1 + chain_rank, fc)
 
     # --- replay scan, cond-gated (SURVEY.md §3.4; only matters after
     # failures, so it runs every replay_scan_every rounds) ------------------

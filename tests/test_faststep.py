@@ -426,3 +426,104 @@ def test_arb_mode_sort_checked_and_matches_totals():
     np.testing.assert_array_equal(get(b.fs.sess.pts), get(c.fs.sess.pts))
 
 
+
+
+# --------------------------------------------------------------------------
+# Intra-round same-key write chaining (cfg.chain_writes; BASELINE.json:9's
+# hot-key lever): a replica's wanting plain-write sessions for one key issue
+# as a packed-ts chain and commit together in one round.
+# --------------------------------------------------------------------------
+
+
+def _hot_write_stream(cfg, key=0):
+    """Every session writes the same key, ops_per_session times."""
+    from hermes_tpu.core import state as st
+
+    r, s, g = cfg.n_replicas, cfg.n_sessions, cfg.ops_per_session
+    return st.OpStream(
+        op=np.full((r, s, g), t.OP_WRITE, np.int32),
+        key=np.full((r, s, g), key, np.int32),
+        uval=None,
+    )
+
+
+def test_chain_writes_hot_key_service_rate_and_check():
+    """With chaining, one round commits ~n_sessions writes of a single hot
+    key per replica instead of 1; the drained run stays checker-clean."""
+    base = dict(n_replicas=3, n_keys=64, n_sessions=16, replay_slots=4,
+                ops_per_session=8, arb_mode="sort")
+    commits = {}
+    for cw in (0, 16):
+        cfg = HermesConfig(**base, chain_writes=cw)
+        rt = FastRuntime(cfg, record=False, stream=_hot_write_stream(cfg))
+        rt.run(6)
+        commits[cw] = rt.counters()["n_write"]
+    # unchained: one commit per replica per round; chained: one per wanting
+    # session per replica per round
+    assert commits[16] >= 8 * commits[0], commits
+    rt = drained_checked(
+        HermesConfig(**base, chain_writes=16),
+        stream=_hot_write_stream(HermesConfig(**base, chain_writes=16)),
+    )
+    c = rt.counters()
+    assert c["n_write"] == 3 * 16 * 8  # every write committed
+
+
+def test_chain_writes_with_rmws_checked():
+    """RMWs never chain behind other writes (their read-part must observe
+    the immediately-preceding value) — the checker's RMW witness pins it
+    under heavy same-key contention."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=8, n_sessions=12, replay_slots=4,
+        ops_per_session=8, arb_mode="sort", chain_writes=8,
+        workload=WorkloadConfig(read_frac=0.3, rmw_frac=0.5, seed=11),
+    )
+    drained_checked(cfg, max_steps=1000)
+
+
+def test_chain_writes_sharded_matches_batched():
+    """batched == sharded equality holds with chaining on (the chain ranks
+    come from the per-replica sort, identical in both executions)."""
+    import jax
+    from jax.sharding import Mesh
+
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=32, n_sessions=6, replay_slots=4,
+        ops_per_session=8, arb_mode="sort", chain_writes=4,
+        workload=WorkloadConfig(read_frac=0.3, rmw_frac=0.2, seed=41),
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+    a = FastRuntime(cfg, backend="batched", record=True)
+    b = FastRuntime(cfg, backend="sharded", mesh=mesh)
+    assert a.drain(300)
+    assert b.drain(300)
+    np.testing.assert_array_equal(get(a.fs.sess.pts), get(b.fs.sess.pts))
+    bval = get(b.fs.table.val).reshape(cfg.n_replicas, cfg.n_keys, -1)
+    for r in range(cfg.n_replicas):
+        np.testing.assert_array_equal(get(a.fs.table.val), bval[r])
+    ca, cb = a.counters(), b.counters()
+    for k in ("n_read", "n_write", "n_rmw", "n_abort"):
+        assert ca[k] == cb[k], k
+    assert a.check().ok
+
+
+def test_chain_writes_blocked_quorum_then_flows():
+    """Chained in-flight writes survive a blocked quorum: with a frozen
+    live replica nothing commits (each chain member holds its distinct ts
+    across rebroadcasts); after membership removes it, all flow and check."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=16, n_sessions=8, replay_slots=4,
+        ops_per_session=4, arb_mode="sort", chain_writes=8,
+        rebroadcast_every=2,
+        workload=WorkloadConfig(read_frac=0.0, seed=13),
+    )
+    rt = FastRuntime(cfg, record=True, stream=_hot_write_stream(cfg))
+    rt.freeze(2)
+    rt.run(8)
+    assert rt.counters()["n_write"] == 0  # quorum blocked: no commits
+    rt.remove(2)
+    assert rt.drain(400)
+    assert rt.check().ok
+    # the two surviving replicas' writes all committed (the removed
+    # replica is fenced: its own sessions never run)
+    assert rt.counters()["n_write"] == 2 * 8 * 4
