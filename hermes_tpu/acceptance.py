@@ -115,6 +115,71 @@ def run_config(n: int, scale: float = 0.01, max_steps: int = 5000,
     return counters, verdict
 
 
+def run_sparse_variant(scale: float = 0.01, ops: Optional[int] = None,
+                       max_steps: int = 50_000,
+                       check_keys: Optional[int] = None,
+                       log: Optional[Callable[[str], None]] = None
+                       ) -> Tuple[Dict, object]:
+    """Config-1-shaped YCSB-A through the CLIENT KVS in sparse-key mode
+    (round-2 verdict item 5's completion criterion): scale x 1M arbitrary
+    64-bit client keys bulk-preloaded through the vectorized
+    KeyIndex.get_slots, then a 50/50 get/put mix driven over (replica,
+    session) future slots, history-recorded and linearizability-checked.
+    Returns (counters, Verdict) like run_config."""
+    import time
+
+    import numpy as np
+
+    from hermes_tpu.kvs import KVS
+
+    say = log or (lambda s: None)
+    keys = _sz(1 << 20, scale, lo=64)
+    sessions = _sz(1024, scale, lo=8)
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=keys, n_sessions=sessions,
+        replay_slots=max(8, min(sessions // 2, 64)), value_words=8,
+        workload=WorkloadConfig(read_frac=0.5, seed=1),
+    )
+    kvs = KVS(cfg, record=True, sparse_keys=True)
+    rng = np.random.default_rng(1)
+    # odd-constant multiply mod 2^64 is a bijection: `keys` DISTINCT
+    # arbitrary-looking 64-bit client ids (the reserved all-ones sentinel
+    # remapped if it appears)
+    universe = (rng.permutation(np.arange(1, keys + 1, dtype=np.uint64))
+                * np.uint64(0x9E3779B97F4A7C15))
+    universe[universe == np.uint64(0xFFFFFFFFFFFFFFFF)] = np.uint64(12345)
+    t0 = time.perf_counter()
+    kvs.index.get_slots(universe)  # vectorized bulk preload
+    preload_s = time.perf_counter() - t0
+    assert len(kvs.index) == keys
+    say(f"sparse variant: preloaded {keys} 64-bit keys in {preload_s:.2f}s")
+
+    n_ops = ops if ops is not None else 4 * cfg.n_replicas * sessions
+    is_get = rng.random(n_ops) < 0.5
+    op_keys = universe[rng.integers(0, keys, n_ops)]
+    t0 = time.perf_counter()
+    futs = []
+    for i in range(n_ops):
+        r, s = i % cfg.n_replicas, (i // cfg.n_replicas) % sessions
+        if is_get[i]:
+            futs.append(kvs.get(r, s, int(op_keys[i])))
+        else:
+            futs.append(kvs.put(r, s, int(op_keys[i]), [i & 0x7FFF]))
+    drained = kvs.run_until(futs, max_steps=max_steps)
+    drive_s = time.perf_counter() - t0
+    completed = sum(f.done() for f in futs)
+    counters = {k: int(v) for k, v in kvs.counters().items()
+                if k.startswith("n_")}
+    counters.update(
+        drained=bool(drained) and completed == n_ops,
+        preload_keys=keys, preload_s=round(preload_s, 3),
+        client_ops=n_ops, completed=completed,
+        client_ops_per_s=round(completed / drive_s, 1),
+    )
+    verdict = kvs.rt.check(max_keys=check_keys)
+    return counters, verdict
+
+
 def run_all(scale: float = 0.01, log=None):
     """All five scenarios; returns {n: (counters, verdict)}."""
     return {n: run_config(n, scale=scale, log=log) for n in range(1, 6)}

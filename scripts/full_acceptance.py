@@ -25,7 +25,9 @@ def main() -> None:
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--max-steps", type=int, default=20000)
     ap.add_argument("--out", default="ACCEPTANCE_FULL.json")
-    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--configs", default="1,2,3,4,5,s",
+                    help="comma list of 1..5 plus 's' (the sparse-key "
+                         "client-KVS variant of config 1)")
     ap.add_argument("--check-keys", type=int, default=0,
                     help="sample size for the checker; 0 = EVERY touched "
                          "key (the artifact default)")
@@ -35,22 +37,34 @@ def main() -> None:
 
     from hermes_tpu import acceptance
 
+    toks = [x.strip() for x in args.configs.split(",")]
+    bad = [x for x in toks if x not in ("1", "2", "3", "4", "5", "s")]
+    if bad:  # reject upfront — never discard hours of completed runs
+        ap.error(f"--configs tokens must be 1..5 or 's'; got {bad}")
+
     results = {}
-    for n in [int(x) for x in args.configs.split(",")]:
+    for tok in toks:
         t0 = time.perf_counter()
-        counters, verdict = acceptance.run_config(
-            n, scale=args.scale, max_steps=args.max_steps,
-            check_keys=args.check_keys or None,
-            log=lambda s: print(f"  {s}", file=sys.stderr),
-        )
+        if tok == "s":
+            counters, verdict = acceptance.run_sparse_variant(
+                scale=args.scale, max_steps=args.max_steps,
+                check_keys=args.check_keys or None,
+                log=lambda s: print(f"  {s}", file=sys.stderr),
+            )
+        else:
+            counters, verdict = acceptance.run_config(
+                int(tok), scale=args.scale, max_steps=args.max_steps,
+                check_keys=args.check_keys or None,
+                log=lambda s: print(f"  {s}", file=sys.stderr),
+            )
         wall = time.perf_counter() - t0
         entry = {"counters": counters, "wall_s": round(wall, 1)}
         entry.update(verdict.to_dict() if verdict else {
             "verdict_ok": None, "keys_checked": None,
             "failures": [], "undecided": [],
         })
-        results[str(n)] = entry
-        print(f"config {n}: ok={entry['verdict_ok']} drained="
+        results[tok] = entry
+        print(f"config {tok}: ok={entry['verdict_ok']} drained="
               f"{counters.get('drained')} wall={wall:.1f}s "
               f"{ {k: v for k, v in counters.items() if k.startswith('n_')} }",
               file=sys.stderr)

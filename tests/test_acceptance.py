@@ -40,3 +40,13 @@ def test_ycsb_read_heavy_mixes(mix):
     assert c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"] == 3 * 16 * 24
     if mix == "c":
         assert c["n_write"] == 0
+
+
+def test_acceptance_sparse_variant():
+    """Sparse-key client-KVS variant of config 1 (round-2 verdict item 5):
+    bulk-preloaded 64-bit keys, 50/50 client mix, checked clean."""
+    counters, verdict = acceptance.run_sparse_variant(scale=0.004)
+    assert counters["drained"], counters
+    assert counters["completed"] == counters["client_ops"]
+    assert verdict.ok, (verdict.failures[:2], verdict.undecided[:2])
+    assert counters["preload_keys"] >= 64
