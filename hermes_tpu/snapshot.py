@@ -32,7 +32,20 @@ def _flatten(tree, prefix=""):
 
 
 def save(path: str, rt) -> None:
-    """Snapshot a FastRuntime / Runtime (state pytree + host control)."""
+    """Snapshot a FastRuntime / Runtime (state pytree + host control), or a
+    client ``KVS`` — which additionally captures the injected stream arrays
+    and, in sparse-key mode, the KeyIndex (buckets + reverse map), so a
+    restored KVS resolves the same client keys to the same dense slots.
+    A KVS must be QUIESCENT (no queued or in-flight client ops): futures
+    are host objects and cannot be serialized meaningfully."""
+    kvs = None
+    if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
+        kvs, rt = rt, rt.rt
+        if kvs._inflight or any(kvs._queues.values()):
+            raise ValueError(
+                "snapshot requires a quiescent KVS: resolve in-flight ops "
+                "(run step()/run_until) before saving"
+            )
     state = rt.fs if hasattr(rt, "fs") else rt.rs
     arrays = _flatten(state, "state.")
     arrays["ctl.step_idx"] = np.int64(rt.step_idx)
@@ -42,6 +55,16 @@ def save(path: str, rt) -> None:
     arrays["meta.cfg"] = np.frombuffer(
         json.dumps(dataclasses.asdict(rt.cfg)).encode(), dtype=np.uint8
     )
+    if kvs is not None:
+        arrays["kvs.op"] = kvs._op
+        arrays["kvs.key"] = kvs._key
+        arrays["kvs.uval"] = kvs._uval
+        if kvs.index is not None:
+            idx = kvs.index
+            arrays["kvs.index.bucket_key"] = idx._bucket_key
+            arrays["kvs.index.bucket_slot"] = idx._bucket_slot
+            arrays["kvs.index.rev"] = idx._rev
+            arrays["kvs.index.n_used"] = np.int64(idx.n_used)
     np.savez_compressed(path, **arrays)
 
 
@@ -58,8 +81,18 @@ def _rebuild(template, arrays, prefix=""):
 
 
 def load(path: str, rt) -> None:
-    """Restore a snapshot into a runtime built with the SAME config."""
+    """Restore a snapshot into a runtime (or KVS) built with the SAME
+    config.  Restoring a KVS snapshot re-installs the stream arrays and
+    the KeyIndex, so client keys resolve to their saved dense slots.
+
+    ALL validation (config match, KVS-mode match both directions, target
+    quiescence) happens before any mutation: a rejected load leaves the
+    target exactly as it was."""
+    kvs = None
+    if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
+        kvs, rt = rt, rt.rt
     z = np.load(path)
+    # -- validate everything first -----------------------------------------
     saved_cfg = json.loads(bytes(z["meta.cfg"]).decode())
     cur_cfg = dataclasses.asdict(rt.cfg)
     if saved_cfg != cur_cfg:
@@ -67,6 +100,35 @@ def load(path: str, rt) -> None:
             "snapshot config mismatch; rebuild the runtime with the saved "
             f"config (saved={saved_cfg}, current={cur_cfg})"
         )
+    if kvs is not None:
+        if "kvs.op" not in z:
+            raise ValueError("snapshot was not taken from a KVS")
+        if kvs._inflight or any(kvs._queues.values()):
+            raise ValueError(
+                "load requires a quiescent KVS target: restoring over "
+                "queued/in-flight client ops would strand their futures"
+            )
+        sparse_snap = "kvs.index.bucket_key" in z
+        if kvs.index is not None and not sparse_snap:
+            raise ValueError("snapshot has no KeyIndex (dense-key run); "
+                             "build the KVS with sparse_keys=False")
+        if kvs.index is None and sparse_snap:
+            raise ValueError(
+                "snapshot carries a KeyIndex (sparse-key run); build the "
+                "KVS with sparse_keys=True or the client-key mapping is lost"
+            )
+    # -- mutate ------------------------------------------------------------
+    if kvs is not None:
+        kvs._op[:] = z["kvs.op"]
+        kvs._key[:] = z["kvs.key"]
+        kvs._uval[:] = z["kvs.uval"]
+        kvs._dirty = True
+        if kvs.index is not None:
+            idx = kvs.index
+            idx._bucket_key[:] = z["kvs.index.bucket_key"]
+            idx._bucket_slot[:] = z["kvs.index.bucket_slot"]
+            idx._rev[:] = z["kvs.index.rev"]
+            idx.n_used = int(z["kvs.index.n_used"])
     state = rt.fs if hasattr(rt, "fs") else rt.rs
     restored = _rebuild(state, z, "state.")
     if hasattr(rt, "fs"):

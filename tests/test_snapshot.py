@@ -45,3 +45,75 @@ def test_snapshot_config_mismatch_rejected(tmp_path):
 
     with pytest.raises(ValueError):
         snapshot.load(p, other)
+
+
+def test_kvs_sparse_snapshot_roundtrip(tmp_path):
+    """A sparse-key KVS snapshot captures the KeyIndex: the restored KVS
+    resolves the same 64-bit client keys to the same dense slots, reads
+    back pre-snapshot values, and keeps serving new ops."""
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu import snapshot
+
+    cfg = HermesConfig(n_replicas=3, n_keys=64, n_sessions=4, value_words=6,
+                       replay_slots=8)
+    a = KVS(cfg, sparse_keys=True)
+    k1, k2 = 0xDEAD_BEEF_0000_0001, (1 << 61) + 7
+    assert a.run_until([a.put(0, 0, k1, [11]), a.put(1, 1, k2, [22])])
+    p = str(tmp_path / "kvs.npz")
+    snapshot.save(p, a)
+
+    b = KVS(cfg, sparse_keys=True)
+    snapshot.load(p, b)
+    assert b.index.slot(k1, insert=False) == a.index.slot(k1, insert=False)
+    assert len(b.index) == len(a.index)
+    g1, g2 = b.get(2, 0, k1), b.get(0, 2, k2)
+    assert b.run_until([g1, g2])
+    assert g1.result().value[:1] == [11] and g2.result().value[:1] == [22]
+    # restored KVS keeps serving: new key allocates the next dense slot
+    f = b.put(0, 3, 999, [33])
+    assert b.run_until([f])
+    assert b.index.slot(999, insert=False) == len(a.index)
+
+
+def test_kvs_snapshot_refuses_inflight():
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu import snapshot
+    import pytest
+
+    cfg = HermesConfig(n_replicas=3, n_keys=64, n_sessions=4, value_words=6,
+                       replay_slots=8)
+    kvs = KVS(cfg, sparse_keys=True)
+    kvs.put(0, 0, 42, [1])  # queued, unresolved
+    with pytest.raises(ValueError, match="quiescent"):
+        snapshot.save("/tmp/should_not_exist.npz", kvs)
+
+
+def test_kvs_load_validates_before_mutating():
+    """A rejected load leaves the target untouched: wrong-mode and
+    non-quiescent targets raise with no partial restore."""
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu import snapshot
+    import pytest
+
+    cfg = HermesConfig(n_replicas=3, n_keys=64, n_sessions=4, value_words=6,
+                       replay_slots=8)
+    src = KVS(cfg, sparse_keys=True)
+    assert src.run_until([src.put(0, 0, 0xABC, [9])])
+    import tempfile, os
+    p = os.path.join(tempfile.mkdtemp(), "s.npz")
+    snapshot.save(p, src)
+
+    # dense target must refuse a sparse snapshot (mapping would be lost)
+    dense = KVS(cfg)
+    with pytest.raises(ValueError, match="sparse_keys=True"):
+        snapshot.load(p, dense)
+
+    # non-quiescent target must refuse, and stay intact
+    busy = KVS(cfg, sparse_keys=True)
+    fut = busy.put(0, 0, 5, [1])
+    with pytest.raises(ValueError, match="quiescent"):
+        snapshot.load(p, busy)
+    assert busy.run_until([fut])  # its pending op still completes
