@@ -54,7 +54,10 @@ WARMUP_CHUNKS = 1
 MIXES = ("a", "rmw", "zipfian")
 
 
-def _cfg(mix: str):
+def _cfg(mix: str, over: dict | None = None):
+    """Bench config for a mix; ``over`` overrides engine knobs (used by
+    scripts/arb_compare.py to measure arbitration variants at the exact
+    bench shape)."""
     from hermes_tpu.config import HermesConfig, WorkloadConfig
 
     wl = {
@@ -72,6 +75,7 @@ def _cfg(mix: str):
     # ~250 bench rounds ~= 32k of the ~1M packed-ts budget (watermark-
     # guarded).
     arb = dict(arb_mode="sort", chain_writes=128) if mix == "zipfian" else {}
+    arb.update(over or {})
     return HermesConfig(
         **arb,
         n_replicas=8,
@@ -90,12 +94,12 @@ def _cfg(mix: str):
     )
 
 
-def run_mix(mix: str) -> dict:
+def run_mix(mix: str, over: dict | None = None) -> dict:
     from hermes_tpu.core import faststep as fst
     from hermes_tpu.stats import percentile_from_hist
     from hermes_tpu.workload import ycsb
 
-    cfg = _cfg(mix)
+    cfg = _cfg(mix, over)
     fs = jax.device_put(fst.init_fast_state(cfg))
     stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
     chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)

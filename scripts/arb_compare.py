@@ -1,0 +1,72 @@
+"""Sort-vs-race arbitration (and write chaining) at the exact bench shape
+(round-2 verdict item 6): one process, one chip claim, every cell through
+bench.run_mix's measurement protocol.
+
+Matrix:
+  * mixes a / rmw: arb race vs sort (chaining is a contention lever; the
+    uniform mixes measure the arbiter cost difference itself)
+  * mix zipfian: race+0, sort+0, sort+chain128 (the round-3 hot-key lever,
+    BASELINE.md "Round-3 mitigation")
+  * mix a: also sort+chain128, to pin that chaining does not regress the
+    primary uncontended metric
+
+Writes ARB_COMPARE.json and prints one JSON line per cell to stderr, plus
+a final summary line to stdout.  Run on the real chip (default env, no
+other TPU process, no timeout-kill).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import bench
+
+CELLS = [
+    ("a", {"arb_mode": "race", "chain_writes": 0}),
+    ("a", {"arb_mode": "sort", "chain_writes": 0}),
+    ("a", {"arb_mode": "sort", "chain_writes": 128}),
+    ("rmw", {"arb_mode": "race", "chain_writes": 0}),
+    ("rmw", {"arb_mode": "sort", "chain_writes": 0}),
+    ("zipfian", {"arb_mode": "race", "chain_writes": 0}),
+    ("zipfian", {"arb_mode": "sort", "chain_writes": 0}),
+    ("zipfian", {"arb_mode": "sort", "chain_writes": 128}),
+]
+
+
+def main() -> None:
+    ok, info = bench.probe_backend(
+        float(os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
+    if not ok:
+        print(json.dumps({"error": info}))
+        sys.exit(1)
+
+    results = []
+    for mix, over in CELLS:
+        t0 = time.perf_counter()
+        r = bench.run_mix(mix, over=over)
+        r["arb"] = over["arb_mode"]
+        r["chain_writes"] = over["chain_writes"]
+        r["cell_wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(r)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+        # rewrite after every cell: a mid-matrix chip failure must not
+        # discard the completed cells' artifact
+        with open("ARB_COMPARE.json", "w") as f:
+            json.dump(results, f, indent=1)
+    best = {}
+    for r in results:
+        key = r["mix"]
+        if key not in best or r["writes_per_sec"] > best[key]["writes_per_sec"]:
+            best[key] = r
+    print(json.dumps({
+        m: {"arb": b["arb"], "chain_writes": b["chain_writes"],
+            "writes_per_sec": b["writes_per_sec"]}
+        for m, b in best.items()
+    }))
+
+
+if __name__ == "__main__":
+    main()
