@@ -20,14 +20,17 @@ from hermes_tpu.runtime import FastRuntime
 from helpers import get
 
 
-@pytest.mark.parametrize("seed,arb_mode", [(11, "race"), (23, "race"),
-                                           (23, "sort")])
-def test_random_fault_soak_checked(seed, arb_mode):
+@pytest.mark.parametrize("seed,arb_mode,chain", [(11, "race", 0),
+                                                 (23, "race", 0),
+                                                 (23, "sort", 0),
+                                                 (23, "sort", 6),
+                                                 (31, "sort", 6)])
+def test_random_fault_soak_checked(seed, arb_mode, chain):
     R = 5
     cfg = HermesConfig(
         n_replicas=R, n_keys=96, n_sessions=6, replay_slots=6,
         ops_per_session=30, replay_age=6, replay_scan_every=4,
-        rebroadcast_every=2, arb_mode=arb_mode,
+        rebroadcast_every=2, arb_mode=arb_mode, chain_writes=chain,
         workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=seed),
     )
     rt = FastRuntime(cfg, record=True)
