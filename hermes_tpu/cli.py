@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--workload", choices=["a", "b", "c", "f"], default="a",
         help="YCSB mix: a=50/50, b=95/5, c=read-only, f=50/50 with RMW updates",
     )
+    ap.add_argument("--arb-mode", choices=["race", "sort"], default="race",
+                    help="same-key issue arbitration strategy (faststep)")
+    ap.add_argument("--chain-writes", type=int, default=0,
+                    help="intra-round same-key write chain length (faststep "
+                         "hot-key throughput; needs --arb-mode sort)")
     ap.add_argument("--distribution", choices=["uniform", "zipfian"], default="uniform")
     ap.add_argument("--zipf-theta", type=float, default=0.99)
     ap.add_argument("--seed", type=int, default=0)
@@ -71,7 +76,14 @@ MIXES = {
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    ap = build_parser()
+    args = ap.parse_args(argv)
+    if args.chain_writes and args.arb_mode != "sort":
+        ap.error("--chain-writes needs --arb-mode sort")
+    if ((args.arb_mode != "race" or args.chain_writes)
+            and args.backend not in ("fast", "fast-sharded")):
+        ap.error("--arb-mode/--chain-writes only affect the fast backends "
+                 "(core/faststep.py); use --backend fast or fast-sharded")
 
     from hermes_tpu import stats as stats_lib
     from hermes_tpu.config import HermesConfig, WorkloadConfig
@@ -100,6 +112,8 @@ def main(argv=None) -> int:
         ops_per_session=args.ops_per_session,
         lane_budget_cfg=args.lane_budget,
         wrap_stream=args.wrap_stream,
+        arb_mode=args.arb_mode,
+        chain_writes=args.chain_writes,
         workload=WorkloadConfig(
             distribution=args.distribution,
             zipf_theta=args.zipf_theta,

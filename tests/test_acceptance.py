@@ -10,7 +10,7 @@ import pytest
 from hermes_tpu import acceptance
 
 
-@pytest.mark.parametrize("n", [1, 2, 3, 4, 5])
+@pytest.mark.parametrize("n", [1, 2, 3, "3c", 4, 5])
 def test_acceptance_config(n):
     counters, verdict = acceptance.run_config(n, scale=0.004, max_steps=4000)
     assert counters["drained"], counters
