@@ -8,6 +8,8 @@ rc=0
 
 echo "=== bench (all mixes + latency) ===" >&2
 python bench.py --mix all 2>>artifacts_run.log || rc=1
+echo "=== arbitration/chaining matrix ===" >&2
+python scripts/arb_compare.py 2>>artifacts_run.log || rc=1
 echo "=== checked bench window ===" >&2
 python scripts/checked_bench.py --rounds 30 2>>artifacts_run.log || rc=1
 echo "=== full-scale acceptance (scale=1.0, all keys checked) ===" >&2
