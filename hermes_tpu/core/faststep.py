@@ -544,54 +544,50 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     want = (sess.status == t.S_ISSUE) & k_valid & ~frozen
     idxs = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (R, S))
     chain_rank = jnp.zeros((R, S), jnp.int32)
-    if cfg.arb_mode == "sort" and cfg.chain_writes:
-        # Same sorted equal-key runs as the plain sort arbiter, but up to
-        # chain_writes entries of a run issue TOGETHER as a packed-ts chain:
-        # entry at rank r within its run mints ver+1+r, so a hot key drains
-        # a whole queue of same-replica writes in one round (the chained
-        # writes are superseded in-round by the chain top exactly like
-        # cross-replica same-version losers are — they commit, ordered by
-        # ts, value never observed; see config.chain_writes).  Only plain
-        # writes may follow the run head: an RMW's read-part must observe
-        # the immediately-preceding value, so any RMW in the run blocks
-        # chaining past it (rank computed from two dense cummax scans — no
-        # extra sparse ops on the round's critical chain).
-        skey = jnp.where(want, sess.key, jnp.int32(cfg.n_keys))
-        sop = jnp.where(want, sess.op, 0)
-        sk, si, so = jax.lax.sort((skey, idxs, sop), dimension=1, num_keys=1)
-        first = jnp.concatenate(
-            [jnp.ones((R, 1), bool), sk[:, 1:] != sk[:, :-1]], axis=1)
-        pos = idxs  # iota along the sorted axis
-        start = jax.lax.cummax(jnp.where(first, pos, -1), axis=1)
-        bad = so != t.OP_WRITE  # RMW (or ineligible) blocks chaining after it
-        last_bad = jax.lax.cummax(jnp.where(bad, pos, -1), axis=1)
-        rank = pos - start
-        in_run = sk < cfg.n_keys
-        issue = in_run & (
-            first
-            | (~bad & (last_bad < start) & (rank < cfg.chain_writes))
-        )
-        # pack (issue bit | rank) through the same permutation scatter the
-        # plain sort arbiter uses for its win bit
-        packed = jnp.where(issue, (jnp.int32(1) << 20) | rank, 0)
-        wz = jnp.zeros((R * S,), jnp.int32)
-        p_flat = wz.at[_gkey(wz, si)].max(packed, mode="drop").reshape(R, S)
-        win = want & (p_flat != 0)
-        chain_rank = jnp.where(win, p_flat & 0xFFFF, 0)
-    elif cfg.arb_mode == "sort":
+    if cfg.arb_mode == "sort":
         # lexicographic (key, session) sort per replica: the first entry of
         # each equal-key run (= the lowest wanting session, lax.sort is
         # stable) wins; ineligible sessions sort past K.  One sort + ONE
-        # win-bit scatter vs the race's scatter-min + gather, and no false
-        # collisions — every distinct wanted key issues every round.
+        # scatter through the permutation (vs the race's scatter-min +
+        # gather), and no false collisions — every distinct wanted key
+        # issues every round.  With cfg.chain_writes, up to chain_writes
+        # entries of a run issue TOGETHER as a packed-ts chain: entry at
+        # rank r mints ver+1+r, so a hot key drains a whole queue of
+        # same-replica writes in one round (chained writes are superseded
+        # in-round by the chain top exactly like cross-replica same-version
+        # losers are — they commit, ordered by ts, value never observed;
+        # see config.chain_writes).  Only plain writes may follow the run
+        # head: an RMW's read-part must observe the immediately-preceding
+        # value, so any RMW in the run blocks chaining past it (rank from
+        # two dense cummax scans — no extra sparse ops).
         skey = jnp.where(want, sess.key, jnp.int32(cfg.n_keys))
-        sk, si = jax.lax.sort((skey, idxs), dimension=1, num_keys=1)
+        if cfg.chain_writes:
+            sop = jnp.where(want, sess.op, 0)
+            sk, si, so = jax.lax.sort((skey, idxs, sop), dimension=1,
+                                      num_keys=1)
+        else:
+            sk, si = jax.lax.sort((skey, idxs), dimension=1, num_keys=1)
         first = jnp.concatenate(
             [jnp.ones((R, 1), bool), sk[:, 1:] != sk[:, :-1]], axis=1)
-        winbit = (first & (sk < cfg.n_keys)).astype(jnp.int32)
+        in_run = sk < cfg.n_keys
+        if cfg.chain_writes:
+            pos = idxs  # iota along the sorted axis
+            start = jax.lax.cummax(jnp.where(first, pos, -1), axis=1)
+            bad = so != t.OP_WRITE  # RMW blocks chaining after it
+            last_bad = jax.lax.cummax(jnp.where(bad, pos, -1), axis=1)
+            rank = pos - start
+            issue = in_run & (
+                first
+                | (~bad & (last_bad < start) & (rank < cfg.chain_writes))
+            )
+            packed = jnp.where(issue, (jnp.int32(1) << 20) | rank, 0)
+        else:
+            packed = (first & in_run).astype(jnp.int32)
         wz = jnp.zeros((R * S,), jnp.int32)
-        win_flat = wz.at[_gkey(wz, si)].max(winbit, mode="drop")
-        win = want & (win_flat.reshape(R, S) != 0)
+        p_flat = wz.at[_gkey(wz, si)].max(packed, mode="drop").reshape(R, S)
+        win = want & (p_flat != 0)
+        if cfg.chain_writes:
+            chain_rank = jnp.where(win, p_flat & 0xFFFF, 0)
     else:
         # hash-slot race: scatter-min of the session index into a small
         # table; colliding sessions (same slot) defer to the lowest index;

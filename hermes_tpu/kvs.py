@@ -286,3 +286,27 @@ class KVS:
 
     def counters(self) -> dict:
         return self.rt.counters()
+
+
+def drive_mix(kvs: KVS, op_keys, is_get, value_of, max_steps: int = 50_000):
+    """Enqueue a get/put client mix round-robin over (replica, session)
+    slots and run until every future resolves — the shared drive loop of
+    scripts/kvs_scale.py and acceptance.run_sparse_variant.  ``value_of(i)``
+    supplies the payload for op i.  Returns (futures, drained,
+    enqueue_seconds, drive_seconds)."""
+    import time
+
+    cfg = kvs.cfg
+    t0 = time.perf_counter()
+    futs = []
+    for i, k in enumerate(op_keys):
+        r = i % cfg.n_replicas
+        s = (i // cfg.n_replicas) % cfg.n_sessions
+        if is_get[i]:
+            futs.append(kvs.get(r, s, int(k)))
+        else:
+            futs.append(kvs.put(r, s, int(k), value_of(i)))
+    enqueue_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    drained = kvs.run_until(futs, max_steps=max_steps)
+    return futs, drained, enqueue_s, time.perf_counter() - t0

@@ -25,7 +25,7 @@ def run(ops: int = 20000, replicas: int = 3, sessions: int = 1024,
         keys: int = 4096, sparse: bool = False, check: bool = True,
         seed: int = 0) -> dict:
     from hermes_tpu.config import HermesConfig, WorkloadConfig
-    from hermes_tpu.kvs import KVS
+    from hermes_tpu.kvs import KVS, drive_mix
 
     cfg = HermesConfig(
         n_replicas=replicas, n_keys=keys, n_sessions=sessions,
@@ -35,26 +35,15 @@ def run(ops: int = 20000, replicas: int = 3, sessions: int = 1024,
     kvs = KVS(cfg, record=check, sparse_keys=sparse)
     rng = np.random.default_rng(seed)
     is_get = rng.random(ops) < 0.5  # YCSB-A shaped 50/50 client mix
-    op_keys = rng.integers(0, keys, ops)
+    op_keys = rng.integers(0, keys, ops).astype(np.uint64)
+    if sparse:
+        # arbitrary 64-bit client keys through the hash index
+        with np.errstate(over="ignore"):
+            op_keys = (op_keys * np.uint64(0x9E3779B97F4A7C15)
+                       + np.uint64(1)) & np.uint64((1 << 64) - 2)
 
-    t0 = time.perf_counter()
-    futs = []
-    for i in range(ops):
-        r = i % replicas
-        s = (i // replicas) % sessions
-        k = int(op_keys[i])
-        if sparse:
-            # arbitrary 64-bit client keys through the hash index
-            k = (k * 0x9E3779B97F4A7C15 + 1) & ((1 << 64) - 2)
-        if is_get[i]:
-            futs.append(kvs.get(r, s, k))
-        else:
-            futs.append(kvs.put(r, s, k, [i & 0x7FFF, i >> 15]))
-    enqueue_s = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    all_done = kvs.run_until(futs, max_steps=50_000)
-    drive_s = time.perf_counter() - t0
+    futs, all_done, enqueue_s, drive_s = drive_mix(
+        kvs, op_keys, is_get, lambda i: [i & 0x7FFF, i >> 15])
 
     verdict = None
     check_s = None
