@@ -40,7 +40,6 @@ import argparse
 import json
 import math
 import os
-import subprocess
 import sys
 import time
 
@@ -67,14 +66,20 @@ def _cfg(mix: str, over: dict | None = None):
             read_frac=0.5, seed=0, distribution="zipfian", zipf_theta=0.99
         ),
     }[mix]
-    # Hot-key mitigation (BASELINE.md "Round-3 mitigation"): the contended
-    # mix runs the sort arbiter with intra-round write chaining, lifting the
-    # per-key service rate from n_replicas to n_replicas*chain_writes per
-    # round.  Version burn is ~chain_writes per round for the hottest key
-    # (replicas mint overlapping ranges from one committed base), so 128 *
-    # ~250 bench rounds ~= 32k of the ~1M packed-ts budget (watermark-
-    # guarded).
-    arb = dict(arb_mode="sort", chain_writes=128) if mix == "zipfian" else {}
+    # Arbiter choice, measured on-chip (ARB_COMPARE.json, round 4): the
+    # sort arbiter beats the race arbiter on EVERY mix (11.59 -> 12.87M w/s
+    # YCSB-A, 10.45 -> 12.01M RMW — one lax.sort + permutation scatter vs
+    # scatter-min + gather, and no false collisions), so it is the bench
+    # default everywhere.  Intra-round write chaining (BASELINE.md
+    # "Round-3 mitigation") lifts the per-key service rate from n_replicas
+    # to n_replicas*chain_writes per round — 13.3x on the contended zipfian
+    # mix (97k -> 1.29M w/s), free on uniform — and stays off for the RMW
+    # mix (RMWs never chain).  Version burn under chaining is
+    # ~chain_writes/round for the hottest key against the ~1M packed-ts
+    # budget; the runtime's auto-rebase (config.auto_rebase) reclaims it.
+    arb = dict(arb_mode="sort")
+    if mix != "rmw":
+        arb["chain_writes"] = 128
     arb.update(over or {})
     return HermesConfig(
         **arb,
@@ -156,18 +161,18 @@ def run_mix(mix: str, over: dict | None = None) -> dict:
     }
 
 
-def _latency_cfg():
+def _latency_cfg(n_sessions: int = 1024):
     from hermes_tpu.config import HermesConfig, WorkloadConfig
 
     return HermesConfig(
-        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=1024,
+        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=n_sessions,
         replay_slots=64, ops_per_session=256, wrap_stream=True,
         device_stream=True, read_unroll=1, rebroadcast_every=4,
         replay_scan_every=32, workload=WorkloadConfig(read_frac=0.5, seed=0),
     )
 
 
-def run_latency() -> dict:
+def run_latency(n_sessions: int = 1024) -> dict:
     """The latency-optimized operating point (BASELINE.json:2's p50 metric):
     ONE protocol round per dispatch at small scale, so a write commits in
     one round whose wall time IS the commit latency — no scan amortization.
@@ -177,7 +182,7 @@ def run_latency() -> dict:
     from hermes_tpu.core import faststep as fst
     from hermes_tpu.workload import ycsb
 
-    cfg = _latency_cfg()
+    cfg = _latency_cfg(n_sessions)
     warm, samples = 5, 100
     fs = jax.device_put(fst.init_fast_state(cfg))
     stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
@@ -240,51 +245,9 @@ def run_latency() -> dict:
     }
 
 
-def probe_backend(timeout_s: float, cmd=None):
-    """Bounded backend-availability probe, run in a SUBPROCESS so this
-    process never initializes a backend that cannot come up (round-2
-    lesson: PJRT init against a wedged tunneled-TPU claim hangs
-    indefinitely and ignores signals — BENCH_r02.json rc=1 was the driver
-    timing out around exactly that).  The probe child initializes the
-    default backend, prints a marker, and exits cleanly (releasing its
-    claim); only then does the parent initialize its own.  On timeout the
-    child is still *waiting* for a grant, not holding one, so killing it
-    is safe where killing a granted process mid-run is not.
-
-    On timeout the child is ABANDONED, never killed: the pool's recorded
-    failure mode is that killing a claim-queue process can leave its grant
-    held pool-side (wedging the chip for an hour), while an abandoned
-    waiter either completes later and exits cleanly (releasing) or idles
-    without blocking new processes (verified against a stuck claimer).
-
-    Returns (ok, info): info is the platform name on success, else a
-    one-line diagnosis.  Skipped (trivially ok) when JAX_PLATFORMS=cpu —
-    CPU init cannot hang."""
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return True, "cpu"
-    if cmd is None:
-        code = ("import jax; "
-                "print('HERMES_BACKEND_OK', jax.devices()[0].platform)")
-        cmd = [sys.executable, "-c", code]
-    import tempfile
-
-    with tempfile.TemporaryFile(mode="w+") as out:
-        p = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
-                             text=True)
-        try:
-            p.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            return False, (
-                f"backend init did not complete within {timeout_s:.0f}s "
-                f"(TPU claim wedged or pool unreachable); probe child "
-                f"pid={p.pid} left running — do NOT kill it mid-claim")
-        out.seek(0)
-        txt = out.read()
-    if p.returncode != 0 or "HERMES_BACKEND_OK" not in txt:
-        tail = [l for l in txt.strip().splitlines() if l.strip()][-1:]
-        return False, (f"backend init failed rc={p.returncode}: "
-                       f"{tail[0] if tail else 'no output'}")
-    return True, txt.split()[-1]
+# Shared with __graft_entry__.entry(): every driver entry path fails fast
+# on a wedged backend with the same bounded subprocess probe.
+from hermes_tpu.probe import probe_backend  # noqa: E402
 
 
 def main() -> None:
@@ -319,8 +282,17 @@ def main() -> None:
         print(json.dumps(r), file=sys.stderr)
 
     if args.mix == "all":
-        results["latency"] = run_latency()
-        print(json.dumps(results["latency"]), file=sys.stderr)
+        # latency operating point at three scales (round-3 verdict item 7):
+        # p50 - dispatch_floor isolates program latency from the tunneled
+        # link handshake at each in-flight count
+        for s in (256, 1024, 4096):
+            cell = run_latency(n_sessions=s)
+            cell["mix"] = f"latency_s{s}"
+            results[cell["mix"]] = cell
+            print(json.dumps(cell), file=sys.stderr)
+        # historical key: a copy, so its mix tag still reads "latency" (the
+        # outage path emits {"mix": "latency", ...} — consumers key on it)
+        results["latency"] = dict(results["latency_s1024"], mix="latency")
         with open("BENCH_MIXES.json", "w") as f:
             json.dump(results, f, indent=1)
 

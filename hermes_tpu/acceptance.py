@@ -69,19 +69,16 @@ def run_config(n: int, scale: float = 0.01, max_steps: int = 5000,
     """Run acceptance scenario ``n``; returns (counters, Verdict|None).
     ``check_keys`` samples the checked key set (None = every touched key —
     the full-scale artifact's setting; 512 keeps CI fast)."""
-    import shutil
+    from hermes_tpu.checker.fast import default_record
 
     say = log or (lambda s: None)
     cfg = _cfg(n, scale)
     # columnar recorder + native witness (checker/fast.py): same verdicts
     # as the Python recorder (witness FAILs are confirmed by the exact
-    # search) at a per-op cost that survives scale=1.0 histories.  The
-    # witness core is C++ — fall back to the pure-Python recorder/checker
-    # where no compiler exists.
-    record = False
-    if check:
-        record = "array" if shutil.which("g++") else True
-    rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=record)
+    # search) at a per-op cost that survives scale=1.0 histories; falls
+    # back to the pure-Python recorder where no compiler exists.
+    rt = FastRuntime(cfg, backend=backend, mesh=mesh,
+                     record=default_record(check))
     say(f"config {n}: R={cfg.n_replicas} K={cfg.n_keys} S={cfg.n_sessions} "
         f"G={cfg.ops_per_session} wl={cfg.workload}")
 
@@ -148,27 +145,35 @@ def run_sparse_variant(scale: float = 0.01, ops: Optional[int] = None,
         replay_slots=max(8, min(sessions // 2, 64)), value_words=8,
         workload=WorkloadConfig(read_frac=0.5, seed=1),
     )
-    kvs = KVS(cfg, record=True, sparse_keys=True)
+    from hermes_tpu.checker.fast import default_record
+
+    kvs = KVS(cfg, record=default_record(), sparse_keys=True)
     rng = np.random.default_rng(1)
     # odd-constant multiply mod 2^64 is a bijection: `keys` DISTINCT
-    # arbitrary-looking 64-bit client ids (the reserved all-ones sentinel
-    # remapped if it appears)
+    # arbitrary-looking 64-bit client ids.  The reserved all-ones bucket
+    # sentinel, if it appears, is remapped to 0 — the image of x=0, which is
+    # outside the image of {1..keys}, so the universe stays duplicate-free
+    # (round-3 advisor: the old 12345 remap could collide with a real
+    # universe element and the only guard was a -O-stripped assert).
     universe = (rng.permutation(np.arange(1, keys + 1, dtype=np.uint64))
                 * np.uint64(0x9E3779B97F4A7C15))
-    universe[universe == np.uint64(0xFFFFFFFFFFFFFFFF)] = np.uint64(12345)
+    universe[universe == np.uint64(0xFFFFFFFFFFFFFFFF)] = np.uint64(0)
     t0 = time.perf_counter()
     kvs.index.get_slots(universe)  # vectorized bulk preload
     preload_s = time.perf_counter() - t0
-    assert len(kvs.index) == keys
+    if len(kvs.index) != keys:
+        raise RuntimeError(
+            f"sparse preload invariant broken: index holds "
+            f"{len(kvs.index)} slots for {keys} distinct client keys")
     say(f"sparse variant: preloaded {keys} 64-bit keys in {preload_s:.2f}s")
 
     n_ops = ops if ops is not None else 4 * cfg.n_replicas * sessions
     is_get = rng.random(n_ops) < 0.5
     op_keys = universe[rng.integers(0, keys, n_ops)]
-    futs, drained, enq_s, run_s = drive_mix(
+    bf, drained, enq_s, run_s = drive_mix(
         kvs, op_keys, is_get, lambda i: [i & 0x7FFF], max_steps=max_steps)
     drive_s = enq_s + run_s  # keep the artifact's historical rate meaning
-    completed = sum(f.done() for f in futs)
+    completed = bf.done_count()
     counters = {k: int(v) for k, v in kvs.counters().items()
                 if k.startswith("n_")}
     counters.update(

@@ -39,12 +39,25 @@ _I64_MAX = np.iinfo(np.int64).max
 K_READ, K_WRITE, K_RMW, K_MAYBE_W = 0, 1, 2, 3
 
 
+_CXX = "g++"  # the witness core's compiler (single source of truth)
+
+
+def default_record(check: bool = True):
+    """The recorder kind a checked run should use: ``"array"`` (columnar
+    recorder + this native witness) when the compiler is available, the
+    pure-Python recorder (``True``) otherwise, ``False`` when not checking.
+    Shared by acceptance / kvs_scale so the compiler choice lives here."""
+    import shutil
+
+    return ("array" if shutil.which(_CXX) else True) if check else False
+
+
 def _ensure_built() -> pathlib.Path:
     if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
         return _SO
     tmp = _SO.with_suffix(f".so.tmp.{os.getpid()}")
     subprocess.run(
-        ["g++", "-O2", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)],
+        [_CXX, "-O2", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)],
         check=True, cwd=str(_NATIVE_DIR),
     )
     os.replace(tmp, _SO)

@@ -112,6 +112,17 @@ class HermesConfig:
     # watermark guard catches a crossing loudly.
     chain_writes: int = 0
 
+    # Version-rebase (round-4; removes the chaining version-budget cliff):
+    # when a counter poll sees the packed-ts watermark past
+    # rebase_fraction * max_key_versions, the runtime quiesces in-flight
+    # writes and resets settled keys to version 1
+    # (FastRuntime.rebase_versions), restoring the full budget; recorded
+    # histories stay checker-valid across the reset (per-key deltas are
+    # added back on record).  auto_rebase=False restores the old loud-
+    # RuntimeError-only behavior.
+    auto_rebase: bool = True
+    rebase_fraction: float = 0.5
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or

@@ -75,6 +75,62 @@ class Future:
         return self._result
 
 
+class BatchFutures:
+    """Array-form futures for a ``KVS.submit_batch`` call (round-3 verdict
+    item 5: array-in, futures-out).  Results land in preallocated numpy
+    columns — no per-op Python objects anywhere on the completion path:
+
+      ``code``  (n,) int32 — 0 while pending, else the completion code
+                (types.C_READ/C_WRITE/C_RMW/C_RMW_ABORT)
+      ``value`` (n, value_words-2) int32 — payload read (gets / rmw
+                read-part; zeros otherwise)
+      ``uid``   (n, 2) int32 — unique id of the written value
+      ``found`` (n,) bool — sparse mode: False for gets of never-written
+                keys (completed immediately, no slot claimed)
+
+    ``future(i)`` materializes a classic per-op Future view lazily for
+    callers that want one."""
+
+    def __init__(self, kinds: np.ndarray, keys: np.ndarray, u: int):
+        n = kinds.shape[0]
+        self.kind = kinds
+        self.key = keys
+        self.code = np.zeros(n, np.int32)
+        self.value = np.zeros((n, u), np.int32)
+        self.uid = np.zeros((n, 2), np.int32)
+        self.found = np.ones(n, bool)
+
+    def __len__(self) -> int:
+        return self.code.shape[0]
+
+    def done_count(self) -> int:
+        return int(np.count_nonzero(self.code))
+
+    def all_done(self) -> bool:
+        return bool((self.code != 0).all())
+
+    _KINDSTR = {t.OP_READ: "get", t.OP_WRITE: "put", t.OP_RMW: "rmw"}
+
+    def completion(self, i: int) -> Completion:
+        assert self.code[i] != 0, "op not complete; run KVS.run_batch()"
+        c = int(self.code[i])
+        kind = ("rmw_abort" if c == t.C_RMW_ABORT
+                else self._KINDSTR[int(self.kind[i])])
+        done = Completion(kind=kind, key=int(self.key[i]),
+                          found=bool(self.found[i]))
+        if c in (t.C_READ, t.C_RMW) and self.found[i]:
+            done.value = self.value[i].tolist()
+        if c in (t.C_WRITE, t.C_RMW):
+            done.uid = (int(self.uid[i, 0]), int(self.uid[i, 1]))
+        return done
+
+    def future(self, i: int) -> Future:
+        fut = Future()
+        if self.code[i] != 0:
+            fut._result = self.completion(i)
+        return fut
+
+
 class KVS:
     """A replicated, linearizable KVS served by the Hermes protocol.
 
@@ -109,6 +165,10 @@ class KVS:
         stream = st.OpStream(op=self._op, key=self._key, uval=self._uval)
         self.rt = FastRuntime(self.cfg, backend=backend, mesh=mesh, record=record,
                               stream=stream)
+        # the runtime's rebase quiesce drain must step THROUGH this layer:
+        # a raw rt.step_once() there would drop Completions on the floor and
+        # strand the matching client futures forever
+        self.rt.comp_sink = self.step
         self._queues: Dict[Tuple[int, int], collections.deque] = (
             collections.defaultdict(collections.deque)
         )
@@ -121,6 +181,13 @@ class KVS:
         self._kindarr = np.zeros((r, s), np.int32)
         self._ready: set = set()
         self._dirty = True
+        # batched client path (round-3 verdict item 5): active submit_batch
+        # calls keyed by a stable id; per-slot (batch id, batch index) so
+        # completions resolve into the BatchFutures columns vectorized
+        self._bat: Dict[int, dict] = {}
+        self._next_bid = 0
+        self._slot_bid = np.full((r, s), -1, np.int32)
+        self._slot_bix = np.zeros((r, s), np.int32)
         # sparse-key mode (SURVEY.md §1 L2, MICA-index parity): arbitrary
         # 64-bit client keys map to dense device slots through an exact
         # open-addressing index (hermes_tpu/keyindex.py); completions
@@ -191,6 +258,102 @@ class KVS:
             raise ValueError(f"value must be <= {u} int32 words")
         return np.pad(arr, (0, u - arr.shape[0]))
 
+    # -- batched client path (array-in, futures-out) -------------------------
+
+    GET, PUT, RMW = t.OP_READ, t.OP_WRITE, t.OP_RMW
+
+    def submit_batch(self, kinds, keys, values=None) -> BatchFutures:
+        """Enqueue a whole op mix at once: ``kinds`` (n,) of KVS.GET/PUT/RMW,
+        ``keys`` (n,) client keys, ``values`` (n, <=value_words-2) int32
+        payloads (rows for gets ignored).  Ops flow through idle (replica,
+        session) slots in submission order, as many per round as there are
+        free slots — the whole path (slot fill, completion match, result
+        store) is numpy-vectorized, no per-op Python objects (round-3
+        verdict item 5: the public L5 API at engine-scale throughput).
+        Returns a BatchFutures; drive it with run_batch()/step()."""
+        opc = np.ascontiguousarray(np.asarray(kinds, np.int32))
+        n = opc.shape[0]
+        bad = ~np.isin(opc, (t.OP_READ, t.OP_WRITE, t.OP_RMW))
+        if bad.any():
+            raise ValueError(f"unknown op kind(s) {np.unique(opc[bad])}")
+        keys_arr = np.asarray(keys)
+        if keys_arr.shape != (n,):
+            raise ValueError("keys must be shape (n,)")
+        u = self.cfg.value_words - 2
+        uval = np.zeros((n, u), np.int32)
+        if values is not None:
+            v = np.asarray(values, np.int32)
+            if v.ndim != 2 or v.shape[0] != n or v.shape[1] > u:
+                raise ValueError(f"values must be (n, <={u}) int32 words")
+            uval[:, : v.shape[1]] = v
+        bf = BatchFutures(opc.copy(), keys_arr.copy(), u)
+        if self.index is not None:
+            k64 = keys_arr.astype(np.uint64)
+            slots = np.zeros(n, np.int32)
+            wr = opc != t.OP_READ
+            if wr.any():
+                slots[wr] = self.index.get_slots(k64[wr])
+            rd = ~wr
+            if rd.any():
+                got = self.index.get_slots(k64[rd], insert=False)
+                gi = np.nonzero(rd)[0]
+                miss = got < 0
+                # absent keys: the get completes immediately as not-found
+                # without claiming a dense slot (same rule as get())
+                bf.code[gi[miss]] = t.C_READ
+                bf.found[gi[miss]] = False
+                slots[gi[~miss]] = got[~miss]
+        else:
+            kmin, kmax = (int(keys_arr.min()), int(keys_arr.max())) if n else (0, 0)
+            if n and not (0 <= kmin and kmax < self.cfg.n_keys):
+                raise ValueError(
+                    f"keys out of range [0, {self.cfg.n_keys})")
+            slots = keys_arr.astype(np.int32)
+        pend = np.nonzero(bf.code == 0)[0].astype(np.int32)
+        if pend.size:
+            self._bat[self._next_bid] = dict(
+                bf=bf, gix=pend, opc=opc[pend], slots=slots[pend],
+                uval=uval[pend], cursor=0)
+            self._next_bid += 1
+        return bf
+
+    def run_batch(self, bf: BatchFutures, max_steps: int = 50_000) -> bool:
+        """Step until every op of ``bf`` resolves (or the budget runs out)."""
+        for _ in range(max_steps):
+            if bf.all_done():
+                return True
+            self.step()
+        return bf.all_done()
+
+    def _inject_batches(self) -> None:
+        free = self._kindarr == t.OP_NOP
+        # slots with queued per-op traffic keep their FIFO promise
+        for rs_key, q in self._queues.items():
+            if q:
+                free[rs_key] = False
+        rows, cols = np.nonzero(free)
+        if rows.size == 0:
+            return
+        p = 0
+        for bid, b in self._bat.items():
+            if p >= rows.size:
+                break
+            cur, total = b["cursor"], b["opc"].shape[0]
+            if cur >= total:
+                continue
+            take = min(total - cur, rows.size - p)
+            rr, cc = rows[p : p + take], cols[p : p + take]
+            sl = slice(cur, cur + take)
+            self._op[rr, cc, 0] = b["opc"][sl]
+            self._key[rr, cc, 0] = b["slots"][sl]
+            self._uval[rr, cc, 0] = b["uval"][sl]
+            self._kindarr[rr, cc] = b["opc"][sl]
+            self._slot_bid[rr, cc] = bid
+            self._slot_bix[rr, cc] = b["gix"][sl]
+            b["cursor"] = cur + take
+            p += take
+            self._dirty = True
+
     # -- stepping ------------------------------------------------------------
 
     _OPC = {"get": t.OP_READ, "put": t.OP_WRITE, "rmw": t.OP_RMW}
@@ -202,10 +365,17 @@ class KVS:
 
         # inject queued ops into idle slots (only slots marked ready —
         # enqueue and completion maintain the invariant that every idle
-        # slot with queued work is in _ready)
+        # slot with queued work is in _ready).  A slot currently owned by a
+        # batch op is NOT idle: injecting over it would clobber the batch's
+        # in-flight stream entry and strand both ops — such slots wait
+        # (batch retirement re-readies them).
+        waiting = set()
         for rs_key in self._ready:
             q = self._queues.get(rs_key)
             if rs_key in self._inflight or not q:
+                continue
+            if self._slot_bid[rs_key] >= 0:
+                waiting.add(rs_key)
                 continue
             kind, slot, client_key, value, fut = q.popleft()
             r, s = rs_key
@@ -217,6 +387,9 @@ class KVS:
             self._kindarr[r, s] = self._OPC[kind]
             self._dirty = True
         self._ready.clear()
+        self._ready |= waiting
+        if self._bat:
+            self._inject_batches()
         if self._dirty:
             from hermes_tpu.core import faststep as fst
 
@@ -242,7 +415,35 @@ class KVS:
             & (ckey == self._key[:, :, 0])
         )
         ndone = 0
-        for r, s in np.argwhere(done_mask):
+        # batch-owned slots: results land in the BatchFutures columns with
+        # three fancy-index stores, then the slots retire vectorized
+        bdone = done_mask & (self._slot_bid >= 0)
+        if bdone.any():
+            rows, cols = np.nonzero(bdone)
+            bids = self._slot_bid[rows, cols]
+            for bid in np.unique(bids):
+                m = bids == bid
+                rr, cc = rows[m], cols[m]
+                b = self._bat[bid]
+                bf: BatchFutures = b["bf"]
+                gi = self._slot_bix[rr, cc]
+                bf.code[gi] = code[rr, cc]
+                bf.value[gi] = rval[rr, cc, 2:]
+                bf.uid[gi] = wval[rr, cc, :2]
+                if b["cursor"] >= b["opc"].shape[0] and bf.all_done():
+                    del self._bat[bid]
+            self._op[rows, cols, 0] = t.OP_NOP
+            self._kindarr[rows, cols] = t.OP_NOP
+            self._slot_bid[rows, cols] = -1
+            self._dirty = True
+            ndone += rows.size
+            # freed slots with waiting per-op traffic become injectable
+            # again (O(#queued slots), not O(#retired))
+            for rs_key, q in self._queues.items():
+                if q and self._slot_bid[rs_key] < 0 \
+                        and rs_key not in self._inflight:
+                    self._ready.add(rs_key)
+        for r, s in np.argwhere(done_mask & ~bdone):
             r, s = int(r), int(s)
             kind, fut, client_key = self._inflight.pop((r, s))
             c = int(code[r, s])
@@ -289,24 +490,24 @@ class KVS:
 
 
 def drive_mix(kvs: KVS, op_keys, is_get, value_of, max_steps: int = 50_000):
-    """Enqueue a get/put client mix round-robin over (replica, session)
-    slots and run until every future resolves — the shared drive loop of
+    """Drive a get/put client mix through the batched public API
+    (KVS.submit_batch — array-in, futures-out) — the shared drive loop of
     scripts/kvs_scale.py and acceptance.run_sparse_variant.  ``value_of(i)``
-    supplies the payload for op i.  Returns (futures, drained,
+    supplies the payload for op i.  Returns (batch_futures, drained,
     enqueue_seconds, drive_seconds)."""
     import time
 
-    cfg = kvs.cfg
+    is_get = np.asarray(is_get, bool)
+    n = len(op_keys)
     t0 = time.perf_counter()
-    futs = []
-    for i, k in enumerate(op_keys):
-        r = i % cfg.n_replicas
-        s = (i // cfg.n_replicas) % cfg.n_sessions
-        if is_get[i]:
-            futs.append(kvs.get(r, s, int(k)))
-        else:
-            futs.append(kvs.put(r, s, int(k), value_of(i)))
+    kinds = np.where(is_get, KVS.GET, KVS.PUT).astype(np.int32)
+    u = kvs.cfg.value_words - 2
+    values = np.zeros((n, u), np.int32)
+    for i in np.nonzero(~is_get)[0]:
+        v = np.asarray(value_of(int(i)), np.int32)
+        values[i, : v.shape[0]] = v
+    bf = kvs.submit_batch(kinds, np.asarray(op_keys), values)
     enqueue_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    drained = kvs.run_until(futs, max_steps=max_steps)
-    return futs, drained, enqueue_s, time.perf_counter() - t0
+    drained = kvs.run_batch(bf, max_steps=max_steps)
+    return bf, drained, enqueue_s, time.perf_counter() - t0

@@ -1,0 +1,53 @@
+"""Bounded backend-availability probe, shared by every driver entry path.
+
+Round-2/3 lesson (BENCH_r02.json, MULTICHIP_r03.json): PJRT init against a
+wedged tunneled-TPU claim hangs indefinitely and ignores signals, so any
+process that touches the default backend first — bench.py, or a harness
+running ``entry()`` before ``dryrun_multichip`` — times out to rc=124 with
+nothing diagnosable in the tail.  The fix is to initialize the backend in a
+SUBPROCESS with a bound first; only when the probe child succeeds does the
+caller initialize its own backend.
+
+On timeout the child is ABANDONED, never killed: the pool's recorded
+failure mode is that killing a claim-queue process can leave its grant held
+pool-side (wedging the chip for an hour+), while an abandoned waiter either
+completes later and exits cleanly (releasing) or idles without blocking new
+processes (verified against a stuck claimer in round 2).
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+
+def probe_backend(timeout_s: float, cmd=None):
+    """Returns (ok, info): info is the platform name on success, else a
+    one-line diagnosis.  Skipped (trivially ok) when JAX_PLATFORMS=cpu —
+    CPU init cannot hang.  The probe child initializes the default backend,
+    prints a marker, and exits cleanly (releasing its claim); only then
+    should the caller initialize its own."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return True, "cpu"
+    if cmd is None:
+        code = ("import jax; "
+                "print('HERMES_BACKEND_OK', jax.devices()[0].platform)")
+        cmd = [sys.executable, "-c", code]
+
+    with tempfile.TemporaryFile(mode="w+") as out:
+        p = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
+                             text=True)
+        try:
+            p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return False, (
+                f"backend init did not complete within {timeout_s:.0f}s "
+                f"(TPU claim wedged or pool unreachable); probe child "
+                f"pid={p.pid} left running — do NOT kill it mid-claim")
+        out.seek(0)
+        txt = out.read()
+    if p.returncode != 0 or "HERMES_BACKEND_OK" not in txt:
+        tail = [l for l in txt.strip().splitlines() if l.strip()][-1:]
+        return False, (f"backend init failed rc={p.returncode}: "
+                       f"{tail[0] if tail else 'no output'}")
+    return True, txt.split()[-1]

@@ -68,6 +68,17 @@ def save(path: str, rt) -> None:
     np.savez_compressed(path, **arrays)
 
 
+def _leaf_keys(template, prefix=""):
+    """Archive key names a restore of ``template`` will read (mirror of
+    _flatten / _rebuild traversal)."""
+    if hasattr(template, "_asdict"):
+        out = []
+        for f, v in template._asdict().items():
+            out.extend(_leaf_keys(v, f"{prefix}{f}."))
+        return out
+    return [prefix[:-1]]
+
+
 def _rebuild(template, arrays, prefix=""):
     if hasattr(template, "_asdict"):
         kw = {
@@ -117,6 +128,22 @@ def load(path: str, rt) -> None:
                 "snapshot carries a KeyIndex (sparse-key run); build the "
                 "KVS with sparse_keys=True or the client-key mapping is lost"
             )
+    # every key the mutation phase will read must exist NOW: a truncated or
+    # corrupt archive must reject before anything is overwritten
+    state = rt.fs if hasattr(rt, "fs") else rt.rs
+    needed = _leaf_keys(state, "state.")
+    needed += ["ctl.step_idx", "ctl.epoch", "ctl.live", "ctl.frozen"]
+    if kvs is not None:
+        needed += ["kvs.op", "kvs.key", "kvs.uval"]
+        if kvs.index is not None:
+            needed += ["kvs.index.bucket_key", "kvs.index.bucket_slot",
+                       "kvs.index.rev", "kvs.index.n_used"]
+    missing = [k for k in needed if k not in z]
+    if missing:
+        raise ValueError(
+            f"snapshot archive is incomplete (truncated/corrupt?): missing "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''}"
+        )
     # -- mutate ------------------------------------------------------------
     if kvs is not None:
         kvs._op[:] = z["kvs.op"]
@@ -129,7 +156,6 @@ def load(path: str, rt) -> None:
             idx._bucket_slot[:] = z["kvs.index.bucket_slot"]
             idx._rev[:] = z["kvs.index.rev"]
             idx.n_used = int(z["kvs.index.n_used"])
-    state = rt.fs if hasattr(rt, "fs") else rt.rs
     restored = _rebuild(state, z, "state.")
     if hasattr(rt, "fs"):
         rt.fs = restored

@@ -1,0 +1,99 @@
+"""Closed-loop zipfian chaining evidence at bench-relevant scales (round-3
+verdict item 6): measure commits/round for the contended config-3 shape
+(scrambled Zipfian-0.99, 50/50 mix) under the race arbiter vs
+sort+chain_writes, at three session scales up to the full 262k-session
+bench shape (8 x 32768) — replacing the round-3 extrapolation from 8x2048
+with measurements.
+
+Usage (CPU, scrubbed env)::
+
+    env PYTHONPATH=/root/repo PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python scripts/chain_scale.py
+
+On the chip, run with the default env.  Writes CHAIN_SCALE.json and prints
+one JSON line per cell.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+SCALES = (2048, 8192, 32768)  # sessions per replica; 8 replicas
+CELLS = (("race", 0), ("sort", 0), ("sort", 128))
+
+
+def run_cell(sessions: int, arb: str, chain: int, rounds: int,
+             warmup: int) -> dict:
+    """One (scale, arbiter) cell.  ``warmup`` rounds run first and are
+    excluded: the closed loop starts with every session on a fresh
+    (mostly-distinct) key, so early rounds overstate the contended steady
+    state the evidence is about."""
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.workload import ycsb
+
+    cfg = HermesConfig(
+        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=sessions,
+        replay_slots=256, ops_per_session=256, wrap_stream=True,
+        device_stream=True, lane_budget_cfg=max(1024, (3 * sessions) // 4),
+        read_unroll=2, rebroadcast_every=4, replay_scan_every=32,
+        arb_mode=arb, chain_writes=chain,
+        workload=WorkloadConfig(read_frac=0.5, seed=0,
+                                distribution="zipfian", zipf_theta=0.99),
+    )
+    fs = jax.device_put(fst.init_fast_state(cfg))
+    stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
+    wchunk = fst.build_fast_scan(cfg, warmup, donate=True)
+    chunk = fst.build_fast_scan(cfg, rounds, donate=True)
+
+    def commits(x):
+        m = jax.device_get(x.meta)
+        return int(m.n_write.sum() + m.n_rmw.sum())
+
+    fs = wchunk(fs, stream, fst.make_fast_ctl(cfg, 0))
+    jax.block_until_ready(fs)
+    c0 = commits(fs)  # drains warmup; forces synchronous link mode
+    t0 = time.perf_counter()
+    fs = chunk(fs, stream, fst.make_fast_ctl(cfg, warmup))
+    jax.block_until_ready(fs)
+    c1 = commits(fs)
+    wall = time.perf_counter() - t0
+    return {
+        "sessions_per_replica": sessions,
+        "total_sessions": 8 * sessions,
+        "arb": arb,
+        "chain_writes": chain,
+        "rounds": rounds,
+        "commits_per_round": round((c1 - c0) / rounds, 1),
+        "writes_per_sec": round((c1 - c0) / wall, 1),
+        "round_ms": round(wall / rounds * 1e3, 2),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=40)
+    ap.add_argument("--warmup", type=int, default=60)
+    args = ap.parse_args()
+    out = []
+    for sessions in SCALES:
+        base = None
+        for arb, chain in CELLS:
+            r = run_cell(sessions, arb, chain, args.rounds, args.warmup)
+            if arb == "race":
+                base = r["commits_per_round"]
+            elif base:
+                r["vs_race"] = round(r["commits_per_round"] / base, 2)
+            out.append(r)
+            print(json.dumps(r), file=sys.stderr, flush=True)
+    with open("CHAIN_SCALE.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"cells": len(out), "file": "CHAIN_SCALE.json"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -117,3 +117,38 @@ def test_kvs_load_validates_before_mutating():
     with pytest.raises(ValueError, match="quiescent"):
         snapshot.load(p, busy)
     assert busy.run_until([fut])  # its pending op still completes
+
+
+def test_truncated_archive_rejected_before_mutation(tmp_path):
+    """Round-3 advisor: a corrupt/truncated npz (missing state.* keys) must
+    reject BEFORE anything — KVS arrays included — is overwritten."""
+    import zipfile
+
+    from hermes_tpu.kvs import KVS
+
+    cfg = HermesConfig(n_replicas=3, n_keys=64, n_sessions=8, replay_slots=4,
+                       ops_per_session=16, value_words=4,
+                       workload=WorkloadConfig(seed=63))
+    kvs = KVS(cfg)
+    kvs.run_until([kvs.put(0, 0, 3, [7])])
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, kvs)
+
+    # truncate: drop one state.* member from the zip archive
+    trunc = str(tmp_path / "trunc.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(trunc, "w") as zout:
+        victims = [n for n in zin.namelist() if n.startswith("state.")]
+        for name in zin.namelist():
+            if name != victims[0]:
+                zout.writestr(name, zin.read(name))
+
+    target = KVS(cfg)
+    before_op = target._op.copy()
+    before_key = target._key.copy()
+    try:
+        snapshot.load(trunc, target)
+        raise AssertionError("truncated archive must be rejected")
+    except ValueError as e:
+        assert "incomplete" in str(e)
+    np.testing.assert_array_equal(target._op, before_op)
+    np.testing.assert_array_equal(target._key, before_key)
