@@ -72,34 +72,53 @@ def _cfg(mix: str, over: dict | None = None):
     # scatter-min + gather, and no false collisions), so it is the bench
     # default everywhere.  Intra-round write chaining (BASELINE.md
     # "Round-3 mitigation") lifts the per-key service rate from n_replicas
-    # to n_replicas*chain_writes per round — 13.3x on the contended zipfian
-    # mix (97k -> 1.29M w/s), free on uniform — and stays off for the RMW
-    # mix (RMWs never chain).  Version burn under chaining is
-    # ~chain_writes/round for the hottest key against the ~1M packed-ts
-    # budget; the runtime's auto-rebase (config.auto_rebase) reclaims it.
+    # to n_replicas*chain_writes per round; the round-4 depth sweep
+    # (SWEEP4.json/SWEEP4B.json) showed it scaling to chain=2048 on the
+    # contended mix — 97k (race) -> 12.5M w/s, bringing zipfian to the
+    # UNIFORM mix's rate — and staying free on uniform.  Off for the RMW
+    # mix (RMWs never chain).  Version burn at depth: the hottest key
+    # burns ~chain_writes versions/round, so a 250-round zipfian bench
+    # consumes ~512k of the ~1M packed-ts budget (within one run's
+    # budget); sustained runs are reclaimed by the runtime's auto-rebase
+    # (config.auto_rebase).
     arb = dict(arb_mode="sort")
-    if mix != "rmw":
+    if mix == "a":
         arb["chain_writes"] = 128
-    arb.update(over or {})
-    return HermesConfig(
+    elif mix == "zipfian":
+        arb["chain_writes"] = 2048
+    # In-flight ops per replica + compaction budget, per mix: the round-4
+    # sweep under the sort arbiter moved the uniform optimum from
+    # (32768, 24576) to (65536, 49152) — 12.28 -> 13.19M w/s (98304 gains
+    # <1% more for 1.5x the round latency; 131072 rolls off) — while the
+    # contended mix PREFERS the smaller shape (its deep chains saturate
+    # the hot keys without more sessions; 65536 at chain 1024 measured
+    # 3.8M vs 32768's 7.6M).  SWEEP4.json / SWEEP4B.json.
+    S = 32768 if mix == "zipfian" else 65536
+    kw = dict(
         **arb,
         n_replicas=8,
         n_keys=1 << 20,  # 1M keys (BASELINE.json:7)
         value_words=8,  # 32B values, the reference's typical small-value shape
-        n_sessions=32768,  # in-flight ops per replica (tuned on-chip)
+        n_sessions=S,
         replay_slots=256,
         ops_per_session=256,
         wrap_stream=True,  # stream cycles; write uids stay unique (config.py)
         device_stream=True,  # counter-hash op stream (no stream gathers)
-        lane_budget_cfg=24576,
+        lane_budget_cfg=(3 * S) // 4,
         read_unroll=2,  # local-read drain depth (reference read batching)
         rebroadcast_every=4,
         replay_scan_every=32,
-        workload=wl,
     )
+    kw.update(over or {})
+    return HermesConfig(workload=wl, **kw)
 
 
-def run_mix(mix: str, over: dict | None = None) -> dict:
+def run_mix(mix: str, over: dict | None = None, rounds: int = ROUNDS,
+            chunks: int = CHUNKS, warmup_chunks: int = WARMUP_CHUNKS) -> dict:
+    """One measured bench cell.  This is THE cell-runner: the sweep /
+    evidence scripts (scripts/arb_compare.py, scripts/chain_scale.py,
+    scripts/sweep4.py) call it with ``over`` overriding any HermesConfig
+    field, so every artifact measures the exact shape bench.py runs."""
     from hermes_tpu.core import faststep as fst
     from hermes_tpu.stats import percentile_from_hist
     from hermes_tpu.workload import ycsb
@@ -107,27 +126,37 @@ def run_mix(mix: str, over: dict | None = None) -> dict:
     cfg = _cfg(mix, over)
     fs = jax.device_put(fst.init_fast_state(cfg))
     stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
-    chunk = fst.build_fast_scan(cfg, ROUNDS, donate=True)
+    chunk = fst.build_fast_scan(cfg, rounds, donate=True)
 
     def counters(x):
         # ONE meta fetch per poll (each device_get is a link round trip)
         m = jax.device_get(x.meta)
+        # This raw-faststep path has no FastRuntime, hence no auto-rebase:
+        # deep chaining burns ~chain_writes versions/round on the hottest
+        # key, so a run long enough to cross the packed-ts budget must
+        # fail LOUDLY here rather than silently corrupt the Lamport compare
+        max_ver = int(m.max_pts.max()) >> fst.PTS_FC_BITS
+        if max_ver >= cfg.max_key_versions:
+            raise RuntimeError(
+                f"bench run crossed the packed-ts budget (key version "
+                f"{max_ver} >= {cfg.max_key_versions}): shorten the run or "
+                f"lower chain_writes — this raw path has no auto-rebase")
         return (int(m.n_write.sum() + m.n_rmw.sum()),
                 int(m.n_abort.sum()), m.lat_hist.sum(axis=0))
 
-    for c in range(WARMUP_CHUNKS):
-        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * ROUNDS))
+    for c in range(warmup_chunks):
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * rounds))
     jax.block_until_ready(fs)
     # drains warmup; switches the link to synchronous mode
     c0, abort0, lat0 = counters(fs)
 
     t0 = time.perf_counter()
-    for c in range(WARMUP_CHUNKS, WARMUP_CHUNKS + CHUNKS):
-        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * ROUNDS))
+    for c in range(warmup_chunks, warmup_chunks + chunks):
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * rounds))
     jax.block_until_ready(fs)
     t1 = time.perf_counter()
 
-    measure = CHUNKS * ROUNDS
+    measure = chunks * rounds
     c1, abort1, lat1 = counters(fs)
     commits = c1 - c0
     wall = t1 - t0
@@ -155,7 +184,7 @@ def run_mix(mix: str, over: dict | None = None) -> dict:
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
         "replicas_on_chip": cfg.n_replicas,
-        "rounds_per_dispatch": ROUNDS,
+        "rounds_per_dispatch": rounds,
         "n_sessions": cfg.n_sessions,
         "lane_budget": cfg.lane_budget,
     }

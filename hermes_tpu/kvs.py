@@ -180,6 +180,12 @@ class KVS:
         # injection pass touches only those.
         self._kindarr = np.zeros((r, s), np.int32)
         self._ready: set = set()
+        # slots whose legacy FIFO queue is non-empty (maintained at enqueue
+        # and pop): the batch paths consult this instead of scanning every
+        # deque _queues ever defaulted — a defaultdict retains empty deques
+        # for every slot ever used, which would make those scans O(all
+        # slots touched) per step
+        self._queued_slots: set = set()
         self._dirty = True
         # batched client path (round-3 verdict item 5): active submit_batch
         # calls keyed by a stable id; per-slot (batch id, batch index) so
@@ -231,6 +237,7 @@ class KVS:
             client_key, slot = int(key), int(key)
         fut = Future()
         self._queues[(replica, session)].append((kind, slot, client_key, value, fut))
+        self._queued_slots.add((replica, session))
         if (replica, session) not in self._inflight:
             self._ready.add((replica, session))
         return fut
@@ -328,9 +335,8 @@ class KVS:
     def _inject_batches(self) -> None:
         free = self._kindarr == t.OP_NOP
         # slots with queued per-op traffic keep their FIFO promise
-        for rs_key, q in self._queues.items():
-            if q:
-                free[rs_key] = False
+        for rs_key in self._queued_slots:
+            free[rs_key] = False
         rows, cols = np.nonzero(free)
         if rows.size == 0:
             return
@@ -378,6 +384,8 @@ class KVS:
                 waiting.add(rs_key)
                 continue
             kind, slot, client_key, value, fut = q.popleft()
+            if not q:
+                self._queued_slots.discard(rs_key)
             r, s = rs_key
             self._op[r, s, 0] = self._OPC[kind]
             self._key[r, s, 0] = slot
@@ -439,8 +447,8 @@ class KVS:
             ndone += rows.size
             # freed slots with waiting per-op traffic become injectable
             # again (O(#queued slots), not O(#retired))
-            for rs_key, q in self._queues.items():
-                if q and self._slot_bid[rs_key] < 0 \
+            for rs_key in self._queued_slots:
+                if self._slot_bid[rs_key] < 0 \
                         and rs_key not in self._inflight:
                     self._ready.add(rs_key)
         for r, s in np.argwhere(done_mask & ~bdone):

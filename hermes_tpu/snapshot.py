@@ -41,10 +41,11 @@ def save(path: str, rt) -> None:
     kvs = None
     if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
         kvs, rt = rt, rt.rt
-        if kvs._inflight or any(kvs._queues.values()):
+        if kvs._inflight or any(kvs._queues.values()) or kvs._bat:
             raise ValueError(
                 "snapshot requires a quiescent KVS: resolve in-flight ops "
-                "(run step()/run_until) before saving"
+                "and active batches (run step()/run_until/run_batch) "
+                "before saving"
             )
     state = rt.fs if hasattr(rt, "fs") else rt.rs
     arrays = _flatten(state, "state.")
@@ -114,10 +115,11 @@ def load(path: str, rt) -> None:
     if kvs is not None:
         if "kvs.op" not in z:
             raise ValueError("snapshot was not taken from a KVS")
-        if kvs._inflight or any(kvs._queues.values()):
+        if kvs._inflight or any(kvs._queues.values()) or kvs._bat:
             raise ValueError(
                 "load requires a quiescent KVS target: restoring over "
-                "queued/in-flight client ops would strand their futures"
+                "queued/in-flight client ops or active batches would "
+                "strand their futures"
             )
         sparse_snap = "kvs.index.bucket_key" in z
         if kvs.index is not None and not sparse_snap:

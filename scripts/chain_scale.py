@@ -5,6 +5,12 @@ sort+chain_writes, at three session scales up to the full 262k-session
 bench shape (8 x 32768) — replacing the round-3 extrapolation from 8x2048
 with measurements.
 
+Every cell runs through ``bench.run_mix`` (the shared cell-runner) with
+shape overrides, so the evidence measures exactly what bench.py runs.  A
+warmup phase is excluded: the closed loop starts with every session on a
+fresh (mostly-distinct) key, so early rounds overstate the contended
+steady state.
+
 Usage (CPU, scrubbed env)::
 
     env PYTHONPATH=/root/repo PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -17,61 +23,30 @@ one JSON line per cell.
 import argparse
 import json
 import sys
-import time
 
-import jax
+sys.path.insert(0, ".")
+
+import bench
 
 SCALES = (2048, 8192, 32768)  # sessions per replica; 8 replicas
 CELLS = (("race", 0), ("sort", 0), ("sort", 128))
 
 
-def run_cell(sessions: int, arb: str, chain: int, rounds: int,
-             warmup: int) -> dict:
-    """One (scale, arbiter) cell.  ``warmup`` rounds run first and are
-    excluded: the closed loop starts with every session on a fresh
-    (mostly-distinct) key, so early rounds overstate the contended steady
-    state the evidence is about."""
-    from hermes_tpu.config import HermesConfig, WorkloadConfig
-    from hermes_tpu.core import faststep as fst
-    from hermes_tpu.workload import ycsb
-
-    cfg = HermesConfig(
-        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=sessions,
-        replay_slots=256, ops_per_session=256, wrap_stream=True,
-        device_stream=True, lane_budget_cfg=max(1024, (3 * sessions) // 4),
-        read_unroll=2, rebroadcast_every=4, replay_scan_every=32,
-        arb_mode=arb, chain_writes=chain,
-        workload=WorkloadConfig(read_frac=0.5, seed=0,
-                                distribution="zipfian", zipf_theta=0.99),
+def run_cell(sessions, arb, chain, rounds, warmup):
+    over = dict(n_sessions=sessions,
+                lane_budget_cfg=max(1024, (3 * sessions) // 4),
+                arb_mode=arb, chain_writes=chain)
+    r = bench.run_mix("zipfian", over=over, rounds=rounds // 2, chunks=2,
+                      warmup_chunks=max(1, warmup // (rounds // 2)))
+    rec = dict(
+        sessions_per_replica=sessions, total_sessions=8 * sessions,
+        arb=arb, chain_writes=chain, rounds=r["rounds"],
+        commits_per_round=round(r["commits"] / r["rounds"], 1),
+        writes_per_sec=r["writes_per_sec"],
+        round_ms=round(r["round_us"] / 1e3, 2), platform=r["platform"],
     )
-    fs = jax.device_put(fst.init_fast_state(cfg))
-    stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
-    wchunk = fst.build_fast_scan(cfg, warmup, donate=True)
-    chunk = fst.build_fast_scan(cfg, rounds, donate=True)
-
-    def commits(x):
-        m = jax.device_get(x.meta)
-        return int(m.n_write.sum() + m.n_rmw.sum())
-
-    fs = wchunk(fs, stream, fst.make_fast_ctl(cfg, 0))
-    jax.block_until_ready(fs)
-    c0 = commits(fs)  # drains warmup; forces synchronous link mode
-    t0 = time.perf_counter()
-    fs = chunk(fs, stream, fst.make_fast_ctl(cfg, warmup))
-    jax.block_until_ready(fs)
-    c1 = commits(fs)
-    wall = time.perf_counter() - t0
-    return {
-        "sessions_per_replica": sessions,
-        "total_sessions": 8 * sessions,
-        "arb": arb,
-        "chain_writes": chain,
-        "rounds": rounds,
-        "commits_per_round": round((c1 - c0) / rounds, 1),
-        "writes_per_sec": round((c1 - c0) / wall, 1),
-        "round_ms": round(wall / rounds * 1e3, 2),
-        "platform": jax.devices()[0].platform,
-    }
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+    return rec
 
 
 def main() -> None:
@@ -89,7 +64,6 @@ def main() -> None:
             elif base:
                 r["vs_race"] = round(r["commits_per_round"] / base, 2)
             out.append(r)
-            print(json.dumps(r), file=sys.stderr, flush=True)
     with open("CHAIN_SCALE.json", "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"cells": len(out), "file": "CHAIN_SCALE.json"}))

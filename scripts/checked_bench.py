@@ -36,6 +36,7 @@ def main() -> None:
         n_sessions=args.sessions, replay_slots=256, ops_per_session=256,
         wrap_stream=True, device_stream=True, lane_budget_cfg=24576,
         read_unroll=2, rebroadcast_every=4, replay_scan_every=32,
+        arb_mode="sort", chain_writes=128,  # the round-4 bench defaults
         workload=WorkloadConfig(read_frac=0.5, seed=0),
     )
     rt = FastRuntime(cfg, record="array")

@@ -1,0 +1,54 @@
+"""Round-4 on-chip sweep around the bench operating point UNDER THE SORT
+ARBITER (the round-4 default): the race-tuned shape (S=32768, lane=24576,
+read_unroll=2) was re-swept because the sort arbiter's cost structure
+differs (one sort + scatter vs scatter-min + gather).  Cells: (n_sessions
+x lane_budget), read_unroll at the best shape, and chain depth for the
+contended zipfian mix (version burn at deeper chains is bounded by the
+cell-runner's watermark guard; sustained runs use the runtime
+auto-rebase).
+
+Every cell runs through ``bench.run_mix`` with ``over`` shape overrides,
+so the sweep measures exactly what bench.py runs.
+
+Usage (chip, default env, ONE process):  python scripts/sweep4.py
+Prints one JSON line per cell; writes SWEEP4.json.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import bench
+
+
+def run_cell(mix="a", S=32768, C=None, ru=2, chain=128):
+    over = dict(n_sessions=S, lane_budget_cfg=C or (3 * S) // 4,
+                read_unroll=ru, arb_mode="sort", chain_writes=chain)
+    r = bench.run_mix(mix, over=over, chunks=2)
+    rec = dict(mix=mix, S=S, C=over["lane_budget_cfg"], read_unroll=ru,
+               chain=chain, wps=r["writes_per_sec"],
+               round_ms=round(r["round_us"] / 1e3, 2))
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    out = []
+    # shape sweep, uniform mix, sort+chain128
+    for S, C in ((16384, 12288), (32768, 16384), (32768, 24576),
+                 (32768, 32768), (65536, 24576), (65536, 49152)):
+        out.append(run_cell(S=S, C=C))
+    best = max(out, key=lambda r: r["wps"])
+    # read_unroll at the best shape
+    for ru in (1, 3, 4):
+        out.append(run_cell(S=best["S"], C=best["C"], ru=ru))
+    # chain depth on the contended mix
+    for ch in (64, 128, 256, 512, 1024):
+        out.append(run_cell(mix="zipfian", chain=ch))
+    with open("SWEEP4.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
