@@ -110,6 +110,10 @@ def _cfg(mix: str, over: dict | None = None):
         replay_scan_every=32,
     )
     kw.update(over or {})
+    if "lane_budget_cfg" not in (over or {}):
+        # keep the 3/4 lane-budget ratio tracking an overridden n_sessions
+        # (an explicit lane_budget_cfg override always wins)
+        kw["lane_budget_cfg"] = (3 * kw["n_sessions"]) // 4
     return HermesConfig(workload=wl, **kw)
 
 

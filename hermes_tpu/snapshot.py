@@ -41,7 +41,7 @@ def save(path: str, rt) -> None:
     kvs = None
     if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
         kvs, rt = rt, rt.rt
-        if kvs._inflight or any(kvs._queues.values()) or kvs._bat:
+        if kvs._inflight or kvs._queued_slots or kvs._bat:
             raise ValueError(
                 "snapshot requires a quiescent KVS: resolve in-flight ops "
                 "and active batches (run step()/run_until/run_batch) "
@@ -115,7 +115,7 @@ def load(path: str, rt) -> None:
     if kvs is not None:
         if "kvs.op" not in z:
             raise ValueError("snapshot was not taken from a KVS")
-        if kvs._inflight or any(kvs._queues.values()) or kvs._bat:
+        if kvs._inflight or kvs._queued_slots or kvs._bat:
             raise ValueError(
                 "load requires a quiescent KVS target: restoring over "
                 "queued/in-flight client ops or active batches would "

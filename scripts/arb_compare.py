@@ -33,6 +33,8 @@ CELLS = [
     ("zipfian", {"arb_mode": "race", "chain_writes": 0}),
     ("zipfian", {"arb_mode": "sort", "chain_writes": 0}),
     ("zipfian", {"arb_mode": "sort", "chain_writes": 128}),
+    # the round-4 production depth (bench default: zipfian chain=2048)
+    ("zipfian", {"arb_mode": "sort", "chain_writes": 2048}),
 ]
 
 

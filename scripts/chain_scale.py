@@ -36,8 +36,9 @@ def run_cell(sessions, arb, chain, rounds, warmup):
     over = dict(n_sessions=sessions,
                 lane_budget_cfg=max(1024, (3 * sessions) // 4),
                 arb_mode=arb, chain_writes=chain)
-    r = bench.run_mix("zipfian", over=over, rounds=rounds // 2, chunks=2,
-                      warmup_chunks=max(1, warmup // (rounds // 2)))
+    half = max(1, rounds // 2)  # two measured chunks of this size
+    r = bench.run_mix("zipfian", over=over, rounds=half, chunks=2,
+                      warmup_chunks=max(1, warmup // half))
     rec = dict(
         sessions_per_replica=sessions, total_sessions=8 * sessions,
         arb=arb, chain_writes=chain, rounds=r["rounds"],

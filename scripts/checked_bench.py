@@ -23,22 +23,21 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=30)
     ap.add_argument("--sessions", type=int, default=32768)
+    # --mix zipfian gates the contended config-3 path (deep production
+    # chains, bench default chain_writes=2048) under the real checker
+    ap.add_argument("--mix", choices=("a", "zipfian"), default="a")
     ap.add_argument("--out", default="CHECKED_BENCH.json")
     args = ap.parse_args()
 
     import jax
 
-    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    import bench
     from hermes_tpu.runtime import FastRuntime
 
-    cfg = HermesConfig(
-        n_replicas=8, n_keys=1 << 20, value_words=8,
-        n_sessions=args.sessions, replay_slots=256, ops_per_session=256,
-        wrap_stream=True, device_stream=True, lane_budget_cfg=24576,
-        read_unroll=2, rebroadcast_every=4, replay_scan_every=32,
-        arb_mode="sort", chain_writes=128,  # the round-4 bench defaults
-        workload=WorkloadConfig(read_frac=0.5, seed=0),
-    )
+    # the EXACT bench shape (bench._cfg is the single source of truth),
+    # at a recordable session count
+    cfg = bench._cfg(args.mix, over=dict(
+        n_sessions=args.sessions, lane_budget_cfg=(3 * args.sessions) // 4))
     rt = FastRuntime(cfg, record="array")
 
     # warm up: one round compiles + switches the tunneled link to
@@ -62,6 +61,8 @@ def main() -> None:
     n_ops = int(rt.recorder.columns()["kind"].shape[0])
 
     out = {
+        "mix": args.mix,
+        "chain_writes": cfg.chain_writes,
         "rounds": args.rounds,
         "ops_checked": n_ops,
         "writes_committed": int(counters["n_write"] + counters["n_rmw"]

@@ -49,6 +49,20 @@ def main():
     with open("SWEEP4.json", "w") as f:
         json.dump(out, f, indent=1)
 
+    # the follow-up cells that pinned the production defaults (SWEEP4B):
+    # larger uniform shapes (98304 gains <1% over 65536, 131072 rolls
+    # off), deeper contended chains (2048 is the plateau; 4096 flat), and
+    # the zipfian shape preference (bigger S measurably hurts at depth)
+    ext = []
+    for S, C in ((98304, 73728), (131072, 98304)):
+        ext.append(run_cell(S=S, C=C))
+    for ch in (2048, 4096):
+        ext.append(run_cell(mix="zipfian", chain=ch))
+    ext.append(run_cell(mix="zipfian", S=65536, C=49152, chain=1024))
+    ext.append(run_cell(mix="zipfian", S=65536, C=49152, chain=4096))
+    with open("SWEEP4B.json", "w") as f:
+        json.dump(ext, f, indent=1)
+
 
 if __name__ == "__main__":
     main()

@@ -21,7 +21,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("n_hosts,devs_per_host", [(2, 4)])
+@pytest.mark.parametrize("n_hosts,devs_per_host", [(2, 4), (4, 2)])
 def test_two_process_dcn_launch(n_hosts, devs_per_host):
     steps = 25
     port = _free_port()
