@@ -267,6 +267,10 @@ class FastRuntime:
         # the lazily-built rebase program
         self.quiesce = False
         self.rebases = 0
+        # watermark value that TRIGGERED each auto-rebase (the true
+        # pre-rebase peak — counter polls otherwise only ever see the
+        # post-rebase value at the poll where a rebase fired)
+        self.prerebase_peaks: list = []
         self._ver_base = None  # np.int64 (K,), allocated on first rebase
         self._rebase_fn = None
         self._in_rebase = False
@@ -276,6 +280,11 @@ class FastRuntime:
         # installs its own step here so drained completions are never
         # dropped on the floor
         self.comp_sink = None
+        # completion fetch per round (device->host).  At bench shape the
+        # Completions tuple is tens of MB — a telemetry-only driver (e.g.
+        # scripts/rebase_soak.py) sets this False to poll counters alone;
+        # recording/client runs need it True (the default)
+        self.fetch_completions = True
         # record: False | True (Python Op recorder) | "array" (columnar
         # recorder + native witness checker, checker/fast.py — bench scale)
         if record == "array":
@@ -379,6 +388,11 @@ class FastRuntime:
         if jax.process_count() > 1:
             assert self.recorder is None, "history recording is single-host only"
             self.step_idx += 1
+            return None
+        if not self.fetch_completions and self.recorder is None:
+            self.step_idx += 1
+            if self.membership is not None:
+                self.membership.poll(self)
             return None
         comp_np = jax.device_get(comp)
         if self._ver_base is not None:
@@ -516,6 +530,7 @@ class FastRuntime:
                 and max_ver >= max(soft, self._next_rebase_at)
                 and jax.process_count() == 1):
             self._in_rebase = True
+            self.prerebase_peaks.append(max_ver)
             try:
                 self.rebase_versions()
             finally:

@@ -1,0 +1,99 @@
+"""Sustained deep-chain soak: prove the version-rebase keeps a zipfian
+chain-2048 run alive PAST the packed-ts budget on real hardware (round-3
+verdict item 4's cliff, removed in round 4).
+
+At chain depth 2048 the hottest key burns ~2048 versions/round, so the
+~1M budget's soft watermark (rebase_fraction=0.5 -> ~512k) is crossed in
+~250 rounds — the runtime's counter-poll auto-rebase must then quiesce,
+reset settled keys to version 1, and let the run continue.  Without the
+rebase this run dies with a loud RuntimeError at ~512 rounds.
+
+Usage (chip, default env, ONE process): python scripts/rebase_soak.py
+Writes REBASE_SOAK.json: per-poll watermark trajectory + rebase count.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import bench  # noqa: E402  (repo-root import; provides _cfg + probe)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--polls", type=int, default=16)
+    ap.add_argument("--rounds-per-poll", type=int, default=50)
+    ap.add_argument("--out", default="REBASE_SOAK.json")
+    args = ap.parse_args()
+
+    ok, info = bench.probe_backend(180.0)
+    if not ok:
+        print(json.dumps({"error": info}))
+        sys.exit(1)
+
+    import jax
+
+    from hermes_tpu.runtime import FastRuntime
+
+    cfg = bench._cfg("zipfian")  # production depth: sort + chain 2048
+    rt = FastRuntime(cfg)
+    # telemetry-only run: skip the per-round completion fetch (tens of MB
+    # per round at bench shape through the tunneled link)
+    rt.fetch_completions = False
+    t0 = time.perf_counter()
+    traj = []
+    for p in range(args.polls):
+        rt.run(args.rounds_per_poll)
+        c = rt.counters()  # the poll where auto-rebase triggers
+        traj.append(dict(
+            poll=p, step=rt.step_idx, max_ver=c["max_ver"],
+            rebases=rt.rebases,
+            commits=int(c["n_write"] + c["n_rmw"]),
+        ))
+        print(json.dumps(traj[-1]), file=sys.stderr, flush=True)
+    wall = time.perf_counter() - t0
+
+    total_rounds = args.polls * args.rounds_per_poll
+    # exact era-corrected cumulative watermark: per-key reclaimed deltas +
+    # that key's CURRENT version, maxed over keys (summing the two maxima
+    # independently would overstate it when the hot key shifts)
+    import numpy as np
+
+    from hermes_tpu.core import faststep as fst
+
+    cur = np.asarray(jax.device_get(fst.pts_ver(rt.fs.table.vpts)),
+                     dtype=np.int64)
+    if rt._ver_base is not None:
+        cum = int((rt._ver_base + cur[: rt._ver_base.shape[0]]).max())
+    else:
+        cum = int(cur.max())
+    # true high-water marks: the poll-sampled values PLUS the value that
+    # triggered each rebase (the peak a poll otherwise never sees)
+    peaks = [t["max_ver"] for t in traj] + rt.prerebase_peaks
+    out = dict(
+        mix="zipfian", chain_writes=cfg.chain_writes,
+        rounds=total_rounds, wall_s=round(wall, 1),
+        rebases=rt.rebases,
+        prerebase_peaks=rt.prerebase_peaks,
+        max_ver_final=traj[-1]["max_ver"],
+        cumulative_max_ver=cum,
+        budget=cfg.max_key_versions,
+        budget_crossed=cum > cfg.max_key_versions,
+        watermark_stayed_under_budget=all(
+            v < cfg.max_key_versions for v in peaks),
+        trajectory=traj,
+        platform=jax.devices()[0].platform,
+    )
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "trajectory"}))
+    if not (out["rebases"] >= 1 and out["budget_crossed"]
+            and out["watermark_stayed_under_budget"]):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
