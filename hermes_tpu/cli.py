@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--chain-writes", type=int, default=0,
                     help="intra-round same-key write chain length (faststep "
                          "hot-key throughput; needs --arb-mode sort)")
+    ap.add_argument("--no-auto-rebase", action="store_true",
+                    help="disable the automatic version rebase at counter "
+                         "polls (restores the loud packed-ts overflow error "
+                         "as the only budget behavior)")
     ap.add_argument("--distribution", choices=["uniform", "zipfian"], default="uniform")
     ap.add_argument("--zipf-theta", type=float, default=0.99)
     ap.add_argument("--seed", type=int, default=0)
@@ -80,10 +84,12 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.chain_writes and args.arb_mode != "sort":
         ap.error("--chain-writes needs --arb-mode sort")
-    if ((args.arb_mode != "race" or args.chain_writes)
+    if ((args.arb_mode != "race" or args.chain_writes
+         or args.no_auto_rebase)
             and args.backend not in ("fast", "fast-sharded")):
-        ap.error("--arb-mode/--chain-writes only affect the fast backends "
-                 "(core/faststep.py); use --backend fast or fast-sharded")
+        ap.error("--arb-mode/--chain-writes/--no-auto-rebase only affect "
+                 "the fast backends (core/faststep.py / runtime."
+                 "FastRuntime); use --backend fast or fast-sharded")
 
     from hermes_tpu import stats as stats_lib
     from hermes_tpu.config import HermesConfig, WorkloadConfig
@@ -114,6 +120,7 @@ def main(argv=None) -> int:
         wrap_stream=args.wrap_stream,
         arb_mode=args.arb_mode,
         chain_writes=args.chain_writes,
+        auto_rebase=not args.no_auto_rebase,
         workload=WorkloadConfig(
             distribution=args.distribution,
             zipf_theta=args.zipf_theta,
