@@ -17,24 +17,24 @@ sys.path.insert(0, ".")
 import jax
 import jax.numpy as jnp
 
-from hermes_tpu.config import HermesConfig, WorkloadConfig
+import bench
 from hermes_tpu.core import faststep as fst
 from hermes_tpu.core import kernels
 from hermes_tpu.workload import ycsb
 
 jax.device_get(jnp.zeros(8) + 1)  # force synchronous (honest) mode
 
-S = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
-C = int(sys.argv[2]) if len(sys.argv) > 2 else 24576
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
 ROUNDS = int(sys.argv[3]) if len(sys.argv) > 3 else 30
 
-cfg = HermesConfig(
-    n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=S,
-    replay_slots=256, ops_per_session=256, wrap_stream=True,
-    device_stream=True, lane_budget_cfg=C, read_unroll=2,
-    rebroadcast_every=4, replay_scan_every=32,
-    workload=WorkloadConfig(read_frac=0.5, seed=0),
-)
+# the EXACT bench configuration (sort arbiter + chaining included), so
+# attributions describe the program the bench actually runs; the lane
+# budget tracks S at bench._cfg's own 3/4 ratio unless argv pins it
+over = dict(n_sessions=S)
+if len(sys.argv) > 2:
+    over["lane_budget_cfg"] = int(sys.argv[2])
+cfg = bench._cfg("a", over=over)
+C = cfg.lane_budget
 
 
 def timed(reps=3):
@@ -111,8 +111,11 @@ def _no_stats():
 
 run("no stats kernel", _no_stats)
 
-run("no compaction sort", lambda: setattr(
-    jax.lax, "sort", lambda x, dimension=-1: x))
+# patching lax.sort ablates BOTH sorts of the round under the sort
+# arbiter — the issue-arbitration sort and the lane compaction sort —
+# so the attribution is their combined cost
+run("no sorts (arbiter+compaction)", lambda: setattr(
+    jax.lax, "sort", lambda x, dimension=-1, num_keys=1: x))
 
 run("no write-value materialize", lambda: setattr(
     fst, "_write_value",
