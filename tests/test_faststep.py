@@ -717,3 +717,31 @@ def test_deep_chain_single_key_checked():
     # the chain actually formed: total versions burned on key 0 ~= commits
     assert c["max_ver"] > 64  # far beyond one-per-round serialization
     assert rt.check().ok
+
+
+def test_bench_cfg_override_contract():
+    """bench._cfg is the single cell-runner config source (sweeps, checked
+    windows, soak all build through it): any field may be overridden, and
+    the lane budget tracks an overridden session count at the 3/4 ratio
+    unless explicitly pinned."""
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location("bench", root / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    base = bench._cfg("a")
+    assert base.arb_mode == "sort" and base.n_sessions == 65536
+    assert base.lane_budget == 49152
+    z = bench._cfg("zipfian")
+    assert z.chain_writes == 2048 and z.n_sessions == 32768
+
+    o = bench._cfg("zipfian", over=dict(n_sessions=65536))
+    assert o.lane_budget == 49152  # ratio tracked the override
+    p = bench._cfg("zipfian", over=dict(n_sessions=65536,
+                                        lane_budget_cfg=1024))
+    assert p.lane_budget == 1024  # explicit pin wins
+    q = bench._cfg("a", over=dict(arb_mode="race", chain_writes=0))
+    assert q.arb_mode == "race" and q.chain_writes == 0
