@@ -231,3 +231,23 @@ def test_per_op_enqueue_waits_for_batch_owned_slot():
     assert kvs.run_until([f], 300)
     assert f.result().uid is not None
     assert kvs.rt.check().ok
+
+
+def test_submit_batch_sharded_backend():
+    """The batched client path over the sharded (tpu_ici-shaped) backend:
+    array-in futures-out works across the 8-device mesh."""
+    import jax
+    from jax.sharding import Mesh
+
+    cfg = HermesConfig(n_replicas=8, n_keys=64, n_sessions=4, value_words=6)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+    kvs = KVS(cfg, backend="sharded", mesh=mesh)
+    n = 48
+    bf = kvs.submit_batch(
+        np.full(n, KVS.PUT, np.int32), np.arange(n) % 64,
+        np.arange(2 * n, dtype=np.int32).reshape(n, 2))
+    assert kvs.run_batch(bf, 300)
+    gets = kvs.submit_batch(np.full(4, KVS.GET, np.int32),
+                            np.arange(4))
+    assert kvs.run_batch(gets, 300)
+    assert gets.all_done()
