@@ -745,3 +745,37 @@ def test_bench_cfg_override_contract():
     assert p.lane_budget == 1024  # explicit pin wins
     q = bench._cfg("a", over=dict(arb_mode="race", chain_writes=0))
     assert q.arb_mode == "race" and q.chain_writes == 0
+
+
+def test_recorder_monotone_across_multiple_rebases():
+    """The recorder's re-anchored (ver, fc) witness order must be STRICTLY
+    monotone per key across several rebase eras — the property the checker's
+    timestamp witness depends on (cross-era version reuse would alias two
+    different writes to one timestamp)."""
+    cfg = HermesConfig(
+        n_replicas=3, n_keys=16, n_sessions=16, replay_slots=4,
+        ops_per_session=64, wrap_stream=True, arb_mode="sort",
+        chain_writes=8,
+        workload=WorkloadConfig(read_frac=0.2, seed=28),
+    )
+    rt = FastRuntime(cfg, record="array")
+    import jax.numpy as jnp
+    rt.stream = rt.stream._replace(key=rt.stream.key % 2)  # two hot keys
+    for _ in range(3):
+        rt.run(15)
+        assert rt.rebase_versions() > 0
+    rt.run(10)
+    assert rt.rebases >= 3
+    cols = rt.recorder.columns()
+    writes = cols["kind"] != 0  # K_READ == 0
+    for k in np.unique(cols["key"][writes]):
+        ts = cols["ts"][writes & (cols["key"] == k)]
+        ts = np.sort(ts)
+        assert (np.diff(ts) > 0).all(), f"duplicate/regressed ts on key {k}"
+    # and the full gate agrees
+    rt.quiesce = True
+    for _ in range(100):
+        if rt._inflight_count() == 0:
+            break
+        rt.step_once()
+    assert rt.check().ok
