@@ -64,7 +64,9 @@ def run_checked(cfg, schedule, max_steps=600):
 
 
 def test_chaos_drop_dup_delay():
-    for seed in range(3):
+    # 6 seeds (round 4 doubled the sweep): each is a distinct adversarial
+    # interleaving of drops/dups/delays over the full op mix
+    for seed in range(6):
         rt = run_checked(cfg_small(30 + seed), chaotic_schedule(seed, until=300))
         c = rt.counters()
         assert c["n_write"] > 0
