@@ -144,7 +144,11 @@ def run_mix(mix: str, over: dict | None = None, rounds: int = ROUNDS,
             raise RuntimeError(
                 f"bench run crossed the packed-ts budget (key version "
                 f"{max_ver} >= {cfg.max_key_versions}): shorten the run or "
-                f"lower chain_writes — this raw path has no auto-rebase")
+                f"lower chain_writes — this raw path has no auto-rebase.  "
+                f"The guard only runs at chunk boundaries, so the chunk "
+                f"that crossed minted corrupt Lamport compares mid-chunk: "
+                f"every counter measured for THAT chunk is invalid, not "
+                f"just the post-crossing remainder")
         return (int(m.n_write.sum() + m.n_rmw.sum()),
                 int(m.n_abort.sum()), m.lat_hist.sum(axis=0))
 

@@ -99,6 +99,10 @@ class BatchFutures:
         self.value = np.zeros((n, u), np.int32)
         self.uid = np.zeros((n, 2), np.int32)
         self.found = np.ones(n, bool)
+        # completing protocol round per op (-1 while pending / for reads
+        # completed without a round) — parity with the per-op path's
+        # Completion.step, so batched callers keep step observability
+        self.step = np.full(n, -1, np.int32)
 
     def __len__(self) -> int:
         return self.code.shape[0]
@@ -117,7 +121,7 @@ class BatchFutures:
         kind = ("rmw_abort" if c == t.C_RMW_ABORT
                 else self._KINDSTR[int(self.kind[i])])
         done = Completion(kind=kind, key=int(self.key[i]),
-                          found=bool(self.found[i]))
+                          step=int(self.step[i]), found=bool(self.found[i]))
         if c in (t.C_READ, t.C_RMW) and self.found[i]:
             done.value = self.value[i].tolist()
         if c in (t.C_WRITE, t.C_RMW):
@@ -438,6 +442,7 @@ class KVS:
                 bf.code[gi] = code[rr, cc]
                 bf.value[gi] = rval[rr, cc, 2:]
                 bf.uid[gi] = wval[rr, cc, :2]
+                bf.step[gi] = self.rt.step_idx - 1
                 if b["cursor"] >= b["opc"].shape[0] and bf.all_done():
                     del self._bat[bid]
             self._op[rows, cols, 0] = t.OP_NOP

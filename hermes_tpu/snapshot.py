@@ -53,6 +53,20 @@ def save(path: str, rt) -> None:
     arrays["ctl.epoch"] = np.asarray(rt.epoch)
     arrays["ctl.live"] = np.asarray(rt.live)
     arrays["ctl.frozen"] = np.asarray(rt.frozen)
+    if hasattr(rt, "_ver_base"):
+        # FastRuntime version-rebase bookkeeping (runtime.rebase_versions):
+        # a post-rebase snapshot must carry the cumulative per-key version
+        # deltas, or completions recorded after a restore would be
+        # re-anchored from the wrong era and silently corrupt checker
+        # histories.  quiesce/rebases/_next_rebase_at ride along so the
+        # restored runtime resumes the exact rebase posture.
+        arrays["ctl.ver_base"] = (
+            np.zeros(rt.cfg.n_keys, np.int64) if rt._ver_base is None
+            else np.asarray(rt._ver_base)
+        )
+        arrays["ctl.rebases"] = np.int64(rt.rebases)
+        arrays["ctl.next_rebase_at"] = np.int64(rt._next_rebase_at)
+        arrays["ctl.quiesce"] = np.bool_(rt.quiesce)
     arrays["meta.cfg"] = np.frombuffer(
         json.dumps(dataclasses.asdict(rt.cfg)).encode(), dtype=np.uint8
     )
@@ -135,6 +149,22 @@ def load(path: str, rt) -> None:
     state = rt.fs if hasattr(rt, "fs") else rt.rs
     needed = _leaf_keys(state, "state.")
     needed += ["ctl.step_idx", "ctl.epoch", "ctl.live", "ctl.frozen"]
+    if hasattr(rt, "_ver_base") and "ctl.ver_base" not in z:
+        # pre-round-5 archive without rebase bookkeeping: only safe to
+        # restore into a runtime that never rebased (nothing to reset);
+        # otherwise the target's stale _ver_base would re-anchor restored-
+        # era completions with deltas from the wrong era
+        if rt._ver_base is not None:
+            raise ValueError(
+                "snapshot has no rebase bookkeeping (ctl.ver_base) but the "
+                "target runtime has already rebased; restoring would "
+                "re-anchor recorded versions from the wrong era — use a "
+                "fresh runtime"
+            )
+    elif hasattr(rt, "_ver_base"):
+        # archive carries rebase bookkeeping: all four entries must exist
+        # before mutation (a truncation between them must reject cleanly)
+        needed += ["ctl.rebases", "ctl.next_rebase_at", "ctl.quiesce"]
     if kvs is not None:
         needed += ["kvs.op", "kvs.key", "kvs.uval"]
         if kvs.index is not None:
@@ -167,3 +197,9 @@ def load(path: str, rt) -> None:
     rt.epoch[:] = z["ctl.epoch"]
     rt.live[:] = z["ctl.live"]
     rt.frozen[:] = z["ctl.frozen"]
+    if hasattr(rt, "_ver_base") and "ctl.ver_base" in z:
+        vb = np.asarray(z["ctl.ver_base"]).astype(np.int64)
+        rt._ver_base = vb.copy() if vb.any() else None
+        rt.rebases = int(z["ctl.rebases"])
+        rt._next_rebase_at = int(z["ctl.next_rebase_at"])
+        rt.quiesce = bool(z["ctl.quiesce"])

@@ -154,6 +154,43 @@ def test_truncated_archive_rejected_before_mutation(tmp_path):
     np.testing.assert_array_equal(target._key, before_key)
 
 
+def test_snapshot_carries_rebase_bookkeeping(tmp_path):
+    """Round-4 advisor: a post-rebase snapshot must persist the version-
+    rebase bookkeeping (_ver_base etc.) so completions recorded after a
+    restore re-anchor from the right era; a pre-round-5 archive without it
+    must refuse to land on an already-rebased target."""
+    import zipfile
+
+    import pytest
+
+    cfg = HermesConfig(n_replicas=3, n_keys=32, n_sessions=8, replay_slots=4,
+                       ops_per_session=16, wrap_stream=True,
+                       workload=WorkloadConfig(seed=66, read_frac=0.0))
+    a = FastRuntime(cfg)
+    a.run(30)
+    assert a.rebase_versions() > 0 and a._ver_base is not None
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, a)
+
+    b = FastRuntime(cfg)
+    snapshot.load(p, b)
+    assert b.rebases == a.rebases
+    assert b._next_rebase_at == a._next_rebase_at
+    np.testing.assert_array_equal(b._ver_base, a._ver_base)
+
+    # strip the bookkeeping entries to fake a pre-round-5 archive: loading
+    # it into the (already-rebased) target must raise before mutation
+    old = str(tmp_path / "old.npz")
+    drop = ("ctl.ver_base", "ctl.rebases", "ctl.next_rebase_at",
+            "ctl.quiesce")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(old, "w") as zout:
+        for name in zin.namelist():
+            if not name.startswith(drop):
+                zout.writestr(name, zin.read(name))
+    with pytest.raises(ValueError, match="rebase"):
+        snapshot.load(old, b)
+
+
 def test_sharded_snapshot_roundtrip(tmp_path):
     """Snapshot/restore over the sharded (tpu_ici-shaped) backend: the
     global device arrays flatten and rebuild with the same values, and the
