@@ -150,6 +150,14 @@ def load(path: str, rt) -> None:
     needed = _leaf_keys(state, "state.")
     needed += ["ctl.step_idx", "ctl.epoch", "ctl.live", "ctl.frozen"]
     if hasattr(rt, "_ver_base") and "ctl.ver_base" not in z:
+        if any(k in z for k in ("ctl.rebases", "ctl.next_rebase_at",
+                                "ctl.quiesce")):
+            # other bookkeeping entries present without ver_base: this is a
+            # TRUNCATED round-5 archive, not a pre-round-5 one — reject
+            raise ValueError(
+                "snapshot archive is incomplete (truncated/corrupt?): "
+                "rebase bookkeeping present but ctl.ver_base missing"
+            )
         # pre-round-5 archive without rebase bookkeeping: only safe to
         # restore into a runtime that never rebased (nothing to reset);
         # otherwise the target's stale _ver_base would re-anchor restored-

@@ -1,0 +1,249 @@
+"""The Pallas-vs-XLA table-step evidence (ARCHITECTURE.md "Why no Pallas
+kernel on the hot path").
+
+The north star's literal form is a single vmapped Pallas kernel stepping the
+key-state table.  Round 1 prototyped the candidates on the target chip and
+replaced them with XLA scatter/gather; this script IS those prototypes,
+restored in-tree (round-4 verdict weak #5) so the decision is reproducible
+on hardware at any time:
+
+  A. ``xla``     — the production formulation: packed-ts scatter-MAX into the
+                   (K,) arbiter column + one fused [pts|sst|val] int8 row
+                   set-scatter (core/faststep.py:_ts_scatter_max /
+                   _winner_row_scatter shapes).
+  B. ``serial``  — Pallas kernel, VMEM-resident table block, fori_loop over
+                   messages with dynamic-index stores (the only scatter
+                   Mosaic supports).
+  C. ``onehot``  — exact scatter as an MXU matmul: one-hot(keys) @ rows.
+                   Does O(K x M) work for O(M) payload; the sweep over K
+                   shows the amplification directly.
+  D. ``vgather`` — vectorized dynamic gather (rows = table[keys]) inside a
+                   Pallas kernel.  Mosaic rejects the lowering (reported,
+                   not timed, if it fails to compile).
+
+Round-5 re-measurement on the chip (PALLAS_PROBE.json; median-of-5 slope
+timing, see _time): the XLA pair moves 49,152 messages into the 1M-key
+bench table in ~3.7 ms (~0.076 us/msg).  The serial kernel has IMPROVED on
+the current Mosaic toolchain (round 1 measured ~10 us/msg; today a
+VMEM-block-resident loop runs ~6 ns/iteration and slightly beats XLA at
+K=4096 toy shapes) — but it cannot scale to the production table: 1M keys
+x 44 B/row = 46 MB >> ~16 MB VMEM, so a full-table serial kernel must grid
+over >= 16 table blocks and scan every unsorted message per block
+(O(nblk x M) iterations ~= 4.7+ ms before masking costs, above XLA's one
+op), or pre-sort messages by block — re-implementing exactly the routing
+XLA's scatter already does.  ``onehot`` cannot even materialize its (M, K)
+operand at bench shape (48 GB), and ``vgather`` still fails to lower
+("Cannot do int indexing on TPU").  The XLA formulation stays.
+
+Usage (TPU, default env — one process, never kill mid-claim):
+
+    python scripts/pallas_probe.py [--json PALLAS_PROBE.json]
+
+On CPU the kernels run interpret=True: functional parity only, timings
+meaningless (the cells are tagged with the platform).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+W = 10  # int32 words per table row ([pts | sst | 8 val words], bench shape)
+
+
+def _time(step, state, args, n_lo=4, n_hi=20):
+    """Per-call seconds of ``state -> step(state, *args)``, measured as the
+    SLOPE between two in-jit repetition counts — the tunneled runtime's
+    per-dispatch floor (~20 ms, see bench.py) would otherwise swamp every
+    cell.  The floor also JITTERS ~±10 ms dispatch-to-dispatch, so each
+    repetition count is timed as the median of 5 dispatches; pick
+    (n_hi - n_lo) * expected-cost well above that jitter.  ``step`` must be
+    shape-preserving in ``state``."""
+
+    def reps(n):
+        @jax.jit
+        def f(state, *args):
+            return jax.lax.fori_loop(
+                0, n, lambda i, s: step(s, *args), state)
+
+        out = f(state, *args)
+        jax.block_until_ready(out)
+        jax.device_get(jax.tree.leaves(out)[0])  # force synchronous link
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = f(state, *args)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    return (reps(n_hi) - reps(n_lo)) / (n_hi - n_lo)
+
+
+def _msgs(key, K, M):
+    kk, kp = jax.random.split(jax.random.PRNGKey(key))
+    keys = jax.random.randint(kk, (M,), 0, K, jnp.int32)
+    pts = jax.random.randint(kp, (M,), 1, 1 << 20, jnp.int32)
+    rows = jnp.tile(pts[:, None], (1, W))
+    return keys, pts, rows
+
+
+# -- A: the production XLA formulation --------------------------------------
+
+
+def _xla_step(vpts, bank, keys, pts, rows8):
+    vpts = vpts.at[keys].max(pts, mode="drop")
+    bank = bank.at[keys].set(rows8, mode="drop")
+    return vpts, bank
+
+
+def cell_xla(K, M, n_lo=200, n_hi=2000):
+    vpts = jnp.zeros((K,), jnp.int32)
+    bank = jnp.zeros((K, 4 * W), jnp.int8)
+    keys, pts, rows = _msgs(0, K, M)
+    rows8 = jax.lax.bitcast_convert_type(rows, jnp.int8).reshape(M, 4 * W)
+    dt = _time(lambda s, k, p, r: _xla_step(*s, k, p, r),
+               (vpts, bank), (keys, pts, rows8), n_lo=n_lo, n_hi=n_hi)
+    return dict(cand="xla", K=K, M=M, s_per_call=dt, us_per_msg=dt / M * 1e6)
+
+
+# -- B: serial VMEM apply (Pallas) ------------------------------------------
+
+
+def _serial_kernel(keys_ref, rows_ref, tin_ref, tout_ref):
+    # tout aliases the table input (input_output_aliases), so untouched
+    # rows keep their values; the loop applies one message per iteration —
+    # the only scatter shape Mosaic accepts (dynamic single-row stores)
+    del tin_ref
+
+    def body(i, _):
+        k = keys_ref[i]
+        tout_ref[pl.dslice(k, 1), :] = rows_ref[pl.dslice(i, 1), :]
+        return 0
+
+    jax.lax.fori_loop(0, keys_ref.shape[0], body, 0)
+
+
+def cell_serial(K, M, interpret, n_lo=100, n_hi=1000):
+    keys, _pts, rows = _msgs(1, K, M)
+    table = jnp.zeros((K, W), jnp.int32)
+
+    def f(table, keys, rows):
+        return pl.pallas_call(
+            _serial_kernel,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((M, W), lambda: (0, 0)),
+                pl.BlockSpec((K, W), lambda: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((K, W), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((K, W), jnp.int32),
+            input_output_aliases={2: 0},
+            interpret=interpret,
+        )(keys, rows, table)
+
+    dt = _time(f, table, (keys, rows), n_lo=n_lo, n_hi=n_hi)
+    return dict(cand="serial", K=K, M=M, s_per_call=dt, us_per_msg=dt / M * 1e6)
+
+
+# -- C: one-hot MXU scatter --------------------------------------------------
+
+
+def cell_onehot(K, M):
+    keys, _pts, rows = _msgs(2, K, M)
+
+    def f(acc, keys, rows):
+        onehot = (keys[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :])
+        # int8 planes keep the scatter exact through the MXU (bf16 would
+        # round); this is the cheapest exact formulation we found.  rows
+        # mixes in the carry so the loop body is not hoistable.
+        rows = rows + acc[:1, :]
+        return jax.lax.dot_general(
+            onehot.astype(jnp.int8), rows.astype(jnp.int8),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+    acc = jnp.zeros((K, W), jnp.int32)
+    dt = _time(f, acc, (keys, rows), n_lo=200, n_hi=2000)
+    return dict(cand="onehot", K=K, M=M, s_per_call=dt, us_per_msg=dt / M * 1e6,
+                flops_amplification=K)
+
+
+# -- D: vectorized dynamic gather inside Pallas ------------------------------
+
+
+def _vgather_kernel(keys_ref, table_ref, out_ref):
+    out_ref[:] = table_ref[keys_ref[:], :]
+
+
+def cell_vgather(K, M, interpret):
+    keys, _pts, _rows = _msgs(3, K, M)
+    table = jnp.ones((K, W), jnp.int32)
+
+    def f(keys, table):
+        out = pl.pallas_call(
+            _vgather_kernel,
+            out_shape=jax.ShapeDtypeStruct((M, W), jnp.int32),
+            interpret=interpret,
+        )(keys, table)
+        return out[:, 0] & (K - 1)  # feed back as next keys (no hoisting)
+
+    try:
+        dt = _time(f, keys, (table,), n_lo=40, n_hi=200)
+        return dict(cand="vgather", K=K, M=M, s_per_call=dt,
+                    us_per_msg=dt / M * 1e6, compiled=True)
+    except Exception as e:  # Mosaic lowering rejection is the expected result
+        first = str(e).strip().splitlines()
+        return dict(cand="vgather", K=K, M=M, compiled=False,
+                    error=(first[0] if first else type(e).__name__)[:300])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    platform = jax.devices()[0].platform
+    interpret = platform != "tpu"
+    cells = []
+
+    # A vs B at the VMEM-resident block shape the serial kernel needs
+    # (K=4096 x 10 words fits VMEM); then A alone at the bench table shape.
+    for K, M in ((4096, 4096),):
+        cells.append(cell_xla(K, M))
+        try:
+            cells.append(cell_serial(K, M, interpret))
+        except Exception as e:
+            cells.append(dict(cand="serial", K=K, M=M, compiled=False,
+                              error=str(e).strip().splitlines()[0][:300]))
+    cells.append(cell_xla(1 << 20, 49152))  # production shape (bench lanes)
+
+    # C: the K-sweep shows the O(K) amplification
+    for K in (1024, 4096, 16384):
+        cells.append(cell_onehot(K, 4096))
+
+    cells.append(cell_vgather(4096, 4096, interpret))
+
+    out = dict(platform=platform,
+               device=getattr(jax.devices()[0], "device_kind", "?"),
+               interpret=interpret, cells=cells)
+    for c in cells:
+        print(json.dumps(c), file=sys.stderr)
+    print(json.dumps({k: v for k, v in out.items() if k != "cells"}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
