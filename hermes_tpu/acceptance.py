@@ -38,9 +38,15 @@ def _cfg(n: int, scale: float) -> HermesConfig:
     )
     if n == 1:
         return HermesConfig(n_replicas=3, workload=WorkloadConfig(read_frac=0.5, seed=1), **base)
-    if n == 2:
+    if n in (2, "2r"):
+        # 2 is the judged gate exactly as BASELINE.json:8 frames it (RMW
+        # conflicts abort, reference semantics); "2r" is the SAME scenario
+        # under round-5 retry-in-place (config.rmw_retries) — nacked RMWs
+        # re-read and re-issue instead of surfacing aborts, so contention
+        # work converts to commits.  Additional variant, not a replacement.
+        retr = dict(rmw_retries=16) if n == "2r" else {}
         return HermesConfig(
-            n_replicas=5,
+            n_replicas=5, **retr,
             workload=WorkloadConfig(read_frac=0.3, rmw_frac=1.0, seed=2), **base,
         )
     if n in (3, "3c"):
@@ -59,7 +65,7 @@ def _cfg(n: int, scale: float) -> HermesConfig:
         )
     if n in (4, 5):
         return HermesConfig(n_replicas=8, workload=WorkloadConfig(read_frac=0.5, seed=n), **base)
-    raise ValueError(f"config {n} not in 1..5")
+    raise ValueError(f"config {n} not in 1..5 / '2r' / '3c'")
 
 
 def run_config(n: int, scale: float = 0.01, max_steps: int = 5000,

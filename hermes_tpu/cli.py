@@ -41,8 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--wrap-stream", action="store_true",
                     help="cycle op streams forever (bench mode; use --steps)")
     ap.add_argument("--acceptance", default=None,
-                    choices=["1", "2", "3", "4", "5", "all"],
-                    help="run BASELINE acceptance config N (1-5) or all; "
+                    choices=["1", "2", "2r", "3", "3c", "4", "5", "all"],
+                    help="run BASELINE acceptance config N (1-5, or the 2r/3c"
+                    " variants) or all; "
                     "ignores most other flags")
     ap.add_argument("--scale", type=float, default=0.01,
                     help="acceptance size scale (1.0 = full 1M-key shape)")
@@ -98,7 +99,9 @@ def main(argv=None) -> int:
     if args.acceptance:
         from hermes_tpu import acceptance
 
-        which = range(1, 6) if args.acceptance == "all" else [int(args.acceptance)]
+        which = ([1, 2, "2r", 3, "3c", 4, 5] if args.acceptance == "all"
+                 else [args.acceptance if args.acceptance in ("2r", "3c")
+                       else int(args.acceptance)])
         rc = 0
         for n in which:
             counters, verdict = acceptance.run_config(

@@ -222,6 +222,7 @@ class FastSess(NamedTuple):
     acks: jnp.ndarray  # gathered-ack replica bitmap
     rd_val: jnp.ndarray  # (R, S, 4V) int8
     invoke_step: jnp.ndarray
+    retries: jnp.ndarray  # RMW retry-in-place count (config.rmw_retries)
 
 
 class FastReplay(NamedTuple):
@@ -336,7 +337,7 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
         sess=FastSess(
             status=z(r, s), op=z(r, s), op_idx=z(r, s), key=z(r, s),
             val=z8(r, s, 4 * v), pts=z(r, s), acks=z(r, s),
-            rd_val=z8(r, s, 4 * v), invoke_step=z(r, s),
+            rd_val=z8(r, s, 4 * v), invoke_step=z(r, s), retries=z(r, s),
         ),
         replay=FastReplay(
             active=jnp.zeros((r, rs), jnp.bool_), key=z(r, rs), pts=z(r, rs),
@@ -999,14 +1000,26 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     infl = sess.status == t.S_INFL
     sacks = jnp.where(infl, sess.acks | gained[:, :S], sess.acks)
     covered = ((sacks | ~live) & full) == full
-    abort = infl & nacked[:, :S] & (sess.op == t.OP_RMW) & ~frozen
+    # RMW nack: the pending RMW's ts lost arbitration to a concurrent
+    # higher-ts update.  With cfg.rmw_retries the session retries in place
+    # (back to S_ISSUE with op/key/value/uid and invoke_step intact — the
+    # nacked ts is globally dead, so nothing leaks between attempts); only
+    # the final failure aborts.  Plain writes ignore nacks and commit by ts
+    # order, as always.
+    nack_rmw = infl & nacked[:, :S] & (sess.op == t.OP_RMW) & ~frozen
+    if cfg.rmw_retries > 0:
+        retry = nack_rmw & (sess.retries < cfg.rmw_retries)
+        abort = nack_rmw & ~retry
+    else:
+        retry = None
+        abort = nack_rmw
     # Commit requires having BROADCAST this round: the slot-aligned VAL (see
     # below) can only notify followers through a slot this lane holds.  A
     # lane whose quorum is completed by a membership change (live_mask
     # shrink) while it is in rebroadcast backoff simply commits at its next
     # broadcast round instead — acks persist in the bitmap, so nothing is
     # lost, and the VAL is never silently dropped.
-    commit = infl & covered & taken_lane[:, :S] & ~frozen & ~abort
+    commit = infl & covered & taken_lane[:, :S] & ~frozen & ~nack_rmw
 
     # Replay-slot release: a slot whose key's shared arbiter moved past the
     # slot's ts was taken over by a newer write — that writer's VAL will
@@ -1064,10 +1077,18 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     )
 
     done = commit | abort
+    status = jnp.where(done, t.S_IDLE, sess.status)
+    new_retries = sess.retries
+    if retry is not None:  # static: rmw_retries=0 compiles the old program
+        status = jnp.where(retry, t.S_ISSUE, status)  # disjoint from done
+        new_retries = jnp.where(done, 0,
+                                jnp.where(retry, sess.retries + 1,
+                                          sess.retries))
     sess = sess._replace(
         acks=sacks,
-        status=jnp.where(done, t.S_IDLE, sess.status),
+        status=status,
         op_idx=jnp.where(done, sess.op_idx + 1, sess.op_idx),
+        retries=new_retries,
     )
     fs = fs._replace(table=table, sess=sess, replay=replay, meta=meta)
     return fs, commit_lane, comp

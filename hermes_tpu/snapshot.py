@@ -150,10 +150,14 @@ def load(path: str, rt) -> None:
     needed = _leaf_keys(state, "state.")
     needed += ["ctl.step_idx", "ctl.epoch", "ctl.live", "ctl.frozen"]
     if hasattr(rt, "_ver_base") and "ctl.ver_base" not in z:
+        # Backstop, not a live migration path: genuinely old (pre-round-5)
+        # archives already fail the config-equality check above (the config
+        # dataclass gained fields), so an archive reaching here without
+        # ctl.ver_base is either truncated or hand-edited.
         if any(k in z for k in ("ctl.rebases", "ctl.next_rebase_at",
                                 "ctl.quiesce")):
-            # other bookkeeping entries present without ver_base: this is a
-            # TRUNCATED round-5 archive, not a pre-round-5 one — reject
+            # other bookkeeping entries present without ver_base: a
+            # TRUNCATED round-5 archive — reject
             raise ValueError(
                 "snapshot archive is incomplete (truncated/corrupt?): "
                 "rebase bookkeeping present but ctl.ver_base missing"

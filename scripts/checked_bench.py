@@ -24,8 +24,9 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=30)
     ap.add_argument("--sessions", type=int, default=32768)
     # --mix zipfian gates the contended config-3 path (deep production
-    # chains, bench default chain_writes=2048) under the real checker
-    ap.add_argument("--mix", choices=("a", "zipfian"), default="a")
+    # chains, bench default chain_writes=2048) under the real checker;
+    # --mix rmw gates the round-5 retry-in-place RMW path the same way
+    ap.add_argument("--mix", choices=("a", "rmw", "zipfian"), default="a")
     ap.add_argument("--out", default="CHECKED_BENCH.json")
     args = ap.parse_args()
 
@@ -63,7 +64,9 @@ def main() -> None:
     out = {
         "mix": args.mix,
         "chain_writes": cfg.chain_writes,
+        "rmw_retries": cfg.rmw_retries,
         "rounds": args.rounds,
+        "aborts": int(counters["n_abort"] - c_warm["n_abort"]),
         "ops_checked": n_ops,
         "writes_committed": int(counters["n_write"] + counters["n_rmw"]
                                 - c_warm["n_write"] - c_warm["n_rmw"]),

@@ -25,8 +25,9 @@ def main() -> None:
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--max-steps", type=int, default=20000)
     ap.add_argument("--out", default="ACCEPTANCE_FULL.json")
-    ap.add_argument("--configs", default="1,2,3,3c,4,5,s",
-                    help="comma list of 1..5, '3c' (config 3 under the "
+    ap.add_argument("--configs", default="1,2,2r,3,3c,4,5,s",
+                    help="comma list of 1..5, '2r' (config 2 under RMW "
+                         "retry-in-place), '3c' (config 3 under the "
                          "sort+chain hot-key mitigation) and 's' (the "
                          "sparse-key client-KVS variant of config 1)")
     ap.add_argument("--check-keys", type=int, default=0,
@@ -39,9 +40,9 @@ def main() -> None:
     from hermes_tpu import acceptance
 
     toks = [x.strip() for x in args.configs.split(",")]
-    bad = [x for x in toks if x not in ("1", "2", "3", "3c", "4", "5", "s")]
+    bad = [x for x in toks if x not in ("1", "2", "2r", "3", "3c", "4", "5", "s")]
     if bad:  # reject upfront — never discard hours of completed runs
-        ap.error(f"--configs tokens must be 1..5, '3c' or 's'; got {bad}")
+        ap.error(f"--configs tokens must be 1..5, '2r', '3c' or 's'; got {bad}")
 
     results = {}
     for tok in toks:
@@ -54,7 +55,7 @@ def main() -> None:
             )
         else:
             counters, verdict = acceptance.run_config(
-                tok if tok == "3c" else int(tok),
+                tok if tok in ("2r", "3c") else int(tok),
                 scale=args.scale, max_steps=args.max_steps,
                 check_keys=args.check_keys or None,
                 log=lambda s: print(f"  {s}", file=sys.stderr),

@@ -10,13 +10,13 @@ import pytest
 from hermes_tpu import acceptance
 
 
-@pytest.mark.parametrize("n", [1, 2, 3, "3c", 4, 5])
+@pytest.mark.parametrize("n", [1, 2, "2r", 3, "3c", 4, 5])
 def test_acceptance_config(n):
     counters, verdict = acceptance.run_config(n, scale=0.004, max_steps=4000)
     assert counters["drained"], counters
     assert verdict.ok, (verdict.failures[:2], verdict.undecided[:2])
     assert counters["n_write"] + counters["n_rmw"] > 0
-    if n == 2:
+    if n in (2, "2r"):
         assert counters["n_rmw"] > 0
 
 
