@@ -192,7 +192,13 @@ def _bank_to_i32(rows8):
     arithmetic: pure slice+elementwise, which XLA fuses into the consumer.
     (bitcast_convert_type forces a byte-plane relayout COPY of the whole
     array — measured 13 MB/round at bench shape — so it is banned from the
-    hot path; this formulation defines the byte order everywhere.)"""
+    hot path; this formulation defines the byte order everywhere.  Census
+    note: the strided access lowers to a GATHER whose iota indices carry
+    ``indices_are_sorted=true`` — XLA fuses it like a slice; a reshape+
+    static-index form that lowers to true slices was A/B-measured ~3%
+    SLOWER on-chip at bench shape, so the strided form stays and the op
+    census classifies gathers by the sorted-indices attribute,
+    scripts/sharded_census.py.)"""
     u = rows8.astype(jnp.uint8).astype(jnp.uint32)
     w = (u[..., 0::4] | (u[..., 1::4] << 8)
          | (u[..., 2::4] << 16) | (u[..., 3::4] << 24))
@@ -236,20 +242,38 @@ class FastReplay(NamedTuple):
 
 
 class FastInv(NamedTuple):
-    """Compacted INV block.  Outbound (R, C, ...); inbound (R, Rsrc, C, ...).
-    ``pkf`` packs (valid-bit << 30) | (fresh-bit << 29) | key: the fresh bit
-    marks first-broadcast slots (a NEW timestamp — unique per (key, ts),
-    since only the issuing session ever broadcasts a ts for the first
-    time); re-broadcast slots carry a ts whose row the table already holds.
-    _apply_commit uses fresh to keep its one set-scatter free of conflicting
-    duplicate rows.  ``epoch``/``alive`` are per-block scalars (a replica's
-    whole batch shares one epoch — SURVEY.md §1 L4)."""
+    """Compacted INV block as ONE byte tensor: ``rows8`` (..., C, 8+4V)
+    int8 holds the bytes of [pkf | pts | val] per slot.  Outbound
+    (R, C, 8+4V); inbound (R, Rsrc, C, 8+4V).  ``pkf`` packs
+    (valid-bit << 30) | (fresh-bit << 29) | key: the fresh bit marks
+    first-broadcast slots (a NEW timestamp — unique per (key, ts), since
+    only the issuing session ever broadcasts a ts for the first time);
+    re-broadcast slots carry a ts whose row the table already holds.
+    _apply_commit uses fresh to keep its one set-scatter free of
+    conflicting duplicate rows.  ``epoch``/``alive`` are per-block scalars
+    (a replica's whole batch shares one epoch — SURVEY.md §1 L4).
 
-    pkf: jnp.ndarray  # (valid << 30) | (fresh << 29) | key
-    pts: jnp.ndarray
-    val: jnp.ndarray  # (..., C, 4V) int8 byte payload
+    One tensor instead of three (round-5, SHARDED_CENSUS.json): the
+    lane->slot compaction costs ONE take_along (was 3 — each ~1.3-2.4 ms of
+    size-independent sparse-op overhead on this chip) and the wire moves
+    ONE all_gather operand (was 3); the field views below are dense
+    slice+elementwise, which XLA fuses into the consumers."""
+
+    rows8: jnp.ndarray  # (..., C, 8+4V) int8 bytes of [pkf | pts | val]
     epoch: jnp.ndarray  # (R,) / (R, Rsrc)
     alive: jnp.ndarray
+
+    @property
+    def pkf(self):
+        return _bank_to_i32(self.rows8[..., 0:4])[..., 0]
+
+    @property
+    def pts(self):
+        return _bank_to_i32(self.rows8[..., 4:8])[..., 0]
+
+    @property
+    def val(self):
+        return self.rows8[..., 8:]
 
     @property
     def valid(self):
@@ -278,15 +302,24 @@ class LaneBlock(NamedTuple):
 
 
 class FastAck(NamedTuple):
-    """ACK block, slot-aligned with the acked INV block.  ``pkf`` packs
+    """ACK block, slot-aligned with the acked INV block, as ONE byte tensor
+    ``rows8`` (..., C, 8) int8 = bytes of [pkf | pts].  ``pkf`` packs
     (key << 2) | (ok << 1) | valid into one word — the echoed key plus the
     conflict flag (ok=False: the INV lost to a higher ts — the RMW nack);
     ``pts`` echoes the acked timestamp.  The echo guarantees a delayed or
-    stale ack can never mis-credit a different pending update."""
+    stale ack can never mis-credit a different pending update.  One tensor
+    means one all_to_all on the wire (round-5; was 2)."""
 
-    pkf: jnp.ndarray  # (R, Rdst, C) outbound / (R, Rsrc, C) inbound
-    pts: jnp.ndarray
+    rows8: jnp.ndarray  # (R, Rdst, C, 8) outbound / (R, Rsrc, C, 8) inbound
     epoch: jnp.ndarray  # (R,) / (R, Rsrc)
+
+    @property
+    def pkf(self):
+        return _bank_to_i32(self.rows8[..., 0:4])[..., 0]
+
+    @property
+    def pts(self):
+        return _bank_to_i32(self.rows8[..., 4:8])[..., 0]
 
 
 class FastVal(NamedTuple):
@@ -744,21 +777,22 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
 
 def _compact_out_inv(ctl: FastCtl, lanes: "LaneBlock", slot_lane, taken_lane):
     """Lane block -> wire-shaped INV block (the C-slot broadcast batch,
-    SURVEY.md §1 L1).  Only the sharded path pays these take_alongs: the
+    SURVEY.md §1 L1).  Only the sharded path pays this take_along: the
     batched emulation scatters straight from the lane arrays
-    (fast_round_batched) — each take_along here costs ~1.5-2 ms of nearly
+    (fast_round_batched) — each take_along costs ~1.3-2.4 ms of nearly
     size-independent sparse-op overhead on the target runtime, so routing
     lanes->slots->table was measured strictly worse than lanes->table when
-    no physical wire exists."""
+    no physical wire exists.  The [pkf | pts | val] bytes ride ONE packed
+    tensor, so the whole compaction is ONE take_along (round-5; was 3)."""
     lane_pkf = (
         lanes.key
         | jnp.where(lanes.fresh, INV_FRESH, 0)
         | jnp.where(taken_lane, INV_VALID, 0)
     )
+    head8 = _i32_to_bank(jnp.stack([lane_pkf, lanes.pts], axis=-1))
+    rows8 = jnp.concatenate([head8, lanes.val], axis=-1)  # (R, L, 8+4V)
     return FastInv(
-        pkf=jnp.take_along_axis(lane_pkf, slot_lane, axis=1),
-        pts=jnp.take_along_axis(lanes.pts, slot_lane, axis=1),
-        val=jnp.take_along_axis(lanes.val, slot_lane[..., None], axis=1),
+        rows8=jnp.take_along_axis(rows8, slot_lane[..., None], axis=1),
         epoch=ctl.epoch,
         alive=~ctl.frozen,
     )
@@ -945,7 +979,8 @@ def _wire_acks(cfg: HermesConfig, ctl: FastCtl, inv_src: FastInv, ack_flags,
     )
     pkf = ((inv_src.key << 2) | (ack_flags.astype(jnp.int32) << 1)
            | ok.astype(jnp.int32))
-    out_ack = FastAck(pkf=pkf[None], pts=inv_src.pts[None], epoch=ctl.epoch)
+    ack8 = _i32_to_bank(jnp.stack([pkf, inv_src.pts], axis=-1))
+    out_ack = FastAck(rows8=ack8[None], epoch=ctl.epoch)
     in_ack = exchange_ack(out_ack)  # (1, Rsrc, C): each source's ack of MY slots
     Rs = in_ack.pkf.shape[1]
     epoch_ok = (in_ack.epoch == ctl.epoch[:, None])[..., None]
