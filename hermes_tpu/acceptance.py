@@ -129,6 +129,7 @@ def run_config(n: int, scale: float = 0.01, max_steps: int = 5000,
 def run_sparse_variant(scale: float = 0.01, ops: Optional[int] = None,
                        max_steps: int = 50_000,
                        check_keys: Optional[int] = None,
+                       backend: str = "batched", mesh=None,
                        log: Optional[Callable[[str], None]] = None
                        ) -> Tuple[Dict, object]:
     """Config-1-shaped YCSB-A through the CLIENT KVS in sparse-key mode
@@ -153,7 +154,8 @@ def run_sparse_variant(scale: float = 0.01, ops: Optional[int] = None,
     )
     from hermes_tpu.checker.fast import default_record
 
-    kvs = KVS(cfg, record=default_record(), sparse_keys=True)
+    kvs = KVS(cfg, backend=backend, mesh=mesh, record=default_record(),
+              sparse_keys=True)
     rng = np.random.default_rng(1)
     # odd-constant multiply mod 2^64 is a bijection: `keys` DISTINCT
     # arbitrary-looking 64-bit client ids.  The reserved all-ones bucket
