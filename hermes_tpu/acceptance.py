@@ -130,6 +130,7 @@ def run_sparse_variant(scale: float = 0.01, ops: Optional[int] = None,
                        max_steps: int = 50_000,
                        check_keys: Optional[int] = None,
                        backend: str = "batched", mesh=None,
+                       n_replicas: int = 3,
                        log: Optional[Callable[[str], None]] = None
                        ) -> Tuple[Dict, object]:
     """Config-1-shaped YCSB-A through the CLIENT KVS in sparse-key mode
@@ -148,7 +149,7 @@ def run_sparse_variant(scale: float = 0.01, ops: Optional[int] = None,
     keys = _sz(1 << 20, scale, lo=64)
     sessions = _sz(1024, scale, lo=8)
     cfg = HermesConfig(
-        n_replicas=3, n_keys=keys, n_sessions=sessions,
+        n_replicas=n_replicas, n_keys=keys, n_sessions=sessions,
         replay_slots=max(8, min(sessions // 2, 64)), value_words=8,
         workload=WorkloadConfig(read_frac=0.5, seed=1),
     )

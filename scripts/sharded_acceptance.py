@@ -59,12 +59,12 @@ def main() -> None:
     for tok in toks:
         t0 = time.perf_counter()
         if tok == "s":
-            n_rep = 3
+            n_rep = 3  # single source: passed to both the mesh and the cfg
             mesh = Mesh(np.array(devs[:n_rep]), ("replica",))
             counters, verdict = acceptance.run_sparse_variant(
                 scale=args.scale, max_steps=args.max_steps,
                 check_keys=args.check_keys or None,
-                backend="sharded", mesh=mesh,
+                backend="sharded", mesh=mesh, n_replicas=n_rep,
                 log=lambda s: print(f"  {s}", file=sys.stderr),
             )
         else:
