@@ -38,10 +38,13 @@ import time
 
 sys.path.insert(0, ".")
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8")
+if "--tpu-r1" not in sys.argv:
+    # census + CPU-mesh ratio need 8 virtual devices, never the chip;
+    # --tpu-r1 (the on-chip routing-delta cell) keeps the default env
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +61,12 @@ SPARSE = ("stablehlo.gather", "stablehlo.scatter", "stablehlo.sort",
           "stablehlo.dynamic_gather")
 COLLECTIVE = ("stablehlo.all_gather", "stablehlo.all_to_all",
               "stablehlo.collective_permute", "stablehlo.all_reduce")
+
+# ARCHITECTURE.md cost model (round-2, measured): ~1.3-2.4 ms per dynamic
+# sparse op.  The --tpu-r1 cell exists to test this pricing at wire shapes;
+# single source here so the projection and the measured-vs-model cell
+# cannot disagree.
+COST_LO, COST_MID, COST_HI = 1.3, 1.8, 2.4
 
 
 def bench_cfg():
@@ -102,9 +111,52 @@ def census(cfg, backend: str, mesh=None) -> dict:
     return out
 
 
+def _prep_backend(cfg, mesh, backend: str, rounds: int):
+    """Build the scan chunk + placed state for one backend (shared by the
+    CPU-mesh ratio and the on-chip R=1 cell, so the two cells cannot
+    drift in setup)."""
+    if backend == "batched":
+        chunk = fst.build_fast_scan(cfg, rounds, donate=True)
+        fs = jax.device_put(fst.init_fast_state(cfg))
+        stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
+    else:
+        chunk = fst.build_fast_sharded(cfg, mesh, rounds=rounds, donate=True)
+        fs = fst.init_fast_state(cfg, n_local=cfg.n_replicas)
+        stream = fst.prep_stream(ycsb.stub_stream(cfg))
+        fs, stream = fst.place_fast_sharded(cfg, mesh, fs, stream)
+    return chunk, fs, stream
+
+
+def _chunk_wall(cfg, mesh, backend: str, rounds: int, reps: int) -> float:
+    """Median wall seconds of one `rounds`-round chunk dispatch (synced
+    per rep)."""
+    chunk, fs, stream = _prep_backend(cfg, mesh, backend, rounds)
+    fs = chunk(fs, stream, fst.make_fast_ctl(cfg, 0))
+    jax.block_until_ready(fs)
+    jax.device_get(jax.tree.leaves(fs)[0].ravel()[:1])  # sync link mode
+    ts = []
+    for c in range(1, 1 + reps):
+        t0 = time.perf_counter()
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * rounds))
+        jax.block_until_ready(fs)
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _slope_ms_per_round(cfg, mesh, backend: str, n_lo=10, n_hi=60,
+                        reps=5) -> float:
+    """ms/round as the slope between two chunk sizes — the per-dispatch
+    host handshake (and its jitter) cancels, same method as
+    bench.run_latency's device_round_us."""
+    t_lo = _chunk_wall(cfg, mesh, backend, n_lo, reps)
+    t_hi = _chunk_wall(cfg, mesh, backend, n_hi, reps)
+    return (t_hi - t_lo) / (n_hi - n_lo) * 1e3
+
+
 def measured_ratio(rounds=20, reps=3) -> dict:
     """ms/round of batched vs sharded scan chunks on the 8-CPU mesh at a
-    CPU-tractable fixed shape (same cfg, same seed, same rounds)."""
+    CPU-tractable fixed shape (same cfg, same seed, same rounds).  CPU
+    dispatch overhead is negligible, so plain per-chunk timing suffices."""
     cfg = HermesConfig(
         n_replicas=8, n_keys=1 << 16, value_words=8, n_sessions=2048,
         replay_slots=64, ops_per_session=64, wrap_stream=True,
@@ -114,28 +166,8 @@ def measured_ratio(rounds=20, reps=3) -> dict:
         workload=WorkloadConfig(read_frac=0.5, seed=0),
     )
     mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
-
-    def time_backend(backend: str) -> float:
-        if backend == "batched":
-            chunk = fst.build_fast_scan(cfg, rounds, donate=True)
-            fs = jax.device_put(fst.init_fast_state(cfg))
-            stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)))
-        else:
-            chunk = fst.build_fast_sharded(cfg, mesh, rounds=rounds,
-                                           donate=True)
-            fs = fst.init_fast_state(cfg, n_local=cfg.n_replicas)
-            stream = fst.prep_stream(ycsb.stub_stream(cfg))
-            fs, stream = fst.place_fast_sharded(cfg, mesh, fs, stream)
-        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, 0))
-        jax.block_until_ready(fs)
-        t0 = time.perf_counter()
-        for c in range(1, 1 + reps):
-            fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * rounds))
-        jax.block_until_ready(fs)
-        return (time.perf_counter() - t0) / reps / rounds * 1e3
-
-    t_b = time_backend("batched")
-    t_s = time_backend("sharded")
+    t_b = _chunk_wall(cfg, mesh, "batched", rounds, reps) / rounds * 1e3
+    t_s = _chunk_wall(cfg, mesh, "sharded", rounds, reps) / rounds * 1e3
     return dict(shape=dict(n_keys=cfg.n_keys, n_sessions=cfg.n_sessions,
                            lane_budget=cfg.lane_budget, rounds=rounds),
                 batched_ms_per_round=round(t_b, 2),
@@ -159,9 +191,7 @@ def projection(cen_b: dict, cen_s: dict) -> dict:
     except Exception:
         round_ms, wps = 28.6, 13.68e6  # round-4 recorded values
     d_sparse = cen_s["sparse_total"] - cen_b["sparse_total"]
-    # ARCHITECTURE.md cost model: each sparse op ~1.3-2.4 ms nearly
-    # size-independent on this chip, inside scan included
-    lo, mid, hi = 1.3, 1.8, 2.4
+    lo, mid, hi = COST_LO, COST_MID, COST_HI
     # ICI bytes per chip per round: INV block (pkf+pts 8 B + val 4V B) and
     # VAL bits gathered from the other R-1 chips; ack words exchanged
     # all_to_all (pkf+pts 8 B) with R-1 peers
@@ -194,7 +224,62 @@ def projection(cen_b: dict, cen_s: dict) -> dict:
     )
 
 
+def tpu_r1_delta() -> dict:
+    """Measure the sharded round's wire-routing overhead ON the real chip
+    at a 1-replica mesh, via chunk-size slope (handshake cancelled,
+    median-of-5 per size — the same method as bench.run_latency).
+
+    Scope, stated honestly: at R=1 the collectives degenerate, and the
+    routing ops whose extent is per-DESTINATION — the lane->slot wire
+    compaction take_along (C slots × the full 48 B row), the VAL slot
+    take_along, the slot->lane ack scatter — run at the true bench slot
+    count; but the SOURCE-shaped extents (the per-slot post-arbiter
+    gather and the ack-match tensor, (Rsrc, C)) are 8× smaller than at
+    bench R=8.  A ~0 delta here therefore bounds the destination-shaped
+    routing cost only; the source-shaped remainder stays model-priced in
+    the projection bracket.  Run under the default TPU env
+    (`python scripts/sharded_census.py --tpu-r1`)."""
+    import bench as bench_mod
+
+    cfg = bench_mod._cfg("a", over=dict(n_replicas=1))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("replica",))
+    t_b = _slope_ms_per_round(cfg, mesh, "batched")
+    t_s = _slope_ms_per_round(cfg, mesh, "sharded")
+    d_sparse = None
+    try:
+        with open("SHARDED_CENSUS.json") as f:
+            cen = json.load(f)["census"]
+        d_sparse = (cen["sharded"]["sparse_total"]
+                    - cen["batched"]["sparse_total"])
+    except Exception:
+        pass
+    return dict(shape=dict(n_replicas=1, n_sessions=cfg.n_sessions,
+                           lane_budget=cfg.lane_budget),
+                platform=jax.devices()[0].platform,
+                method="slope between 10- and 60-round chunks, median-of-5",
+                batched_ms_per_round=round(t_b, 2),
+                sharded_ms_per_round=round(t_s, 2),
+                routing_delta_ms=round(t_s - t_b, 2),
+                census_sparse_delta=d_sparse,
+                model_predicted_delta_ms=(
+                    None if d_sparse is None else
+                    [round(d_sparse * COST_LO, 1),
+                     round(d_sparse * COST_HI, 1)]),
+                scope="destination-shaped routing ops at true slot count; "
+                      "source-shaped (Rsrc,C) extents are 8x smaller than "
+                      "bench R=8 and stay model-priced")
+
+
 def main() -> None:
+    if "--tpu-r1" in sys.argv:
+        out = tpu_r1_delta()
+        print(json.dumps(out))
+        with open("SHARDED_CENSUS.json") as f:
+            full = json.load(f)
+        full["tpu_r1_delta"] = out
+        with open("SHARDED_CENSUS.json", "w") as f:
+            json.dump(full, f, indent=1)
+        return
     cfg = bench_cfg()
     mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
     print("census at bench shape "

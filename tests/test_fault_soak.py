@@ -20,17 +20,25 @@ from hermes_tpu.runtime import FastRuntime
 from helpers import get
 
 
-@pytest.mark.parametrize("seed,arb_mode,chain", [(11, "race", 0),
-                                                 (23, "race", 0),
-                                                 (23, "sort", 0),
-                                                 (23, "sort", 6),
-                                                 (31, "sort", 6)])
-def test_random_fault_soak_checked(seed, arb_mode, chain):
+@pytest.mark.parametrize("seed,arb_mode,chain,retries", [
+    (11, "race", 0, 0),
+    (23, "race", 0, 0),
+    (23, "sort", 0, 0),
+    (23, "sort", 6, 0),
+    (31, "sort", 6, 0),
+    # round-5: RMW retry-in-place under the same chaos — a retrying
+    # session must survive freezes/removes/joins of its own replica's
+    # peers (its dead nacked ts must not resurface through replay)
+    (23, "sort", 6, 8),
+    (31, "race", 0, 8),
+])
+def test_random_fault_soak_checked(seed, arb_mode, chain, retries):
     R = 5
     cfg = HermesConfig(
         n_replicas=R, n_keys=96, n_sessions=6, replay_slots=6,
         ops_per_session=30, replay_age=6, replay_scan_every=4,
         rebroadcast_every=2, arb_mode=arb_mode, chain_writes=chain,
+        rmw_retries=retries,
         workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=seed),
     )
     rt = FastRuntime(cfg, record=True)
