@@ -33,15 +33,37 @@ from helpers import get
     (31, "race", 0, 8),
 ])
 def test_random_fault_soak_checked(seed, arb_mode, chain, retries):
-    R = 5
-    cfg = HermesConfig(
-        n_replicas=R, n_keys=96, n_sessions=6, replay_slots=6,
+    cfg = _soak_cfg(seed, arb_mode, chain, retries)
+    _run_soak(FastRuntime(cfg, record=True), cfg, seed)
+
+
+def test_random_fault_soak_checked_sharded():
+    """The same randomized chaos schedule against the SHARDED engine (the
+    transport=tpu_ici program shape: real collectives over a 5-device
+    mesh) — freeze/remove/rejoin-with-state-transfer interleavings travel
+    the wire path, not the lockstep emulation."""
+    import jax
+    from jax.sharding import Mesh
+
+    seed = 23
+    cfg = _soak_cfg(seed, "sort", 6, 8)
+    mesh = Mesh(np.array(jax.devices()[: cfg.n_replicas]), ("replica",))
+    _run_soak(FastRuntime(cfg, backend="sharded", mesh=mesh, record=True),
+              cfg, seed)
+
+
+def _soak_cfg(seed, arb_mode, chain, retries):
+    return HermesConfig(
+        n_replicas=5, n_keys=96, n_sessions=6, replay_slots=6,
         ops_per_session=30, replay_age=6, replay_scan_every=4,
         rebroadcast_every=2, arb_mode=arb_mode, chain_writes=chain,
         rmw_retries=retries,
         workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=seed),
     )
-    rt = FastRuntime(cfg, record=True)
+
+
+def _run_soak(rt, cfg, seed):
+    R = cfg.n_replicas
     rng = np.random.default_rng(seed)
 
     frozen_since = {}  # replica -> step frozen (still in live mask)
