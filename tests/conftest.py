@@ -80,6 +80,7 @@ SLOW_TESTS = {
     "test_rmw_reads_displaced_value",
     "test_get_untouched_key_returns_initial",
     "test_stall_remove_rejoin_checked",
+    "test_random_fault_soak_checked_sharded",
 }
 
 

@@ -34,7 +34,7 @@ from helpers import get
 ])
 def test_random_fault_soak_checked(seed, arb_mode, chain, retries):
     cfg = _soak_cfg(seed, arb_mode, chain, retries)
-    _run_soak(FastRuntime(cfg, record=True), cfg, seed)
+    _run_soak(FastRuntime(cfg, record=True))
 
 
 def test_random_fault_soak_checked_sharded():
@@ -48,8 +48,7 @@ def test_random_fault_soak_checked_sharded():
     seed = 23
     cfg = _soak_cfg(seed, "sort", 6, 8)
     mesh = Mesh(np.array(jax.devices()[: cfg.n_replicas]), ("replica",))
-    _run_soak(FastRuntime(cfg, backend="sharded", mesh=mesh, record=True),
-              cfg, seed)
+    _run_soak(FastRuntime(cfg, backend="sharded", mesh=mesh, record=True))
 
 
 def _soak_cfg(seed, arb_mode, chain, retries):
@@ -62,9 +61,10 @@ def _soak_cfg(seed, arb_mode, chain, retries):
     )
 
 
-def _run_soak(rt, cfg, seed):
+def _run_soak(rt):
+    cfg = rt.cfg
     R = cfg.n_replicas
-    rng = np.random.default_rng(seed)
+    rng = np.random.default_rng(cfg.workload.seed)
 
     frozen_since = {}  # replica -> step frozen (still in live mask)
     removed = set()
