@@ -4,10 +4,12 @@ Target (BASELINE.json:5): >=10M committed writes/sec aggregate on a v5e-8
 (8 replicas, 1 chip = 1 replica).  This environment exposes ONE v5e chip, so
 the bench runs the 8-replica configuration batched on that chip — every
 replica's protocol work AND all 8x8 message traffic execute on the single
-chip.  A real 8-chip mesh splits this work 8 ways (each chip applies each
-write once instead of this chip applying it 8 times) and pays ICI instead of
-on-chip copies, so the single-chip number lower-bounds the real-mesh
-aggregate.
+chip.  On a real 8-chip mesh each chip runs the sharded program instead:
+identical per-chip apply volume by construction, plus wire routing and ICI
+collectives — quantified in SHARDED_CENSUS.json / BASELINE.md "Round-5:
+the sharded round, quantified" (projected v5e-8 aggregate ~10.0-13.1M w/s
+depending on how the routing delta is priced; the round-1 "lower bound"
+framing is retired there).
 
 Runs the TPU-optimized round (core/faststep.py: packed-ts scatter-max
 conflict resolution, lane-direct applies, cond-gated replay scan),
