@@ -58,6 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--chain-writes", type=int, default=0,
                     help="intra-round same-key write chain length (faststep "
                          "hot-key throughput; needs --arb-mode sort)")
+    ap.add_argument("--rmw-retries", type=int, default=0,
+                    help="RMW nack retry-in-place budget (faststep; 0 = "
+                         "reference abort-on-nack behavior)")
     ap.add_argument("--no-auto-rebase", action="store_true",
                     help="disable the automatic version rebase at counter "
                          "polls (restores the loud packed-ts overflow error "
@@ -86,10 +89,10 @@ def main(argv=None) -> int:
     if args.chain_writes and args.arb_mode != "sort":
         ap.error("--chain-writes needs --arb-mode sort")
     if ((args.arb_mode != "race" or args.chain_writes
-         or args.no_auto_rebase)
+         or args.no_auto_rebase or args.rmw_retries)
             and args.backend not in ("fast", "fast-sharded")):
-        ap.error("--arb-mode/--chain-writes/--no-auto-rebase only affect "
-                 "the fast backends (core/faststep.py / runtime."
+        ap.error("--arb-mode/--chain-writes/--no-auto-rebase/--rmw-retries "
+                 "only affect the fast backends (core/faststep.py / runtime."
                  "FastRuntime); use --backend fast or fast-sharded")
 
     from hermes_tpu import stats as stats_lib
@@ -123,6 +126,7 @@ def main(argv=None) -> int:
         wrap_stream=args.wrap_stream,
         arb_mode=args.arb_mode,
         chain_writes=args.chain_writes,
+        rmw_retries=args.rmw_retries,
         auto_rebase=not args.no_auto_rebase,
         workload=WorkloadConfig(
             distribution=args.distribution,
