@@ -81,6 +81,8 @@ SLOW_TESTS = {
     "test_get_untouched_key_returns_initial",
     "test_stall_remove_rejoin_checked",
     "test_random_fault_soak_checked_sharded",
+    "test_rmw_retry_sharded_matches_batched",
+    "test_rmw_retry_converts_aborts_to_commits",
 }
 
 
