@@ -83,6 +83,16 @@ SLOW_TESTS = {
     "test_random_fault_soak_checked_sharded",
     "test_rmw_retry_sharded_matches_batched",
     "test_rmw_retry_converts_aborts_to_commits",
+    # quick-tier trim (round-5): each of these has a same-mechanism sibling
+    # that stays in the quick tier — rebase keeps headroom/kvs-inflight/
+    # quiesce-flag; scan equivalence keeps the sharded variant; backend
+    # equivalence keeps the sharded cell; retry keeps acceptance[2r]
+    "test_sharded_rebase_nonuniform_keys_vetoed",
+    "test_auto_rebase_soak_crosses_old_budget",
+    "test_auto_rebase_backoff_latch",
+    "test_scan_matches_step_loop",
+    "test_sim_backend_lockstep_equivalence",
+    "test_rmw_retry_bounded_then_aborts",
 }
 
 
