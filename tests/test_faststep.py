@@ -57,6 +57,39 @@ def test_ycsb_f_rmw_checked():
     assert rt.counters()["n_rmw"] > 0
 
 
+def test_wire_block_pack_roundtrip():
+    """FastInv/FastAck ride the wire as single int8 byte tensors
+    (round-5): the field views must recover exactly the packed words,
+    including sign bits (INV_VALID occupies bit 30; negative-looking
+    bytes must not corrupt the unpack)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    key = jnp.asarray(rng.integers(0, 1 << 29, (2, 5), dtype=np.int32))
+    pts = jnp.asarray(rng.integers(0, 1 << 30, (2, 5), dtype=np.int32))
+    val = jnp.asarray(rng.integers(-128, 128, (2, 5, 8), dtype=np.int8))
+    fresh = jnp.asarray(rng.random((2, 5)) < 0.5)
+    taken = jnp.asarray(rng.random((2, 5)) < 0.7)
+    pkf = (key | jnp.where(fresh, fst.INV_FRESH, 0)
+           | jnp.where(taken, fst.INV_VALID, 0))
+    head8 = fst._i32_to_bank(jnp.stack([pkf, pts], axis=-1))
+    inv = fst.FastInv(rows8=jnp.concatenate([head8, val], axis=-1),
+                      epoch=jnp.zeros((2,), jnp.int32),
+                      alive=jnp.ones((2,), bool))
+    np.testing.assert_array_equal(get(inv.key), get(key))
+    np.testing.assert_array_equal(get(inv.pts), get(pts))
+    np.testing.assert_array_equal(get(inv.val), get(val))
+    np.testing.assert_array_equal(get(inv.fresh), get(fresh))
+    np.testing.assert_array_equal(get(inv.valid), get(taken))
+
+    apkf = (key << 2) | 2 | 1
+    ack = fst.FastAck(
+        rows8=fst._i32_to_bank(jnp.stack([apkf, pts], axis=-1))[None],
+        epoch=jnp.zeros((2,), jnp.int32))
+    np.testing.assert_array_equal(get(ack.pkf)[0], get(apkf))
+    np.testing.assert_array_equal(get(ack.pts)[0], get(pts))
+
+
 def test_rmw_retry_converts_aborts_to_commits():
     """config.rmw_retries (round-5): a nacked RMW retries in place instead
     of aborting; under heavy same-key RMW contention the retry run must
