@@ -64,9 +64,10 @@ def run_checked(cfg, schedule, max_steps=600):
 
 
 def test_chaos_drop_dup_delay():
-    # 6 seeds (round 4 doubled the sweep): each is a distinct adversarial
-    # interleaving of drops/dups/delays over the full op mix
-    for seed in range(6):
+    # 12 seeds (round 4 doubled to 6, round 5 doubled again): each is a
+    # distinct adversarial interleaving of drops/dups/delays over the full
+    # op mix
+    for seed in range(12):
         rt = run_checked(cfg_small(30 + seed), chaotic_schedule(seed, until=300))
         c = rt.counters()
         assert c["n_write"] > 0
