@@ -46,7 +46,6 @@ meaningless (the cells are tagged with the platform).
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import sys
 import time
@@ -215,6 +214,14 @@ def main():
 
     platform = jax.devices()[0].platform
     interpret = platform != "tpu"
+    if interpret:
+        # CPU smoke is functional parity only; the TPU-sized repetition
+        # counts would crawl under interpret mode — shrink them globally
+        global _time
+        _orig_time = _time
+
+        def _time(step, state, args, n_lo=1, n_hi=3, _t=_orig_time):
+            return _t(step, state, args, n_lo=1, n_hi=3)
     cells = []
 
     # A vs B at the VMEM-resident block shape the serial kernel needs

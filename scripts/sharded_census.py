@@ -47,7 +47,6 @@ if "--tpu-r1" not in sys.argv:
                                    + " --xla_force_host_platform_device_count=8")
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
