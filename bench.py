@@ -186,6 +186,9 @@ def run_mix(mix: str, over: dict | None = None, rounds: int = ROUNDS,
     p99_rounds = percentile_from_hist(hist, 0.99)
     step_us = wall / measure * 1e6
 
+    # percentile_from_hist returns None on an empty histogram (a run with
+    # zero commits); the *_us_est derivations must not crash on it
+    us_est = lambda p: None if p is None else round((p + 1) * step_us, 1)
     return {
         "mix": mix,
         "writes_per_sec": round(wps, 1),
@@ -196,8 +199,8 @@ def run_mix(mix: str, over: dict | None = None, rounds: int = ROUNDS,
         "round_us": round(step_us, 1),
         "p50_commit_rounds": p50_rounds,
         "p99_commit_rounds": p99_rounds,
-        "p50_commit_us_est": round((p50_rounds + 1) * step_us, 1),
-        "p99_commit_us_est": round((p99_rounds + 1) * step_us, 1),
+        "p50_commit_us_est": us_est(p50_rounds),
+        "p99_commit_us_est": us_est(p99_rounds),
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
         "replicas_on_chip": cfg.n_replicas,
@@ -330,9 +333,26 @@ from hermes_tpu.probe import probe_backend  # noqa: E402
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mix", choices=MIXES + ("all", "latency"), default="a")
+    ap.add_argument("--metrics-out", default=None, metavar="RUN_JSONL",
+                    help="additionally write every measured cell to an obs "
+                    "run log (stamped t/kind schema; scripts/obs_report.py)")
     ap.add_argument("--probe-timeout", type=float, default=float(
         os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
     args = ap.parse_args()
+
+    # Legacy contract lines ride the unstamped exporter — byte-identical to
+    # the print(json.dumps(...)) they replace (the BENCH harness scrapes
+    # stdout); --metrics-out adds the stamped obs run log alongside.
+    from hermes_tpu.obs.metrics import JsonlExporter
+
+    out = JsonlExporter(sys.stdout, stamp=False)
+    err = JsonlExporter(sys.stderr, stamp=False)
+    obs_exp = (JsonlExporter(open(args.metrics_out, "w"), stamp=True)
+               if args.metrics_out else None)
+
+    def cell(rec: dict) -> None:
+        if obs_exp is not None:
+            obs_exp.write(rec, kind="summary")
 
     ok, info = probe_backend(args.probe_timeout)
     if not ok:
@@ -344,11 +364,13 @@ def main() -> None:
                if args.mix == "latency" else
                {"metric": "committed_writes_per_sec", "value": 0.0,
                 "unit": "writes/s", "vs_baseline": 0.0, "error": info})
-        print(json.dumps(rec))
+        out.write(rec)
         sys.exit(1)
 
     if args.mix == "latency":
-        print(json.dumps(run_latency()))
+        r = run_latency()
+        cell(r)
+        out.write(r)
         return
 
     mixes = MIXES if args.mix == "all" else (args.mix,)
@@ -356,17 +378,19 @@ def main() -> None:
     for mix in mixes:
         r = run_mix(mix)
         results[mix] = r
-        print(json.dumps(r), file=sys.stderr)
+        cell(r)
+        err.write(r)
 
     if args.mix == "all":
         # latency operating point at three scales (round-3 verdict item 7):
         # p50 - dispatch_floor isolates program latency from the tunneled
         # link handshake at each in-flight count
         for s in (256, 1024, 4096):
-            cell = run_latency(n_sessions=s)
-            cell["mix"] = f"latency_s{s}"
-            results[cell["mix"]] = cell
-            print(json.dumps(cell), file=sys.stderr)
+            rec = run_latency(n_sessions=s)
+            rec["mix"] = f"latency_s{s}"
+            results[rec["mix"]] = rec
+            cell(rec)
+            err.write(rec)
         # historical key: a copy, so its mix tag still reads "latency" (the
         # outage path emits {"mix": "latency", ...} — consumers key on it)
         results["latency"] = dict(results["latency_s1024"], mix="latency")
@@ -384,7 +408,8 @@ def main() -> None:
         # never let a non-primary mix masquerade as the driver's YCSB-A
         # metric: tag the stdout line so scrapers can tell them apart
         line["metric"] = f"committed_writes_per_sec_{primary['mix']}"
-    print(json.dumps(line))
+    cell(line)
+    out.write(line)
 
 
 if __name__ == "__main__":

@@ -52,8 +52,8 @@ def default_record(check: bool = True):
     return ("array" if shutil.which(_CXX) else True) if check else False
 
 
-def _ensure_built() -> pathlib.Path:
-    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+def _ensure_built(force: bool = False) -> pathlib.Path:
+    if not force and _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
         return _SO
     tmp = _SO.with_suffix(f".so.tmp.{os.getpid()}")
     subprocess.run(
@@ -70,7 +70,9 @@ _lib = None
 def _core():
     global _lib
     if _lib is None:
-        _lib = ctypes.CDLL(str(_ensure_built()))
+        from hermes_tpu.core.compat import load_native
+
+        _lib = load_native(_ensure_built)
         _lib.hc_check_witness.restype = ctypes.c_int64
         _lib.hc_check_witness.argtypes = [
             ctypes.c_int64,

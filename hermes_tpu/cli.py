@@ -71,7 +71,20 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--check", action="store_true", help="record history + linearizability gate")
     ap.add_argument("--check-keys", type=int, default=512, help="sampled keys for the gate")
     ap.add_argument("--report-every", type=int, default=0, help="steps between stat lines")
-    ap.add_argument("--metrics-jsonl", type=str, default=None)
+    ap.add_argument("--metrics-jsonl", type=str, default=None,
+                    help="legacy interval-metrics JSONL (see --metrics-out)")
+    ap.add_argument("--metrics-out", type=str, default=None, metavar="RUN_JSONL",
+                    help="obs run log: interval metrics + trace events + "
+                    "summary on one monotonic clock (hermes_tpu.obs); render "
+                    "with scripts/obs_report.py")
+    ap.add_argument("--trace-steps", action="store_true",
+                    help="with --metrics-out: per-step dispatch/readback "
+                    "spans (verbose; faults/drains/intervals are always "
+                    "traced)")
+    ap.add_argument("--freeze", action="append", default=[],
+                    metavar="R:FROM:TO",
+                    help="failure injection: freeze replica R at step FROM, "
+                    "thaw at step TO (repeatable; emits obs fault events)")
     return ap
 
 
@@ -136,6 +149,40 @@ def main(argv=None) -> int:
         ),
     )
 
+    # validate --freeze before the runtime, profiler trace, or any output
+    # file exists: an argument error must not truncate a previous run's
+    # metrics log or leave an unstopped profiler trace behind
+    windows: dict = {}  # replica -> [(lo, hi)]
+    for spec in args.freeze:
+        try:
+            r, lo, hi = (int(x) for x in spec.split(":"))
+        except ValueError:
+            ap.error(f"--freeze wants R:FROM:TO, got {spec!r}")
+        if not 0 <= r < args.replicas:
+            ap.error(f"--freeze replica {r} out of range (0..{args.replicas - 1})")
+        if not 0 <= lo < hi:
+            ap.error(f"--freeze window {lo}:{hi} must satisfy 0 <= FROM < TO")
+        windows.setdefault(r, []).append((lo, hi))
+    faults = []  # (step, replica, action) sorted by step, thaw before freeze
+    for r, wins in windows.items():
+        wins.sort()
+        for (_, hi_a), (lo_b, _) in zip(wins, wins[1:]):
+            if lo_b < hi_a:
+                ap.error(f"--freeze windows for replica {r} overlap "
+                         f"(..:{hi_a} vs {lo_b}:..)")
+        for lo, hi in wins:
+            faults += [(lo, r, "freeze"), (hi, r, "thaw")]
+    # thaw before freeze at the same step so back-to-back windows
+    # (R:10:20 + R:20:30) keep the replica continuously frozen
+    faults.sort(key=lambda f: (f[0], f[2] != "thaw", f[1]))
+    if faults:
+        if args.steps <= 0:
+            ap.error("--freeze needs a bounded run (--steps > 0)")
+        if faults[-1][0] >= args.steps:
+            ap.error(f"--freeze window ends at step {faults[-1][0]} but the "
+                     f"run stops after --steps {args.steps}; the thaw would "
+                     "never fire (want TO < --steps)")
+
     mesh = None
     if args.backend in ("sharded", "fast-sharded"):
         import jax
@@ -162,18 +209,29 @@ def main(argv=None) -> int:
     logger = None
     if args.metrics_jsonl:
         logger = stats_lib.JsonlLogger(open(args.metrics_jsonl, "w"))
+    obs = None
+    if args.metrics_out:
+        from hermes_tpu.obs import Observability
+
+        obs = rt.attach_obs(Observability(path=args.metrics_out,
+                                          trace_steps=args.trace_steps))
 
     meta_of = lambda: rt.fs.meta if hasattr(rt, "fs") else rt.rs.meta
     t0 = time.perf_counter()
     try:
         if args.steps > 0:
             for s in range(args.steps):
+                while faults and faults[0][0] <= s:
+                    _, r, action = faults.pop(0)
+                    getattr(rt, action)(r)
                 rt.step_once()
                 if args.report_every and (s + 1) % args.report_every == 0:
                     rec = stats_lib.summarize(meta_of(), time.perf_counter() - t0, s + 1)
                     print(rec, file=sys.stderr)
                     if logger:
                         logger.log(rec)
+                    if obs:
+                        obs.interval(rec)
         else:
             ok = rt.drain()
             if not ok:
@@ -185,19 +243,30 @@ def main(argv=None) -> int:
             jax.profiler.stop_trace()
     wall = time.perf_counter() - t0
 
-    rec = stats_lib.summarize(meta_of(), wall, rt.step_idx)
+    # one Meta readback: the run-log summary carries the raw histograms
+    # (obs_report.py renders them); the stdout/legacy lines stay scalar-only
+    rec = stats_lib.summarize(meta_of(), wall, rt.step_idx,
+                              hists=obs is not None)
+    if obs:
+        obs.summary(rec)
+        rec = {k: v for k, v in rec.items()
+               if k not in ("lat_hist", "qwait_hist")}
     print(rec)
     if logger:
         logger.log(rec)
 
-    if args.check:
-        v = rt.check(max_keys=args.check_keys)
-        print(f"linearizability: {'PASS' if v.ok else 'FAIL'} ({v.keys_checked} keys)")
-        if not v.ok:
-            for f in v.failures[:5]:
-                print("  ", f.reason[:200])
-            return 1
-    return 0
+    try:
+        if args.check:
+            v = rt.check(max_keys=args.check_keys)
+            print(f"linearizability: {'PASS' if v.ok else 'FAIL'} ({v.keys_checked} keys)")
+            if not v.ok:
+                for f in v.failures[:5]:
+                    print("  ", f.reason[:200])
+                return 1
+        return 0
+    finally:
+        if obs:
+            obs.close()
 
 
 if __name__ == "__main__":

@@ -139,6 +139,16 @@ class HermesConfig:
     # leaks between attempts.
     rmw_retries: int = 0
 
+    # Device-side phase metrics (hermes_tpu/obs): per-round protocol-phase
+    # counters and the ACK quorum-wait histogram summed into the Meta
+    # columns (core/state.Meta: n_inv/n_rebcast/n_nack/n_retry/replay_peak/
+    # qwait_*).  All dense elementwise+reduction work that XLA fuses into
+    # the round; False compiles the uninstrumented program (the ablation
+    # baseline scripts/check_obs_overhead.py measures against).  The base
+    # counters (n_read/n_write/n_rmw/n_abort/lat_*) are always on — they
+    # predate this flag and the acceptance drivers read them.
+    phase_metrics: bool = True
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or

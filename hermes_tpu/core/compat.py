@@ -1,0 +1,40 @@
+"""Environment compatibility shims (JAX API versions, native toolchains).
+
+The sharded engines are written against the stable ``jax.shard_map`` API
+(with ``check_vma``); older JAX (< 0.5) ships it as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` spelling of
+the same knob.  One resolver here keeps every build site identical.
+
+``load_native`` is the shared dlopen-or-rebuild policy for the repo's C++
+components (checker/fast.py, transport/tcp.py): a checked-in ``.so`` built
+by a foreign toolchain can be newer-than-source by mtime yet still fail to
+load (e.g. it links a libstdc++ symbol version this machine doesn't have) —
+the fallback rebuilds from source with the local compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map(..., check_vma=False)`` on any supported JAX."""
+    import jax  # deferred: load_native callers stay importable without jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm_exp
+
+    return sm_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def load_native(ensure_built) -> ctypes.CDLL:
+    """dlopen the path ``ensure_built(force)`` returns; on OSError (foreign
+    toolchain binary) force a from-source rebuild and retry once."""
+    try:
+        return ctypes.CDLL(str(ensure_built(False)))
+    except OSError:
+        return ctypes.CDLL(str(ensure_built(True)))

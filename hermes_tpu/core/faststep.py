@@ -72,7 +72,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hermes_tpu.config import HermesConfig
-from hermes_tpu.core import kernels
+from hermes_tpu.core import compat, kernels
 from hermes_tpu.core import state as st
 from hermes_tpu.core import types as t
 
@@ -229,6 +229,9 @@ class FastSess(NamedTuple):
     rd_val: jnp.ndarray  # (R, S, 4V) int8
     invoke_step: jnp.ndarray
     retries: jnp.ndarray  # RMW retry-in-place count (config.rmw_retries)
+    # step of the pending update's FIRST broadcast — the ACK quorum-wait
+    # origin (Meta.qwait_*; maintained only under cfg.phase_metrics)
+    issue_step: jnp.ndarray
 
 
 class FastReplay(NamedTuple):
@@ -362,6 +365,13 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
         lat_cnt=z(r),
         lat_hist=z(r, st.LAT_BINS),
         max_pts=z(r),
+        n_inv=z(r),
+        n_rebcast=z(r),
+        n_nack=z(r),
+        n_retry=z(r),
+        replay_peak=z(r),
+        qwait_sum=z(r),
+        qwait_hist=z(r, st.LAT_BINS),
     )
     z8 = lambda *sh: jnp.zeros(sh, jnp.int8)
     return FastState(
@@ -371,6 +381,7 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
             status=z(r, s), op=z(r, s), op_idx=z(r, s), key=z(r, s),
             val=z8(r, s, 4 * v), pts=z(r, s), acks=z(r, s),
             rd_val=z8(r, s, 4 * v), invoke_step=z(r, s), retries=z(r, s),
+            issue_step=z(r, s),
         ),
         replay=FastReplay(
             active=jnp.zeros((r, rs), jnp.bool_), key=z(r, rs), pts=z(r, rs),
@@ -763,6 +774,22 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             (read_done | is_rmw_issue)[..., None], rd_val, sess.rd_val
         ),
     )
+    meta = fs.meta
+    if cfg.phase_metrics:
+        # phase metrics (hermes_tpu/obs): dense per-round sums over masks the
+        # round already computed — XLA fuses them into the existing
+        # elementwise work, no extra sparse ops.  issue_step anchors the ACK
+        # quorum-wait clock at the pending update's FIRST broadcast.
+        sess = sess._replace(
+            issue_step=jnp.where(win_eff, step, sess.issue_step))
+        meta = meta._replace(
+            n_inv=meta.n_inv + jnp.sum(taken_lane, axis=1, dtype=jnp.int32),
+            n_rebcast=meta.n_rebcast
+            + jnp.sum(taken_lane & ~lane_fresh, axis=1, dtype=jnp.int32),
+            replay_peak=jnp.maximum(
+                meta.replay_peak,
+                jnp.sum(replay.active, axis=1, dtype=jnp.int32)),
+        )
 
     lanes = LaneBlock(
         key=jnp.concatenate([sess.key, replay.key], axis=1),
@@ -771,7 +798,7 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         fresh=lane_fresh,
     )
 
-    fs = fs._replace(table=table, sess=sess, replay=replay)
+    fs = fs._replace(table=table, sess=sess, replay=replay, meta=meta)
     return (fs, lanes, slot_lane, taken_lane, read_done, read_extra, sub_comps)
 
 
@@ -1110,6 +1137,29 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
         # detection instead of silent compare corruption past the limit
         max_pts=jnp.maximum(meta.max_pts, jnp.max(sess.pts, axis=1)),
     )
+    if cfg.phase_metrics:
+        # ACK quorum-wait (issue -> commit, in rounds) + nack/retry
+        # breakdown.  The histogram is one broadcast compare-and-reduce over
+        # (R, S, LAT_BINS) — dense, fusable, same formulation as the Pallas
+        # stats kernel's per-bin reductions.
+        nbin = st.LAT_BINS
+        qwait = jnp.where(commit, step - sess.issue_step, 0)
+        cq = jnp.clip(qwait, 0, nbin - 1)
+        qhist = jnp.sum(
+            (cq[..., None] == jnp.arange(nbin, dtype=jnp.int32))
+            & commit[..., None],
+            axis=1, dtype=jnp.int32)
+        meta = meta._replace(
+            n_nack=meta.n_nack
+            + jnp.sum(infl & nacked[:, :S] & ~frozen, axis=1,
+                      dtype=jnp.int32),
+            n_retry=(meta.n_retry
+                     + jnp.sum(retry, axis=1, dtype=jnp.int32))
+            if retry is not None else meta.n_retry,
+            qwait_sum=meta.qwait_sum + jnp.sum(qwait, axis=1,
+                                               dtype=jnp.int32),
+            qwait_hist=meta.qwait_hist + qhist,
+        )
 
     done = commit | abort
     status = jnp.where(done, t.S_IDLE, sess.status)
@@ -1294,11 +1344,10 @@ def build_fast_sharded(cfg: HermesConfig, mesh: Mesh, rounds: int = 1,
     rspec = P("replica")
     ctl_spec = FastCtl(step=P(), my_cid=P(), epoch=rspec, live_mask=rspec,
                        frozen=rspec, quiesce=P())
-    sharded = jax.shard_map(
+    sharded = compat.shard_map(
         shard_body, mesh=mesh,
         in_specs=(rspec, rspec, ctl_spec),
         out_specs=(rspec, rspec) if rounds == 1 else rspec,
-        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
@@ -1415,12 +1464,11 @@ def build_rebase(cfg: HermesConfig, backend: str = "batched", mesh=None):
         return _rebase_core(cfg, fs, busy, uniform)
 
     rspec = P("replica")
-    sharded = jax.shard_map(
+    sharded = compat.shard_map(
         shard_body, mesh=mesh,
         in_specs=(rspec,),
         # delta is device-uniform by construction (psum'd busy + identical
         # converged rows on every chip) — replicate it
         out_specs=(rspec, P()),
-        check_vma=False,
     )
     return jax.jit(sharded)

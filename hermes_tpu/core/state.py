@@ -198,6 +198,23 @@ class Meta(NamedTuple):
         host-side so a key nearing the int32 packed-ts version limit fails
         loudly instead of silently corrupting the Lamport compare.  The
         phases engine has no packed ts and leaves it 0.
+
+    Phase metrics (hermes_tpu/obs; gated by HermesConfig.phase_metrics,
+    summed by the faststep engine — the phases engine leaves them 0):
+
+    ``n_inv``       () INV slots broadcast (fanout = n_inv * live receivers)
+    ``n_rebcast``   () re-broadcast slots (non-fresh: ack-waiting sessions on
+        their backoff round + replay-slot re-INVs)
+    ``n_nack``      () nack (conflict) verdicts observed on in-flight lanes
+    ``n_retry``     () RMW retry-in-place transitions (abort-reason
+        breakdown: n_abort = nacks that exhausted the retry budget)
+    ``replay_peak`` () high-water mark of concurrently active replay slots
+    ``qwait_sum`` / ``qwait_hist`` () / (LAT_BINS,) ACK quorum-wait: steps
+        from INV issue (first broadcast) to commit — the network-bound slice
+        of the commit latency (lat_* measures load->commit; the difference
+        is intake/arbitration/backoff wait).  VAL latency is structurally 0
+        in faststep — the commit decision and the winner's VALID row land in
+        the issue round itself (see faststep._apply_commit).
     """
 
     last_seen: jnp.ndarray
@@ -209,6 +226,13 @@ class Meta(NamedTuple):
     lat_cnt: jnp.ndarray
     lat_hist: jnp.ndarray
     max_pts: jnp.ndarray
+    n_inv: jnp.ndarray
+    n_rebcast: jnp.ndarray
+    n_nack: jnp.ndarray
+    n_retry: jnp.ndarray
+    replay_peak: jnp.ndarray
+    qwait_sum: jnp.ndarray
+    qwait_hist: jnp.ndarray
 
 
 LAT_BINS = 64
@@ -285,6 +309,13 @@ def init_meta(cfg: config_lib.HermesConfig) -> Meta:
         lat_cnt=z,
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         max_pts=z,
+        n_inv=z,
+        n_rebcast=z,
+        n_nack=z,
+        n_retry=z,
+        replay_peak=z,
+        qwait_sum=z,
+        qwait_hist=jnp.zeros((LAT_BINS,), jnp.int32),
     )
 
 

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hermes_tpu.config import HermesConfig
-from hermes_tpu.core import phases, state as st
+from hermes_tpu.core import compat, phases, state as st
 from hermes_tpu.core import types as t
 
 
@@ -216,12 +216,11 @@ def build_step_sharded(cfg: HermesConfig, mesh: Mesh):
         return jax.tree.map(lambda x: x[None], out_rs), jax.tree.map(lambda x: x[None], comp)
 
     rspec = P("replica")
-    sharded = jax.shard_map(
+    sharded = compat.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(rspec, rspec, StepCtl(step=P(), epoch=rspec, live_mask=rspec, frozen=rspec)),
         out_specs=(rspec, rspec),
-        check_vma=False,
     )
     return jax.jit(sharded)
 
@@ -256,12 +255,11 @@ def build_step_sharded_scan(cfg: HermesConfig, mesh: Mesh, rounds: int, donate: 
         return jax.tree.map(lambda x: x[None], rs1)
 
     rspec = P("replica")
-    sharded = jax.shard_map(
+    sharded = compat.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(rspec, rspec, StepCtl(step=P(), epoch=rspec, live_mask=rspec, frozen=rspec)),
         out_specs=rspec,
-        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 

@@ -66,6 +66,13 @@ class MembershipService:
                 continue
             freshest = max(int(last_seen[i, r]) for i in observers)
             if rt.step_idx - freshest > self.cfg.lease_steps:
+                # suspect precedes remove on the obs timeline: the remove
+                # event records the membership outcome, this one records the
+                # detector's evidence (how stale the freshest observation was)
+                trace = getattr(rt, "_trace", None)
+                if trace is not None:
+                    trace("suspect", replica=r,
+                          stale_steps=rt.step_idx - freshest)
                 rt.remove(r)
                 live = int(rt.live[0])
                 evt = MembershipEvent(rt.step_idx, "remove", r, live)

@@ -1,0 +1,96 @@
+"""hermes_tpu.obs — unified observability: metrics registry, exporters,
+event-timeline tracing (SURVEY.md §5.5; the reference's stats thread,
+grown into a subsystem).
+
+Three pillars:
+
+  1. **Device-side phase metrics** — the Meta columns (core/state.Meta):
+     base op counters + the phase counters/histograms the fast round sums
+     per step at zero host cost (gated by ``HermesConfig.phase_metrics``).
+  2. **Host-side registry + exporters** — ``MetricsRegistry`` (counter /
+     gauge / histogram) with JSONL, Prometheus-text, and human-report
+     exporters (obs/metrics.py, obs/report.py).
+  3. **Event-timeline tracing** — span/point trace records on the same
+     monotonic clock as interval metrics (obs/trace.py), merged by
+     ``scripts/obs_report.py`` into one causally ordered run story.
+
+``Observability`` is the facade the runtimes attach
+(``Runtime.attach_obs`` / ``FastRuntime.attach_obs``): one registry, one
+exporter (file or in-memory), one tracer, one clock.
+"""
+
+from __future__ import annotations
+
+from typing import IO, Optional
+
+from hermes_tpu.obs.metrics import (
+    BufferExporter,
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlExporter,
+    MetricsRegistry,
+    percentile_from_counts,
+    prometheus_text,
+)
+from hermes_tpu.obs.trace import Tracer
+
+__all__ = [
+    "BufferExporter", "Counter", "Gauge", "Histogram", "JsonlExporter",
+    "MetricsRegistry", "Observability", "Tracer", "percentile_from_counts",
+    "prometheus_text",
+]
+
+
+class Observability:
+    """One obs context for a run: registry + exporter + tracer on a shared
+    monotonic clock.
+
+    ``path``/``fp`` select a JSONL file sink; with neither, records buffer
+    in memory (``.records`` — tests and post-hoc report rendering).
+    ``trace_steps`` additionally emits per-step dispatch/readback spans —
+    off by default (two records per protocol step is run-log noise at
+    bench scale; faults, intervals, drains and rebases are always traced).
+    """
+
+    def __init__(self, path: Optional[str] = None, fp: Optional[IO[str]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 trace_steps: bool = False):
+        self.registry = registry or MetricsRegistry()
+        self._own_fp = None
+        if fp is None and path is not None:
+            fp = self._own_fp = open(path, "w")
+        self.exporter = JsonlExporter(fp) if fp is not None else BufferExporter()
+        self.tracer = Tracer(self.exporter)
+        self.trace_steps = trace_steps
+
+    @property
+    def records(self):
+        """Buffered records (in-memory sink only)."""
+        if not isinstance(self.exporter, BufferExporter):
+            raise AttributeError(
+                "records buffer only exists for the in-memory sink; "
+                "read the JSONL file back via obs.report.load_records")
+        return self.exporter.records
+
+    def interval(self, record: dict) -> None:
+        """Write one interval-metrics record (cumulative counters at a
+        reporting boundary; obs/report.py derives per-interval rates)."""
+        self.exporter.write(record, kind="metrics")
+
+    def summary(self, record: dict) -> None:
+        self.exporter.write(record, kind="summary")
+
+    def registry_snapshot(self) -> None:
+        """Flush the host registry's current values as one record."""
+        self.exporter.write(self.registry.snapshot(), kind="registry")
+
+    def close(self) -> None:
+        if isinstance(self.exporter, JsonlExporter):
+            try:
+                self.exporter.fp.flush()
+            except ValueError:
+                pass  # already closed
+        if self._own_fp is not None:
+            self._own_fp.close()
+            self._own_fp = None

@@ -1,0 +1,209 @@
+"""Host-side metrics registry + exporters (hermes_tpu/obs pillar 2).
+
+The reference aggregates cache-line-padded per-thread counters in a stats
+thread (SURVEY.md §5.5); the rebuild's device-side twin is the Meta column
+block summed per round at zero host cost (core/state.Meta).  This module is
+the HOST half: a ``MetricsRegistry`` of named counters / gauges / histograms
+that the runtimes, transports, and scripts feed, with three exporters —
+
+  * ``JsonlExporter``   — one JSON object per line.  ``stamp=True`` (the obs
+    run-log mode) prefixes every record with the shared monotonic clock
+    ``t`` and a ``kind`` tag, the schema ``scripts/obs_report.py`` merges
+    into a run timeline.  ``stamp=False`` writes the record verbatim — the
+    byte-compatible mode the legacy bench/soak stdout contracts ride.
+  * ``prometheus_text`` — a Prometheus-style text snapshot of a registry.
+  * ``render_report``   — the human renderer (hermes_tpu/obs/report.py).
+
+Registries are plain host objects: feeding them costs dict lookups and int
+adds, never a device sync — device counters enter via ``Counter.set_total``
+at the caller's chosen poll interval.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, Dict, List, Optional, Union
+
+import numpy as np
+
+
+class Counter:
+    """Monotone counter.  ``inc`` for host events; ``set_total`` for
+    device-derived cumulative totals (Meta columns are absolute sums)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def set_total(self, total: Union[int, float]) -> None:
+        self.value = total
+
+
+class Gauge:
+    """Point-in-time value (watermarks, rates, config echoes)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def set(self, v: Union[int, float]) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Fixed-bin histogram over non-negative integer observations (bin i
+    counts value i; the last bin clips) — the same shape as the device
+    latency histograms (state.LAT_BINS), so a device hist drops in via
+    ``set_counts``."""
+
+    def __init__(self, name: str, bins: int = 64, help: str = ""):
+        self.name = name
+        self.help = help
+        self.counts = np.zeros(bins, np.int64)
+
+    def observe(self, v: int, n: int = 1) -> None:
+        self.counts[min(max(int(v), 0), len(self.counts) - 1)] += n
+
+    def set_counts(self, counts) -> None:
+        c = np.asarray(counts, np.int64)
+        if c.shape != self.counts.shape:
+            raise ValueError(
+                f"histogram {self.name}: expected {self.counts.shape[0]} "
+                f"bins, got {c.shape}")
+        self.counts = c.copy()
+
+    @property
+    def total(self) -> int:
+        return int(self.counts.sum())
+
+    def percentile(self, q: float) -> Optional[int]:
+        return percentile_from_counts(self.counts, q)
+
+
+def percentile_from_counts(counts: np.ndarray, q: float) -> Optional[int]:
+    """q in [0, 1]; bin index of the q-quantile, or None when empty (an
+    empty histogram has no percentile — never a sentinel that poisons
+    downstream JSON)."""
+    cum = np.asarray(counts).cumsum()
+    if cum[-1] == 0:
+        return None
+    return int((cum >= q * cum[-1]).argmax())
+
+
+class MetricsRegistry:
+    """Named metric registry with get-or-create accessors.  A name maps to
+    exactly one metric object for the registry's lifetime; asking for the
+    same name with a different type is a bug and raises."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Union[Counter, Gauge, Histogram]] = {}
+
+    def _get(self, name: str, cls, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, **kw)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} is a {type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help=help)
+
+    def histogram(self, name: str, bins: int = 64, help: str = "") -> Histogram:
+        return self._get(name, Histogram, bins=bins, help=help)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._metrics
+
+    def snapshot(self) -> dict:
+        """Flat JSON-ready view: scalars verbatim; histograms as counts plus
+        derived p50/p99 (None-omitted, matching stats.summarize)."""
+        out: dict = {}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Histogram):
+                out[name] = m.counts.tolist()
+                for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                    p = m.percentile(q)
+                    if p is not None:
+                        out[f"{name}_{tag}"] = p
+            else:
+                out[name] = m.value
+        return out
+
+
+def prometheus_text(reg: MetricsRegistry) -> str:
+    """Prometheus text-exposition snapshot (counters/gauges as samples,
+    histograms as cumulative ``_bucket`` series + ``_count``)."""
+    lines: List[str] = []
+    for name, m in sorted(reg._metrics.items()):
+        if m.help:
+            lines.append(f"# HELP {name} {m.help}")
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {m.value}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {m.value}")
+        else:
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for i, c in enumerate(m.counts.tolist()):
+                cum += c
+                lines.append(f'{name}_bucket{{le="{i}"}} {cum}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{name}_count {cum}")
+    return "\n".join(lines) + "\n"
+
+
+class JsonlExporter:
+    """One JSON object per line.
+
+    ``stamp=True``: every record is emitted as ``{"t": <monotonic seconds
+    since exporter birth>, "kind": <tag>, ...}`` — the obs run-log schema
+    (every record has ``t`` and ``kind``; ``t`` is non-decreasing because
+    the clock is monotonic and records are written in call order).
+
+    ``stamp=False``: the record is serialized verbatim, preserving key
+    order — byte-compatible with the legacy ``print(json.dumps(...))``
+    contract lines of bench.py / scripts/rebase_soak.py.
+    """
+
+    def __init__(self, fp: IO[str], stamp: bool = True):
+        self.fp = fp
+        self.stamp = stamp
+        self.t0 = time.perf_counter()
+
+    def now(self) -> float:
+        return time.perf_counter() - self.t0
+
+    def write(self, record: dict, kind: str = "metrics") -> None:
+        if self.stamp:
+            record = {"t": round(self.now(), 6), "kind": kind, **record}
+        self.fp.write(json.dumps(record) + "\n")
+        self.fp.flush()
+
+
+class BufferExporter:
+    """In-memory exporter (tests, report post-processing): same write()
+    surface as JsonlExporter(stamp=True), records kept as dicts."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.records: List[dict] = []
+
+    def now(self) -> float:
+        return time.perf_counter() - self.t0
+
+    def write(self, record: dict, kind: str = "metrics") -> None:
+        self.records.append({"t": round(self.now(), 6), "kind": kind,
+                             **record})

@@ -1,0 +1,163 @@
+"""Run-timeline merge + human report renderer (``obs report``).
+
+Consumes the JSONL records an Observability run emits (interval metrics,
+trace events, span begin/end — one shared monotonic clock, see trace.py) and
+renders one causally ordered story: interval throughput next to the fault
+events that explain its dips, plus the device phase histograms from the
+final summary.  ``scripts/obs_report.py`` is the CLI wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+FAULT_EVENTS = ("freeze", "thaw", "remove", "join", "suspect")
+
+
+def load_records(paths: Iterable[str]) -> List[dict]:
+    """Read + merge one or more obs JSONL files into a single timeline,
+    stably sorted by ``t`` (records from one file keep their write order —
+    the clock is monotonic per file).  Each record is tagged with a
+    ``_src`` file index so cumulative counters from different run logs are
+    never differenced against each other."""
+    recs: List[dict] = []
+    for src, path in enumerate(paths):
+        with open(path) as fp:
+            for line in fp:
+                line = line.strip()
+                if line:
+                    rec = json.loads(line)
+                    rec["_src"] = src
+                    recs.append(rec)
+    recs.sort(key=lambda r: r.get("t", 0.0))
+    return recs
+
+
+def interval_throughput(records: List[dict]) -> List[dict]:
+    """Per-interval commit/read rates from consecutive cumulative metrics
+    records (kind metrics/summary carrying ``commits``).  Counters are
+    cumulative per run log, so deltas are taken within each ``_src``
+    stream — a merged multi-file timeline never mixes streams."""
+    out = []
+    prev: dict = {}  # _src -> last metrics record of that stream
+    for r in records:
+        if r.get("kind") not in ("metrics", "summary") or "commits" not in r:
+            continue
+        p = prev.get(r.get("_src", 0))
+        if p is not None:
+            dc = r["commits"] - p["commits"]
+            dr = r.get("n_read", 0) - p.get("n_read", 0)
+            if dc < 0 or dr < 0 or r.get("steps", 0) < p.get("steps", 0):
+                # counter reset: a fresh runtime wrote into the same log
+                # (bench.py emits one summary per mix cell) — start a new
+                # segment instead of differencing unrelated runs
+                p = None
+        if p is not None:
+            dt = r["t"] - p["t"]
+            out.append(dict(
+                t0=p["t"], t1=r["t"],
+                commits=dc,
+                commits_per_s=round(dc / dt, 1) if dt > 0 else None,
+                reads=dr,
+            ))
+        prev[r.get("_src", 0)] = r
+    return out
+
+
+def _fmt_fields(r: dict, skip=("t", "kind", "name", "_src")) -> str:
+    return " ".join(f"{k}={v}" for k, v in r.items()
+                    if k not in skip and not isinstance(v, list))
+
+
+def _render_hist(counts: List[int], width: int = 40) -> List[str]:
+    from hermes_tpu.obs.metrics import percentile_from_counts
+
+    total = sum(counts)
+    lines = []
+    if total == 0:
+        return ["  (empty)"]
+    peak = max(counts)
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        bar = "#" * max(1, round(c / peak * width))
+        lines.append(f"  {i:>3} | {bar} {c}")
+    p50 = percentile_from_counts(counts, 0.5)
+    p99 = percentile_from_counts(counts, 0.99)
+    lines.append(f"  n={total} p50={p50} p99={p99} (bins are protocol"
+                 " rounds; last bin clips)")
+    return lines
+
+
+def render_report(records: List[dict], max_timeline: Optional[int] = None
+                  ) -> str:
+    """Human ``obs report``: kind census, fault-event list, merged
+    timeline with per-interval throughput, and the phase histograms from
+    the last record that carries them."""
+    by_kind: dict = {}
+    for r in records:
+        by_kind[r.get("kind", "?")] = by_kind.get(r.get("kind", "?"), 0) + 1
+    lines = ["== obs report =="]
+    if records:
+        span = records[-1].get("t", 0.0) - records[0].get("t", 0.0)
+        census = " ".join(f"{k}={v}" for k, v in sorted(by_kind.items()))
+        lines.append(f"{len(records)} records over {span:.3f}s ({census})")
+    else:
+        lines.append("no records")
+        return "\n".join(lines) + "\n"
+
+    faults = [r for r in records
+              if r.get("kind") == "event" and r.get("name") in FAULT_EVENTS]
+    lines.append("")
+    lines.append(f"-- membership / fault events ({len(faults)}) --")
+    for r in faults:
+        lines.append(f"  t={r['t']:9.3f}s {r['name']:<8} {_fmt_fields(r)}")
+    if not faults:
+        lines.append("  (none)")
+
+    ivals = interval_throughput(records)
+    ival_by_t1 = {iv["t1"]: iv for iv in ivals}
+
+    lines.append("")
+    lines.append("-- timeline --")
+    shown = records if max_timeline is None else records[-max_timeline:]
+    for r in shown:
+        kind = r.get("kind", "?")
+        if kind in ("metrics", "summary"):
+            iv = ival_by_t1.get(r.get("t"))
+            rate = (f" [{iv['commits_per_s']}/s over "
+                    f"{iv['t1'] - iv['t0']:.3f}s]" if iv else "")
+            core = " ".join(
+                f"{k}={r[k]}" for k in
+                ("steps", "commits", "n_read", "n_abort", "ops_per_sec")
+                if k in r)
+            lines.append(f"  t={r['t']:9.3f}s {kind:<10} {core}{rate}")
+        elif kind == "span_end":
+            lines.append(f"  t={r['t']:9.3f}s span       "
+                         f"{r.get('name')} dur={r.get('dur_s')}s "
+                         f"{_fmt_fields(r, skip=('t', 'kind', 'name', 'dur_s', '_src'))}")
+        elif kind == "span_begin":
+            continue  # the end record carries the duration
+        else:
+            lines.append(f"  t={r['t']:9.3f}s {kind:<10} "
+                         f"{r.get('name', '')} {_fmt_fields(r)}")
+
+    last_hists = None
+    for r in records:
+        if isinstance(r.get("lat_hist"), list) or isinstance(
+                r.get("qwait_hist"), list):
+            last_hists = r
+    lines.append("")
+    lines.append("-- phase histograms --")
+    if last_hists is None:
+        lines.append("  (no histogram-bearing record; run with hists=True "
+                     "intervals, e.g. cli --metrics-out)")
+    else:
+        for field, title in (("lat_hist", "commit latency (load->commit)"),
+                             ("qwait_hist", "ACK quorum-wait (issue->commit)")):
+            h = last_hists.get(field)
+            if isinstance(h, list):
+                lines.append(f"  {title}:")
+                lines.extend("  " + ln for ln in _render_hist(h))
+    return "\n".join(lines) + "\n"

@@ -33,7 +33,26 @@ from hermes_tpu.core import types as t
 from hermes_tpu.workload import ycsb
 
 
-class Runtime:
+class _ObsHooks:
+    """Shared observability surface of both run drivers (hermes_tpu.obs):
+    ``attach_obs`` installs the run's Observability context; fault-injection
+    and membership transitions emit point events on its timeline, drains and
+    rebases emit spans.  Interval metrics stay the caller's job (cli.py /
+    scripts poll ``counters()``/``stats.summarize`` at their own cadence).
+    Everything is a no-op while no obs context is attached."""
+
+    obs = None
+
+    def attach_obs(self, obs):
+        self.obs = obs
+        return obs
+
+    def _trace(self, name: str, **fields) -> None:
+        if self.obs is not None:
+            self.obs.tracer.event(name, step=self.step_idx, **fields)
+
+
+class Runtime(_ObsHooks):
     def __init__(
         self,
         cfg: HermesConfig,
@@ -91,9 +110,11 @@ class Runtime:
         """Failure injection: replica stops processing and emitting
         (config 4, BASELINE.json:10)."""
         self.frozen[replica] = True
+        self._trace("freeze", replica=replica)
 
     def thaw(self, replica: int) -> None:
         self.frozen[replica] = False
+        self._trace("thaw", replica=replica)
 
     def set_live(self, mask: int) -> None:
         """Membership change: new live bitmap, epoch bump everywhere (stale
@@ -109,6 +130,7 @@ class Runtime:
         after state transfer."""
         self.frozen[replica] = True
         self.set_live(int(self.live[0]) & ~(1 << replica))
+        self._trace("remove", replica=replica, live_mask=int(self.live[0]))
 
     def join(self, replica: int, from_replica: int) -> None:
         """Reconfiguration join (config 5, BASELINE.json:11): state transfer
@@ -133,6 +155,8 @@ class Runtime:
         self.rs = self.rs._replace(table=new_tbl)
         self.frozen[replica] = False
         self.set_live(int(self.live[0]) | (1 << replica))
+        self._trace("join", replica=replica, from_replica=from_replica,
+                    live_mask=int(self.live[0]))
         if self.membership is not None:
             self.membership.note_join(self, replica)
 
@@ -145,12 +169,23 @@ class Runtime:
 
     def step_once(self) -> None:
         ctl = self._ctl()
+        obs = self.obs
+        trace = obs is not None and obs.trace_steps
+        if trace:
+            td = obs.tracer.span_begin("step_dispatch", step=self.step_idx)
         if self._fused is not None:
             self.rs, comp = self._fused(self.rs, self.stream, ctl)
         else:
             self.rs, comp = self._host_step(ctl)
+        if trace:
+            obs.tracer.span_end("step_dispatch", td)
         if self.recorder is not None:
-            self.recorder.record_step(jax.device_get(comp))
+            if trace:
+                tr = obs.tracer.span_begin("readback", step=self.step_idx)
+            comp_np = jax.device_get(comp)
+            if trace:
+                obs.tracer.span_end("readback", tr)
+            self.recorder.record_step(comp_np)
         self.step_idx += 1
         if self.membership is not None:
             self.membership.poll(self)
@@ -183,6 +218,12 @@ class Runtime:
     def drain(self, max_steps: int = 10_000) -> bool:
         """Step until every session finished its stream and the network is
         empty; returns False if max_steps elapsed first."""
+        if self.obs is not None:
+            with self.obs.tracer.span("drain", step=self.step_idx):
+                return self._drain(max_steps)
+        return self._drain(max_steps)
+
+    def _drain(self, max_steps: int) -> bool:
         for _ in range(max_steps):
             status = np.asarray(jax.device_get(self.rs.sess.status))
             live0 = int(self.live[0])
@@ -223,14 +264,16 @@ class Runtime:
         ops = self.history_ops()
         if max_keys is not None:
             ops = lin.sample_keys(ops, max_keys=max_keys)
-        return lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)
+        v = lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)
+        self._trace("checker_verdict", ok=v.ok, keys_checked=v.keys_checked)
+        return v
 
 
 def _to_jnp(block):
     return jax.tree.map(jnp.asarray, block)
 
 
-class FastRuntime:
+class FastRuntime(_ObsHooks):
     """Run driver for the TPU-optimized round (core/faststep.py): same
     membership / failure-injection / history-recording surface as Runtime,
     over the packed-column FastState.  Backends: ``batched`` (R replicas on
@@ -321,9 +364,11 @@ class FastRuntime:
 
     def freeze(self, replica: int) -> None:
         self.frozen[replica] = True
+        self._trace("freeze", replica=replica)
 
     def thaw(self, replica: int) -> None:
         self.frozen[replica] = False
+        self._trace("thaw", replica=replica)
 
     def set_live(self, mask: int) -> None:
         self.live[:] = mask
@@ -332,6 +377,7 @@ class FastRuntime:
     def remove(self, replica: int) -> None:
         self.frozen[replica] = True
         self.set_live(int(self.live[0]) & ~(1 << replica))
+        self._trace("remove", replica=replica, live_mask=int(self.live[0]))
 
     def join(self, replica: int, from_replica: int) -> None:
         """Reconfiguration join (config 5, BASELINE.json:11): copy a live
@@ -370,6 +416,8 @@ class FastRuntime:
         # joiner's state, so no transfer is needed.
         self.frozen[replica] = False
         self.set_live(int(self.live[0]) | (1 << replica))
+        self._trace("join", replica=replica, from_replica=from_replica,
+                    live_mask=int(self.live[0]))
         if self.membership is not None:
             self.membership.note_join(self, replica)
 
@@ -384,7 +432,13 @@ class FastRuntime:
         hermes_tpu/launch.py) skip the completion fetch — the global arrays
         span non-addressable devices; use counters() (which allgathers) for
         observability there."""
+        obs = self.obs
+        trace = obs is not None and obs.trace_steps
+        if trace:
+            td = obs.tracer.span_begin("step_dispatch", step=self.step_idx)
         self.fs, comp = self._step(self.fs, self.stream, self._ctl())
+        if trace:
+            obs.tracer.span_end("step_dispatch", td)
         if jax.process_count() > 1:
             assert self.recorder is None, "history recording is single-host only"
             self.step_idx += 1
@@ -394,7 +448,11 @@ class FastRuntime:
             if self.membership is not None:
                 self.membership.poll(self)
             return None
+        if trace:
+            tr = obs.tracer.span_begin("readback", step=self.step_idx)
         comp_np = jax.device_get(comp)
+        if trace:
+            obs.tracer.span_end("readback", tr)
         if self._ver_base is not None:
             # re-anchor post-rebase versions into the global (monotone)
             # version space the recorder/checker needs (see rebase_versions)
@@ -443,6 +501,12 @@ class FastRuntime:
         is globally monotone even though on-device versions restart.
 
         Returns the number of keys rebased."""
+        if self.obs is not None:
+            with self.obs.tracer.span("rebase_versions", step=self.step_idx):
+                return self._rebase_versions(quiesce, max_quiesce_rounds)
+        return self._rebase_versions(quiesce, max_quiesce_rounds)
+
+    def _rebase_versions(self, quiesce: bool, max_quiesce_rounds: int) -> int:
         fst = self._fst
         if jax.process_count() > 1:
             raise NotImplementedError("rebase_versions is single-host only")
@@ -476,6 +540,12 @@ class FastRuntime:
             raise NotImplementedError(
                 "drain() polls per-step session status and is single-host "
                 "only; multi-host runs should use run(n_steps)")
+        if self.obs is not None:
+            with self.obs.tracer.span("drain", step=self.step_idx):
+                return self._drain(max_steps)
+        return self._drain(max_steps)
+
+    def _drain(self, max_steps: int) -> bool:
         for _ in range(max_steps):
             status = np.asarray(jax.device_get(self.fs.sess.status))
             live0 = int(self.live[0])
@@ -579,8 +649,11 @@ class FastRuntime:
         assert self.recorder is not None, "construct FastRuntime(record=True)"
         if isinstance(self.recorder, ArrayRecorder):
             self.recorder.finalize(self._sess_view())
-            return check_arrays(self.recorder, max_keys=max_keys)
-        ops = self.history_ops()
-        if max_keys is not None:
-            ops = lin.sample_keys(ops, max_keys=max_keys)
-        return lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)
+            v = check_arrays(self.recorder, max_keys=max_keys)
+        else:
+            ops = self.history_ops()
+            if max_keys is not None:
+                ops = lin.sample_keys(ops, max_keys=max_keys)
+            v = lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)
+        self._trace("checker_verdict", ok=v.ok, keys_checked=v.keys_checked)
+        return v

@@ -5,45 +5,76 @@ thread that prints ops/s and latency percentiles.  Here the counters are the
 device-side Meta columns (summed per step at zero cost); the host reads them
 off-device at reporting interval and derives throughput and the commit-latency
 distribution (in protocol steps, convertible to wall time via the measured
-step duration).  ``JsonlLogger`` writes one JSON object per interval, the
-rebuild's machine-readable metrics log."""
+step duration).
+
+This module is the thin summarize layer over those columns; the registry /
+exporter / tracing machinery lives in ``hermes_tpu.obs`` (``JsonlLogger``
+below is the back-compat shim over ``obs.metrics.JsonlExporter``).
+"""
 
 from __future__ import annotations
 
-import json
-import time
 from typing import IO, Optional
 
 import jax
 import numpy as np
 
-
-def percentile_from_hist(hist: np.ndarray, q: float) -> int:
-    """q in [0,1]; histogram bins are latency-in-steps (last bin = clip)."""
-    cum = hist.cumsum()
-    if cum[-1] == 0:
-        return -1
-    return int((cum >= q * cum[-1]).argmax())
+from hermes_tpu.obs.metrics import JsonlExporter, percentile_from_counts
 
 
-def summarize(meta, wall_s: Optional[float] = None, steps: Optional[int] = None) -> dict:
+def percentile_from_hist(hist: np.ndarray, q: float) -> Optional[int]:
+    """q in [0,1]; histogram bins are latency-in-steps (last bin = clip).
+    Returns None on an empty histogram — never a numeric sentinel that
+    silently poisons downstream JSON (``p50_commit_steps: -1``)."""
+    return percentile_from_counts(hist, q)
+
+
+def summarize(meta, wall_s: Optional[float] = None, steps: Optional[int] = None,
+              hists: bool = False) -> dict:
+    """One metrics record from a Meta pytree (batched (R, ...) or
+    per-replica).  Percentile fields are omitted when their histogram is
+    empty; phase-metric fields (obs pillar 1) are included whenever any
+    replica recorded them (faststep under cfg.phase_metrics — the phases
+    engine leaves them 0).  ``hists=True`` attaches the raw histogram
+    arrays, which scripts/obs_report.py renders."""
     m = jax.device_get(meta)
-    hist = np.asarray(m.lat_hist)
-    if hist.ndim > 1:
-        hist = hist.sum(axis=0)
-    commits = int(np.asarray(m.n_write).sum() + np.asarray(m.n_rmw).sum())
+
+    def tot(field):
+        return int(np.asarray(getattr(m, field)).sum())
+
+    def hist_of(field):
+        h = np.asarray(getattr(m, field))
+        return h.sum(axis=0) if h.ndim > 1 else h
+
+    hist = hist_of("lat_hist")
+    commits = tot("n_write") + tot("n_rmw")
     out = dict(
-        n_read=int(np.asarray(m.n_read).sum()),
-        n_write=int(np.asarray(m.n_write).sum()),
-        n_rmw=int(np.asarray(m.n_rmw).sum()),
-        n_abort=int(np.asarray(m.n_abort).sum()),
+        n_read=tot("n_read"),
+        n_write=tot("n_write"),
+        n_rmw=tot("n_rmw"),
+        n_abort=tot("n_abort"),
         commits=commits,
-        p50_commit_steps=percentile_from_hist(hist, 0.5),
-        p99_commit_steps=percentile_from_hist(hist, 0.99),
         mean_commit_steps=(
-            float(np.asarray(m.lat_sum).sum()) / max(1, int(np.asarray(m.lat_cnt).sum()))
+            float(np.asarray(m.lat_sum).sum()) / max(1, tot("lat_cnt"))
         ),
     )
+    for q, tag in ((0.5, "p50"), (0.99, "p99")):
+        p = percentile_from_hist(hist, q)
+        if p is not None:
+            out[f"{tag}_commit_steps"] = p
+    qhist = hist_of("qwait_hist") if hasattr(m, "qwait_hist") else None
+    if hasattr(m, "n_inv") and tot("n_inv"):
+        out.update(
+            n_inv=tot("n_inv"),
+            n_rebcast=tot("n_rebcast"),
+            n_nack=tot("n_nack"),
+            n_retry=tot("n_retry"),
+            replay_peak=int(np.asarray(m.replay_peak).max()),
+        )
+        for q, tag in ((0.5, "p50"), (0.99, "p99")):
+            p = percentile_from_hist(qhist, q)
+            if p is not None:
+                out[f"{tag}_qwait_steps"] = p
     if wall_s:
         out["wall_s"] = round(wall_s, 4)
         out["writes_per_sec"] = round(commits / wall_s, 1)
@@ -52,17 +83,20 @@ def summarize(meta, wall_s: Optional[float] = None, steps: Optional[int] = None)
         out["steps"] = steps
         if wall_s:
             out["step_us"] = round(wall_s / steps * 1e6, 1)
+    if hists:
+        out["lat_hist"] = hist.astype(int).tolist()
+        if qhist is not None:
+            out["qwait_hist"] = qhist.astype(int).tolist()
     return out
 
 
 class JsonlLogger:
-    """Interval metrics to a JSONL stream (one object per report)."""
+    """Back-compat interval logger: one JSON object per report, now routed
+    through the obs exporter (every record gains the shared ``t``/``kind``
+    schema the obs timeline tools consume)."""
 
     def __init__(self, fp: IO[str]):
-        self.fp = fp
-        self.t0 = time.perf_counter()
+        self._exp = JsonlExporter(fp)
 
     def log(self, record: dict) -> None:
-        record = dict(record, t=round(time.perf_counter() - self.t0, 4))
-        self.fp.write(json.dumps(record) + "\n")
-        self.fp.flush()
+        self._exp.write(dict(record), kind="metrics")

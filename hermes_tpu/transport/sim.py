@@ -37,11 +37,19 @@ def lockstep_schedule(kind: str, src: int, dst: int, step: int) -> Sequence[int]
 
 
 class SimTransport:
-    """Host-side adversarial network between vmapped protocol phases."""
+    """Host-side adversarial network between vmapped protocol phases.
 
-    def __init__(self, n_replicas: int, schedule: Schedule = lockstep_schedule):
+    ``registry`` (optional ``hermes_tpu.obs.MetricsRegistry``) makes the
+    adversarial schedule itself observable: per-kind send / dropped /
+    duplicated / delayed counters plus the in-flight queue gauge, so a chaos
+    soak's metrics record says HOW hostile the network actually was, not
+    just how the protocol fared under it."""
+
+    def __init__(self, n_replicas: int, schedule: Schedule = lockstep_schedule,
+                 registry=None):
         self.r = n_replicas
         self.schedule = schedule
+        self.registry = registry
         # (kind, src, dst) -> deque of (deliver_step, block-dict of numpy arrays)
         self.chan: Dict[Tuple[str, int, int], collections.deque] = collections.defaultdict(
             collections.deque
@@ -50,7 +58,18 @@ class SimTransport:
     # -- helpers -----------------------------------------------------------
 
     def _send(self, kind: str, src: int, dst: int, step: int, block: dict) -> None:
-        for when in self.schedule(kind, src, dst, step):
+        whens = list(self.schedule(kind, src, dst, step))
+        reg = self.registry
+        if reg is not None:
+            reg.counter(f"net_{kind}_sends").inc()
+            if not whens:
+                reg.counter(f"net_{kind}_dropped").inc()
+            elif len(whens) > 1:
+                reg.counter(f"net_{kind}_duplicated").inc(len(whens) - 1)
+            late = sum(1 for w in whens if w > step)
+            if late:
+                reg.counter(f"net_{kind}_delayed").inc(late)
+        for when in whens:
             assert when >= step, "cannot deliver into the past"
             self.chan[(kind, src, dst)].append((when, block))
 
@@ -59,8 +78,10 @@ class SimTransport:
         overlay earlier)."""
         q = self.chan[(kind, src, dst)]
         merged = None
+        delivered = 0
         while q and q[0][0] <= step:
             blk = q.popleft()[1]
+            delivered += 1
             if merged is None:
                 merged = dict(blk)
                 continue
@@ -75,6 +96,8 @@ class SimTransport:
                 else:
                     merged[f] = np.where(v, arr, merged[f])
             merged["valid"] = merged["valid"] | v
+        if delivered and self.registry is not None:
+            self.registry.counter(f"net_{kind}_delivered").inc(delivered)
         return merged
 
     def _exchange_bcast(self, kind: str, out, step: int):
@@ -126,4 +149,7 @@ class SimTransport:
         return out_ack._replace(**inb)
 
     def pending(self) -> int:
-        return sum(len(q) for q in self.chan.values())
+        n = sum(len(q) for q in self.chan.values())
+        if self.registry is not None:
+            self.registry.gauge("net_pending_blocks").set(n)
+        return n

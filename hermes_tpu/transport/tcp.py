@@ -22,8 +22,8 @@ _SO = _NATIVE_DIR / "libhermes_tcp.so"
 _SRC = _NATIVE_DIR / "tcp_transport.cpp"
 
 
-def _ensure_built() -> pathlib.Path:
-    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+def _ensure_built(force: bool = False) -> pathlib.Path:
+    if not force and _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
         return _SO
     # Atomic build: compile to a unique temp path, rename into place — many
     # replica processes may race here on a fresh checkout, and a rank must
@@ -39,10 +39,18 @@ def _ensure_built() -> pathlib.Path:
 
 
 class TcpMesh:
-    """Full-mesh, step-synchronous block exchange between replica processes."""
+    """Full-mesh, step-synchronous block exchange between replica processes.
 
-    def __init__(self, my_rank: int, n_ranks: int, hosts: str | None = None, base_port: int = 29500):
-        lib = ctypes.CDLL(str(_ensure_built()))
+    ``registry`` (optional ``hermes_tpu.obs.MetricsRegistry``) counts
+    exchanges and wire bytes per rank — the distributed driver's transport
+    feed into the obs metrics snapshot."""
+
+    def __init__(self, my_rank: int, n_ranks: int, hosts: str | None = None,
+                 base_port: int = 29500, registry=None):
+        from hermes_tpu.core.compat import load_native
+
+        self.registry = registry
+        lib = load_native(_ensure_built)
         lib.ht_create.restype = ctypes.c_void_p
         lib.ht_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
         lib.ht_exchange.restype = ctypes.c_int
@@ -77,6 +85,13 @@ class TcpMesh:
         )
         if rc != 0:
             raise RuntimeError("tcp exchange failed (peer closed?)")
+        if self.registry is not None:
+            self.registry.counter("net_tcp_exchanges").inc()
+            # every exchange moves one block per non-self peer, both ways
+            self.registry.counter("net_tcp_bytes_sent").inc(
+                int(out.shape[1]) * (self.n_ranks - 1))
+            self.registry.counter("net_tcp_bytes_recv").inc(
+                int(out.shape[1]) * (self.n_ranks - 1))
         return inb
 
     def close(self) -> None:

@@ -27,11 +27,21 @@ def main() -> None:
     ap.add_argument("--polls", type=int, default=16)
     ap.add_argument("--rounds-per-poll", type=int, default=50)
     ap.add_argument("--out", default="REBASE_SOAK.json")
+    ap.add_argument("--metrics-out", default=None, metavar="RUN_JSONL",
+                    help="obs run log: stamped per-poll metrics + rebase "
+                    "spans (scripts/obs_report.py renders the timeline)")
     args = ap.parse_args()
+
+    # the legacy stdout/stderr contract lines ride the unstamped exporter,
+    # byte-identical to the print(json.dumps(...)) they replace
+    from hermes_tpu.obs.metrics import JsonlExporter
+
+    out = JsonlExporter(sys.stdout, stamp=False)
+    err = JsonlExporter(sys.stderr, stamp=False)
 
     ok, info = bench.probe_backend(180.0)
     if not ok:
-        print(json.dumps({"error": info}))
+        out.write({"error": info})
         sys.exit(1)
 
     import jax
@@ -40,6 +50,11 @@ def main() -> None:
 
     cfg = bench._cfg("zipfian")  # production depth: sort + chain 2048
     rt = FastRuntime(cfg)
+    obs = None
+    if args.metrics_out:
+        from hermes_tpu.obs import Observability
+
+        obs = rt.attach_obs(Observability(path=args.metrics_out))
     # telemetry-only run: skip the per-round completion fetch (tens of MB
     # per round at bench shape through the tunneled link)
     rt.fetch_completions = False
@@ -53,7 +68,9 @@ def main() -> None:
             rebases=rt.rebases,
             commits=int(c["n_write"] + c["n_rmw"]),
         ))
-        print(json.dumps(traj[-1]), file=sys.stderr, flush=True)
+        err.write(traj[-1])
+        if obs is not None:
+            obs.interval(traj[-1])
     wall = time.perf_counter() - t0
 
     total_rounds = args.polls * args.rounds_per_poll
@@ -73,7 +90,7 @@ def main() -> None:
     # true high-water marks: the poll-sampled values PLUS the value that
     # triggered each rebase (the peak a poll otherwise never sees)
     peaks = [t["max_ver"] for t in traj] + rt.prerebase_peaks
-    out = dict(
+    summary = dict(
         mix="zipfian", chain_writes=cfg.chain_writes,
         rounds=total_rounds, wall_s=round(wall, 1),
         rebases=rt.rebases,
@@ -88,10 +105,13 @@ def main() -> None:
         platform=jax.devices()[0].platform,
     )
     with open(args.out, "w") as f:
-        json.dump(out, f, indent=1)
-    print(json.dumps({k: v for k, v in out.items() if k != "trajectory"}))
-    if not (out["rebases"] >= 1 and out["budget_crossed"]
-            and out["watermark_stayed_under_budget"]):
+        json.dump(summary, f, indent=1)
+    if obs is not None:
+        obs.summary({k: v for k, v in summary.items() if k != "trajectory"})
+        obs.close()
+    out.write({k: v for k, v in summary.items() if k != "trajectory"})
+    if not (summary["rebases"] >= 1 and summary["budget_crossed"]
+            and summary["watermark_stayed_under_budget"]):
         sys.exit(1)
 
 

@@ -41,6 +41,11 @@ def test_bench_main_outage_contract():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "tpu"  # not installed here -> init fails fast
     env["PALLAS_AXON_POOL_IPS"] = ""  # never touch the real chip from tests
+    # bench's default probe budget (180 s) exceeds this test's own kill
+    # timer: if the probe child BLOCKS instead of failing fast (seen when
+    # /tmp/libtpu_lockfile is contended by a sibling test's subprocess),
+    # the contract line must still beat our timeout — cap the probe budget
+    env["HERMES_BENCH_PROBE_TIMEOUT"] = "45"
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
     p = subprocess.run(
@@ -53,7 +58,10 @@ def test_bench_main_outage_contract():
     rec = json.loads(lines[0])
     assert rec["metric"] == "committed_writes_per_sec"
     assert rec["value"] == 0.0 and rec["vs_baseline"] == 0.0
-    assert "backend init failed" in rec["error"]
+    # "backend init failed rc=..." when the probe child fails fast;
+    # "backend init did not complete within ..." when it wedges on a
+    # contended libtpu lockfile — both are the diagnosable contract
+    assert "backend init" in rec["error"]
 
 
 def test_entry_probe_fails_fast_on_dead_backend():

@@ -1,0 +1,276 @@
+"""Unified observability layer (hermes_tpu/obs + the Meta phase columns).
+
+Pins the three pillars: (1) the registry/exporter machinery (metric types,
+get-or-create semantics, Prometheus snapshot, the byte-compatible unstamped
+JSONL mode), (2) the obs run-log schema — every record carries ``t`` and
+``kind`` with non-decreasing ``t`` — and (3) the fault-event timeline: a
+freeze/thaw cycle appears as ordered events bracketing the throughput dip.
+Also the percentile sentinel regression (empty histogram -> None, field
+omitted from summarize output — never ``-1`` poisoning downstream JSON).
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from hermes_tpu import stats
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import faststep as fst
+from hermes_tpu.core import state as st
+from hermes_tpu.obs import (
+    BufferExporter,
+    Histogram,
+    JsonlExporter,
+    MetricsRegistry,
+    Observability,
+    percentile_from_counts,
+    prometheus_text,
+)
+from hermes_tpu.obs import report as report_lib
+from hermes_tpu.runtime import FastRuntime
+from hermes_tpu.transport.sim import SimTransport
+
+
+def small_cfg(**kw):
+    base = dict(
+        n_replicas=3, n_keys=256, n_sessions=16, replay_slots=8,
+        ops_per_session=32,
+        workload=WorkloadConfig(read_frac=0.5, seed=7),
+    )
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+# --- pillar 2: registry + exporters ----------------------------------------
+
+
+def test_registry_get_or_create_and_type_guard():
+    reg = MetricsRegistry()
+    c = reg.counter("commits")
+    c.inc()
+    c.inc(4)
+    assert reg.counter("commits").value == 5  # same object back
+    reg.gauge("depth").set(17)
+    h = reg.histogram("lat", bins=8)
+    h.observe(3)
+    h.observe(100)  # clips into the last bin
+    assert h.total == 2 and h.counts[7] == 1
+    with pytest.raises(TypeError):
+        reg.gauge("commits")
+    with pytest.raises(TypeError):
+        reg.histogram("depth")
+
+
+def test_registry_snapshot_derives_percentiles_and_omits_empty():
+    reg = MetricsRegistry()
+    reg.counter("n").set_total(42)
+    reg.histogram("lat", bins=4).observe(1, n=10)
+    reg.histogram("empty", bins=4)
+    snap = reg.snapshot()
+    assert snap["n"] == 42
+    assert snap["lat_p50"] == 1 and snap["lat_p99"] == 1
+    assert "empty_p50" not in snap and "empty_p99" not in snap
+    json.dumps(snap)  # JSON-clean
+
+
+def test_histogram_set_counts_rejects_wrong_bins():
+    h = Histogram("x", bins=4)
+    with pytest.raises(ValueError):
+        h.set_counts(np.zeros(8, np.int64))
+
+
+def test_prometheus_text_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("ops", help="total ops").inc(3)
+    reg.gauge("depth").set(2)
+    reg.histogram("lat", bins=3).observe(1, n=2)
+    text = prometheus_text(reg)
+    assert "# TYPE ops counter\nops 3" in text
+    assert "# TYPE depth gauge\ndepth 2" in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 2' in text and "lat_count 2" in text
+
+
+def test_unstamped_exporter_is_byte_compatible_with_json_dumps():
+    buf = io.StringIO()
+    rec = {"metric": "committed_writes_per_sec", "value": 1.5, "none": None}
+    JsonlExporter(buf, stamp=False).write(rec)
+    assert buf.getvalue() == json.dumps(rec) + "\n"
+
+
+# --- percentile sentinel regression (satellite 1) --------------------------
+
+
+def test_percentile_empty_hist_returns_none_not_sentinel():
+    assert percentile_from_counts(np.zeros(16, np.int64), 0.5) is None
+    assert stats.percentile_from_hist(np.zeros(st.LAT_BINS), 0.99) is None
+    h = np.zeros(16, np.int64)
+    h[3] = 1
+    assert percentile_from_counts(h, 0.5) == 3
+
+
+def test_summarize_omits_percentiles_on_empty_histogram():
+    cfg = small_cfg()
+    meta = fst.init_fast_state(cfg).meta  # all-zero: nothing committed yet
+    rec = stats.summarize(meta)
+    assert "p50_commit_steps" not in rec and "p99_commit_steps" not in rec
+    assert rec["commits"] == 0
+    json.dumps(rec)
+
+
+# --- pillar 1: device-side phase metrics -----------------------------------
+
+
+def test_phase_metrics_populate_and_do_not_change_behavior():
+    import jax
+
+    base_cols = ("n_read", "n_write", "n_rmw", "n_abort",
+                 "lat_sum", "lat_cnt", "lat_hist", "max_pts")
+    metas = {}
+    for on in (True, False):
+        rt = FastRuntime(small_cfg(phase_metrics=on))
+        assert rt.drain(400)
+        metas[on] = jax.device_get(rt.fs.meta)
+    m_on, m_off = metas[True], metas[False]
+    for f in base_cols:
+        assert np.array_equal(np.asarray(getattr(m_on, f)),
+                              np.asarray(getattr(m_off, f))), f
+    assert int(np.asarray(m_on.n_inv).sum()) > 0
+    assert int(np.asarray(m_on.qwait_hist).sum()) == int(
+        np.asarray(m_on.n_write).sum() + np.asarray(m_on.n_rmw).sum())
+    for f in ("n_inv", "n_rebcast", "n_nack", "n_retry", "replay_peak",
+              "qwait_sum", "qwait_hist"):
+        assert not np.asarray(getattr(m_off, f)).any(), f
+    rec = stats.summarize(m_on)
+    assert rec["n_inv"] > 0 and "p50_qwait_steps" in rec
+
+
+# --- pillar 3: run-log schema + fault timeline -----------------------------
+
+
+def test_obs_jsonl_schema_t_and_kind_monotonic(tmp_path):
+    path = tmp_path / "run.jsonl"
+    cfg = small_cfg()
+    rt = FastRuntime(cfg, record=True)
+    obs = rt.attach_obs(Observability(path=str(path), trace_steps=True))
+    rt.run(3)
+    rt.freeze(1)
+    rt.thaw(1)
+    obs.interval(stats.summarize(rt.fs.meta, wall_s=0.1, steps=3))
+    assert rt.drain(400)
+    v = rt.check()
+    assert v.ok
+    obs.summary(stats.summarize(rt.fs.meta, hists=True))
+    obs.close()
+
+    records = report_lib.load_records([str(path)])
+    assert len(records) > 6
+    last_t = 0.0
+    for r in records:
+        assert "t" in r and "kind" in r, r
+        assert r["t"] >= last_t, "t must be non-decreasing"
+        last_t = r["t"]
+    kinds = {r["kind"] for r in records}
+    assert {"event", "metrics", "summary", "span_begin",
+            "span_end"} <= kinds
+    names = [r.get("name") for r in records if r["kind"] == "event"]
+    assert "freeze" in names and "thaw" in names
+    assert "checker_verdict" in names
+    # drain ran under a span; per-step spans carry matched begin/end
+    spans = [r["name"] for r in records if r["kind"] == "span_end"]
+    assert "drain" in spans and "step_dispatch" in spans
+
+
+def test_fault_timeline_orders_freeze_thaw_around_dip():
+    """A frozen replica blocks the ack quorum: commits stall between the
+    freeze and thaw events, and recover after — in ONE ordered record
+    stream (the 'what did the cluster look like' story)."""
+    cfg = small_cfg(n_sessions=8, ops_per_session=64, wrap_stream=True)
+    rt = FastRuntime(cfg)
+    obs = rt.attach_obs(Observability())  # in-memory sink
+
+    def commits_now():
+        import jax
+
+        m = jax.device_get(rt.fs.meta)
+        return int(np.asarray(m.n_write).sum() + np.asarray(m.n_rmw).sum())
+
+    def tick(n):
+        rt.run(n)
+        obs.interval({"commits": commits_now(), "step": rt.step_idx})
+
+    tick(10)
+    before = commits_now()
+    assert before > 0
+    rt.freeze(2)
+    tick(10)
+    during = commits_now()
+    rt.thaw(2)
+    tick(15)
+    after = commits_now()
+
+    assert during == before, "commits must stall while the quorum is broken"
+    assert after > during, "commits must recover after thaw"
+
+    recs = obs.records
+    order = [(r["kind"], r.get("name")) for r in recs]
+    i_freeze = order.index(("event", "freeze"))
+    i_thaw = order.index(("event", "thaw"))
+    assert i_freeze < i_thaw
+    # one metrics record strictly between freeze and thaw, one after thaw
+    between = [r for r in recs[i_freeze + 1:i_thaw] if r["kind"] == "metrics"]
+    post = [r for r in recs[i_thaw + 1:] if r["kind"] == "metrics"]
+    assert between and between[-1]["commits"] == before
+    assert post and post[-1]["commits"] == after
+    ts = [r["t"] for r in recs]
+    assert ts == sorted(ts)
+
+
+def test_report_renders_faults_throughput_and_histograms():
+    exp = BufferExporter()
+    exp.write({"commits": 100, "steps": 10}, kind="metrics")
+    exp.write({"name": "freeze", "step": 12, "replica": 1}, kind="event")
+    exp.write({"commits": 100, "steps": 20}, kind="metrics")
+    exp.write({"name": "thaw", "step": 25, "replica": 1}, kind="event")
+    hist = [0] * st.LAT_BINS
+    hist[0], hist[2] = 90, 10
+    exp.write({"commits": 250, "steps": 40, "lat_hist": hist,
+               "qwait_hist": hist}, kind="summary")
+    out = report_lib.render_report(exp.records)
+    assert "freeze" in out and "thaw" in out
+    assert "membership / fault events (2)" in out
+    assert "commit latency" in out and "ACK quorum-wait" in out
+    assert "p50=0" in out
+    ivals = report_lib.interval_throughput(exp.records)
+    assert [iv["commits"] for iv in ivals] == [0, 150]
+
+
+# --- transport registry feed -----------------------------------------------
+
+
+def test_sim_transport_feeds_registry_drop_dup_counts():
+    reg = MetricsRegistry()
+
+    def chaos(kind, src, dst, step):
+        if kind == "inv" and dst == 1:
+            return []  # drop every INV into replica 1
+        if kind == "ack" and src == 0:
+            return [step, step + 1]  # duplicate ACKs out of replica 0
+        return [step]
+
+    from hermes_tpu.runtime import Runtime
+
+    cfg = small_cfg(n_keys=64, n_sessions=4, ops_per_session=8)
+    tr = SimTransport(cfg.n_replicas, schedule=chaos, registry=reg)
+    rt = Runtime(cfg, backend="sim", transport=tr, record=True)
+    rt.run(12)
+    assert reg.counter("net_inv_sends").value > 0
+    assert reg.counter("net_inv_dropped").value > 0
+    assert reg.counter("net_ack_duplicated").value > 0
+    assert reg.counter("net_inv_delivered").value > 0
+    tr.pending()
+    assert "net_pending_blocks" in reg
+    snap = reg.snapshot()
+    assert snap["net_inv_sends"] >= snap["net_inv_dropped"]
