@@ -336,6 +336,11 @@ def main() -> None:
     ap.add_argument("--metrics-out", default=None, metavar="RUN_JSONL",
                     help="additionally write every measured cell to an obs "
                     "run log (stamped t/kind schema; scripts/obs_report.py)")
+    ap.add_argument("--profile-out", default=None, metavar="PROFILE_JSONL",
+                    help="additionally write each measured mix's op census "
+                    "+ cost-model pricing + measured round time as obs "
+                    "profile records (hermes_tpu.obs.profile; abstract "
+                    "lowering, no extra device work)")
     ap.add_argument("--probe-timeout", type=float, default=float(
         os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
     args = ap.parse_args()
@@ -375,11 +380,28 @@ def main() -> None:
 
     mixes = MIXES if args.mix == "all" else (args.mix,)
     results = {}
+    profile_recs = []
     for mix in mixes:
         r = run_mix(mix)
         results[mix] = r
         cell(r)
         err.write(r)
+        if args.profile_out:
+            # fusion-level accountability for the measured number: the op
+            # census of the exact program just timed, plus the cost-model
+            # pricing of its sparse chain against the measured round time
+            # (lowering is host-side — the chip is not touched again)
+            from hermes_tpu.obs import profile as prof
+
+            profile_recs.append(prof.round_record(
+                prof.op_census(_cfg(mix)), mix=mix,
+                round_ms=round(r["round_us"] / 1e3, 3),
+                writes_per_sec=r["writes_per_sec"]))
+
+    if args.profile_out and profile_recs:
+        from hermes_tpu.obs import profile as prof
+
+        prof.export_profile(args.profile_out, profile_recs)
 
     if args.mix == "all":
         # latency operating point at three scales (round-3 verdict item 7):

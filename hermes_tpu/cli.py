@@ -41,9 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--wrap-stream", action="store_true",
                     help="cycle op streams forever (bench mode; use --steps)")
     ap.add_argument("--acceptance", default=None,
-                    choices=["1", "2", "2r", "3", "3c", "4", "5", "all"],
+                    choices=["1", "2", "2r", "3", "3c", "4", "5", "all",
+                             "all+variants"],
                     help="run BASELINE acceptance config N (1-5, or the 2r/3c"
-                    " variants) or all; "
+                    " variants); 'all' = the judged configs 1-5 (the baseline"
+                    " gate's exit code covers exactly those), 'all+variants'"
+                    " additionally runs the 2r/3c variants; "
                     "ignores most other flags")
     ap.add_argument("--scale", type=float, default=0.01,
                     help="acceptance size scale (1.0 = full 1M-key shape)")
@@ -85,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="R:FROM:TO",
                     help="failure injection: freeze replica R at step FROM, "
                     "thaw at step TO (repeatable; emits obs fault events)")
+    ap.add_argument("--profile-out", type=str, default=None,
+                    metavar="PROFILE_JSONL",
+                    help="write the run config's round op census + cost-model"
+                    " pricing as obs profile records (fast backends only; "
+                    "abstract lowering — adds no device work to the run)")
     return ap
 
 
@@ -107,6 +115,12 @@ def main(argv=None) -> int:
         ap.error("--arb-mode/--chain-writes/--no-auto-rebase/--rmw-retries "
                  "only affect the fast backends (core/faststep.py / runtime."
                  "FastRuntime); use --backend fast or fast-sharded")
+    if args.profile_out and args.backend not in ("fast", "fast-sharded"):
+        ap.error("--profile-out censuses the fast round (core/faststep.py); "
+                 "use --backend fast or fast-sharded")
+    if args.profile_out and args.acceptance:
+        ap.error("--profile-out does not apply to acceptance runs (they "
+                 "build their own configs); census a run config instead")
 
     from hermes_tpu import stats as stats_lib
     from hermes_tpu.config import HermesConfig, WorkloadConfig
@@ -115,7 +129,11 @@ def main(argv=None) -> int:
     if args.acceptance:
         from hermes_tpu import acceptance
 
-        which = ([1, 2, "2r", 3, "3c", 4, 5] if args.acceptance == "all"
+        # 'all' is the JUDGED set 1-5 (round-5 advice #3: the baseline
+        # gate's aggregate exit code must not fail on a non-judged variant)
+        which = ([1, 2, 3, 4, 5] if args.acceptance == "all"
+                 else [1, 2, "2r", 3, "3c", 4, 5]
+                 if args.acceptance == "all+variants"
                  else [args.acceptance if args.acceptance in ("2r", "3c")
                        else int(args.acceptance)])
         rc = 0
@@ -254,6 +272,13 @@ def main(argv=None) -> int:
     print(rec)
     if logger:
         logger.log(rec)
+
+    if args.profile_out:
+        from hermes_tpu.obs import profile as prof_mod
+
+        eng = "batched" if args.backend == "fast" else "sharded"
+        prof_mod.export_profile(args.profile_out, [prof_mod.round_record(
+            prof_mod.op_census(cfg, eng, mesh), backend=eng)])
 
     try:
         if args.check:

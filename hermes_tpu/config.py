@@ -95,6 +95,19 @@ class HermesConfig:
     # Both are protocol-equivalent (lowest eligible session wins a key).
     arb_mode: Literal["race", "sort"] = "race"
 
+    # Round-6 op diet: fuse the arbiter sort and the lane->slot compaction
+    # sort into ONE per-round lax.sort over the lane axis (sort arbiter
+    # only; see faststep._coordinate).  The fused key packs
+    # (band << 29) | sub — band 0 = waiting/replay, 1 = fresh-issue runs
+    # grouped by rotated key, 2 = ineligible — and lax.sort's stability
+    # preserves the arbiter's lowest-session-wins order within equal-key
+    # runs.  Each removed sort is ~1.8 ms of size-independent sparse-op
+    # cost per round on the target chip.  False restores the split
+    # two-sort program (the A/B cell scripts/fused_compare.py measures,
+    # and the fallback when the packed key cannot hold the shape —
+    # use_fused_sort is the resolved switch).
+    fused_sort: bool = True
+
     # Intra-round same-key write chaining (sort arbiter only): up to this
     # many of a replica's wanting sessions for ONE key issue per round as a
     # packed-ts chain (ver+1, ver+2, ..) and commit together — the hot-key
@@ -211,6 +224,17 @@ class HermesConfig:
     def n_lanes(self) -> int:
         """Outbound message lanes per replica: one per session + one per replay slot."""
         return self.n_sessions + self.replay_slots
+
+    @property
+    def use_fused_sort(self) -> bool:
+        """Resolved fused-sort switch (faststep._coordinate): the single
+        arbiter+compaction sort needs the sort arbiter and a packed key of
+        (band 2b | sub 29b) — sub holds the rotated key for issue runs and
+        the rotation index for waiting/replay lanes, so both n_keys
+        (config-enforced) and n_lanes must fit 29 bits.  Anything else
+        falls back to the split two-sort program."""
+        return (self.arb_mode == "sort" and self.fused_sort
+                and self.n_lanes <= (1 << 29))
 
     @property
     def lane_budget(self) -> int:

@@ -38,7 +38,8 @@ The key engineering moves, mapped to the reference:
      write, so a winner lands directly as VALID (committed this round) or
      INVALID (awaiting acks) — the reference's separate apply_inv/apply_val
      table writes collapse into a single scatter (the VAL message itself
-     still exists: slot bits, see FastVal).
+     still exists: slot bits over the round's own INV block, see
+     fast_round_sharded).
   4. **Lane compaction with rebroadcast backoff**: outbound INV lanes
      (sessions + replay slots, SURVEY.md §1 L1 "batching") compact to a
      fixed budget C per round, rotating priority so no lane starves; lanes
@@ -65,7 +66,7 @@ local supersession needs no separate detection pass.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -253,18 +254,32 @@ class FastInv(NamedTuple):
     only the issuing session ever broadcasts a ts for the first time);
     re-broadcast slots carry a ts whose row the table already holds.
     _apply_commit uses fresh to keep its one set-scatter free of
-    conflicting duplicate rows.  ``epoch``/``alive`` are per-block scalars
-    (a replica's whole batch shares one epoch — SURVEY.md §1 L4).
+    conflicting duplicate rows.  ``meta`` packs the per-block scalars
+    ``(epoch << 1) | alive`` into ONE word (a replica's whole batch shares
+    one epoch — SURVEY.md §1 L4), so the wire moves one collective operand
+    for both.
 
     One tensor instead of three (round-5, SHARDED_CENSUS.json): the
     lane->slot compaction costs ONE take_along (was 3 — each ~1.3-2.4 ms of
     size-independent sparse-op overhead on this chip) and the wire moves
     ONE all_gather operand (was 3); the field views below are dense
-    slice+elementwise, which XLA fuses into the consumers."""
+    slice+elementwise, which XLA fuses into the consumers.  Round-6 carried
+    the packing through the block scalars: the per-round sharded
+    collectives are the rows8 + meta all_gathers, the ack all_to_all and
+    the VAL-bit all_gather — the ACK/VAL epoch words ride the INV meta word
+    gathered the same round (epochs cannot change mid-round), so their
+    separate all_gathers are gone."""
 
     rows8: jnp.ndarray  # (..., C, 8+4V) int8 bytes of [pkf | pts | val]
-    epoch: jnp.ndarray  # (R,) / (R, Rsrc)
-    alive: jnp.ndarray
+    meta: jnp.ndarray  # (R,) / (R, Rsrc) int32 (epoch << 1) | alive
+
+    @property
+    def epoch(self):
+        return self.meta >> 1
+
+    @property
+    def alive(self):
+        return (self.meta & 1) != 0
 
     @property
     def pkf(self):
@@ -311,10 +326,13 @@ class FastAck(NamedTuple):
     conflict flag (ok=False: the INV lost to a higher ts — the RMW nack);
     ``pts`` echoes the acked timestamp.  The echo guarantees a delayed or
     stale ack can never mis-credit a different pending update.  One tensor
-    means one all_to_all on the wire (round-5; was 2)."""
+    means one all_to_all on the wire (round-5; was 2).  The acker's epoch
+    no longer rides along (round-6): the receiver checks it against the
+    INV meta word all-gathered the same round — same value, one fewer
+    collective.  (The VAL phase needs no block type at all: it is a bare
+    per-slot commit-bit tensor over the round's own INV slots.)"""
 
     rows8: jnp.ndarray  # (R, Rdst, C, 8) outbound / (R, Rsrc, C, 8) inbound
-    epoch: jnp.ndarray  # (R,) / (R, Rsrc)
 
     @property
     def pkf(self):
@@ -323,17 +341,6 @@ class FastAck(NamedTuple):
     @property
     def pts(self):
         return _bank_to_i32(self.rows8[..., 4:8])[..., 0]
-
-
-class FastVal(NamedTuple):
-    """VAL block: one bit per INV slot of the SAME round ("this slot's write
-    committed — validate its key").  key/ts live in the round's INV block;
-    fields stay for structural compatibility but are None in faststep."""
-
-    valid: jnp.ndarray  # (R, C) / (R, Rsrc, C)
-    key: Optional[jnp.ndarray]
-    pts: Optional[jnp.ndarray]
-    epoch: jnp.ndarray
 
 
 class FastState(NamedTuple):
@@ -432,6 +439,29 @@ class FastCtl(NamedTuple):
     # sessions in ~p99-commit rounds.  Traced scalar: flipping it does not
     # recompile.  (Default False keeps every existing construction site.)
     quiesce: jnp.ndarray = False
+
+
+def _run_issue(cfg: HermesConfig, first, in_run, sop, pos):
+    """Equal-key-run issue decision over a SORTED axis, shared by the fused
+    and split sort-arbiter paths (the one copy of the chain semantics — the
+    A/B baseline must not drift from the production program): the run head
+    always issues; with cfg.chain_writes up to chain_writes PLAIN writes
+    directly behind it join as a packed-ts chain, and an RMW blocks
+    chaining past it (its read-part must observe the immediately-preceding
+    value).  ``sop`` is the sorted op operand (only consulted when
+    chaining).  Entries outside runs are "bad" too, but cannot perturb the
+    test: in both paths they sort strictly before or strictly after every
+    run, so only a bad entry INSIDE the run can make last_bad >= start.
+    Returns (issue, rank) with rank=None when chaining is off."""
+    if not cfg.chain_writes:
+        return in_run & first, None
+    start = jax.lax.cummax(jnp.where(first, pos, -1), axis=1)
+    bad = sop != t.OP_WRITE
+    last_bad = jax.lax.cummax(jnp.where(bad, pos, -1), axis=1)
+    rank = pos - start
+    issue = in_run & (
+        first | (~bad & (last_bad < start) & (rank < cfg.chain_writes)))
+    return issue, jnp.where(issue, rank, 0)
 
 
 def _stream_idx(cfg: HermesConfig, op_idx):
@@ -593,10 +623,18 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # An issue requires the key VALID: any in-flight same-key write (its INV
     # applies the round it issues — see the revert rule below) holds the key
     # un-readable, so no duplicate-ts window exists.
+    #
+    # With cfg.use_fused_sort the arbitration happens INSIDE the single
+    # fused lane sort of the compaction block below (round-6 op diet: one
+    # lax.sort per round instead of two); the split paths here remain as
+    # the race arbiter and the fused-sort fallback/A-B baseline.
     want = (sess.status == t.S_ISSUE) & k_valid & ~frozen & ~ctl.quiesce
     idxs = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (R, S))
     chain_rank = jnp.zeros((R, S), jnp.int32)
-    if cfg.arb_mode == "sort":
+    win = None  # fused path: resolved by the lane sort below
+    if cfg.use_fused_sort:
+        pass
+    elif cfg.arb_mode == "sort":
         # lexicographic (key, session) sort per replica: the first entry of
         # each equal-key run (= the lowest wanting session, lax.sort is
         # stable) wins; ineligible sessions sort past K.  One sort + ONE
@@ -619,22 +657,15 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
                                       num_keys=1)
         else:
             sk, si = jax.lax.sort((skey, idxs), dimension=1, num_keys=1)
+            so = None
         first = jnp.concatenate(
             [jnp.ones((R, 1), bool), sk[:, 1:] != sk[:, :-1]], axis=1)
         in_run = sk < cfg.n_keys
+        issue, rank = _run_issue(cfg, first, in_run, so, idxs)
         if cfg.chain_writes:
-            pos = idxs  # iota along the sorted axis
-            start = jax.lax.cummax(jnp.where(first, pos, -1), axis=1)
-            bad = so != t.OP_WRITE  # RMW blocks chaining after it
-            last_bad = jax.lax.cummax(jnp.where(bad, pos, -1), axis=1)
-            rank = pos - start
-            issue = in_run & (
-                first
-                | (~bad & (last_bad < start) & (rank < cfg.chain_writes))
-            )
             packed = jnp.where(issue, (jnp.int32(1) << 20) | rank, 0)
         else:
-            packed = (first & in_run).astype(jnp.int32)
+            packed = issue.astype(jnp.int32)
         wz = jnp.zeros((R * S,), jnp.int32)
         p_flat = wz.at[_gkey(wz, si)].max(packed, mode="drop").reshape(R, S)
         win = want & (p_flat != 0)
@@ -653,7 +684,8 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
 
     flag = jnp.where(sess.op == t.OP_WRITE, t.FLAG_WRITE, t.FLAG_RMW)
     fc = (flag << 8) | ctl.my_cid[:, None]
-    new_pts = pack_pts(pts_ver(k_vpts) + 1 + chain_rank, fc)
+    # new_pts is minted after the compaction block: the fused sort resolves
+    # win/chain_rank there (dense formula either way, nothing reordered)
 
     # --- replay scan, cond-gated (SURVEY.md §3.4; only matters after
     # failures, so it runs every replay_scan_every rounds) ------------------
@@ -728,36 +760,119 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     infl = sess.status == t.S_INFL  # in-flight from earlier rounds
     backoff_ok = (step - sess.invoke_step) % cfg.rebroadcast_every == 0
     waiting = infl & backoff_ok
-    sess_elig = (win | waiting) & ~frozen
-    fresh_s = win & ~frozen
-    lane_elig = jnp.concatenate([sess_elig, replay.active & ~frozen], axis=1)
-    lane_fresh = jnp.concatenate(
-        [fresh_s, jnp.zeros_like(replay.active)], axis=1
-    )
     lane_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (R, L))
-    if C == L:
-        # budget covers every lane: slots ARE lanes, no compaction sort
-        slot_lane = lane_idx
-        taken_lane = lane_elig
-    else:
-        # Single-operand sort: one int32 packs (band | rotation | lane) —
-        # one sort buffer, and which lanes hold a slot falls out of a
-        # THRESHOLD test against the C-th smallest packed value (values are
-        # unique — the lane id is the low bits) instead of an inverse
-        # scatter.  Band (2b): 0 = waiting/replay, 1 = fresh, 2 = ineligible.
-        # The rotating anti-starvation tie-break is coarsened to the bits
-        # left between band and lane: rotation granularity 2^(lb-rb) lanes,
-        # with membership shifting by 127 lanes per round, so every lane
-        # still reaches the front of its band within O(L) rounds.
-        lb = max(1, (L - 1).bit_length())  # lane bits
-        rb = max(0, 31 - 2 - lb)  # rotation bits
+    if cfg.use_fused_sort:
+        # --- fused arbiter + compaction sort (round-6 op diet) ------------
+        # The arbiter's equal-key-run scan and the lane->slot compaction
+        # both order the SAME (R, L) lanes each round, so one lax.sort
+        # serves both.  Packed key (band << 29) | sub:
+        #   band 0 — waiting/replay lanes; sub = rotation index
+        #            (lane + 127*step) % L, unique per lane, so the
+        #            anti-starvation rotation is exact (the split path had
+        #            to coarsen it to spare bits);
+        #   band 1 — wanting sessions; sub = ROTATED key
+        #            (key + 127*step) % K: a per-round bijection on keys,
+        #            so equal-key runs stay contiguous (run detection and
+        #            chain ranks work unchanged) while run PRIORITY rotates
+        #            — under budget overflow every key still reaches the
+        #            front of its band within O(K) rounds;
+        #   band 2 — ineligible; never in a run, never takes a slot.
+        # lax.sort is stable, so within an equal-key run the original lane
+        # order — the session order — is preserved: the run head is the
+        # LOWEST wanting session, exactly the split arbiter's
+        # lowest-session-wins tie-break.  Slot ownership falls out of the
+        # rank among slot-eligible sorted entries (band 0 plus run
+        # winners/chain members) against the budget C — a dense cumsum,
+        # not a second sort — and everything routes back to lanes through
+        # the ONE permutation scatter the arbiter already paid, widened to
+        # also land each slot's owning lane id (slot_lane) for the sharded
+        # wire path.  Unfilled slots receive non-eligible lanes (never
+        # taken, so their wire rows carry valid=0), mirroring the split
+        # path's threshold behavior.
+        lane_key = jnp.concatenate([sess.key, replay.key], axis=1)
+        lane_want = jnp.concatenate(
+            [want, jnp.zeros_like(replay.active)], axis=1)
+        lane_wait = jnp.concatenate(
+            [waiting, replay.active], axis=1) & ~frozen
+        band = jnp.where(lane_wait, 0, jnp.where(lane_want, 1, 2))
         rot = (lane_idx + step * 127) % L
-        rotp = rot >> max(0, lb - rb)
-        band = jnp.where(lane_elig, jnp.where(lane_fresh, 1, 0), 2)
-        packed_own = (((band << min(rb, lb)) | rotp) << lb) | lane_idx
-        packed = jax.lax.sort(packed_own, dimension=1)
-        slot_lane = packed[:, :C] & ((1 << lb) - 1)  # (R, C) lane id per slot
-        taken_lane = lane_elig & (packed_own <= packed[:, C - 1 : C])
+        rkey = (lane_key + step * 127) % cfg.n_keys
+        sub = jnp.where(band == 0, rot, jnp.where(band == 1, rkey, 0))
+        lane_sop = jnp.concatenate(
+            [jnp.where(want, sess.op, 0), jnp.zeros_like(replay.key)],
+            axis=1)
+        sp, si, so = jax.lax.sort((((band << 29) | sub), lane_idx, lane_sop),
+                                  dimension=1, num_keys=1)
+        sband = sp >> 29
+        first = jnp.concatenate(
+            [jnp.ones((R, 1), bool), sp[:, 1:] != sp[:, :-1]], axis=1)
+        in_run = sband == 1
+        pos = lane_idx  # iota along the sorted axis
+        issue, rank_word = _run_issue(cfg, first, in_run, so, pos)
+        if rank_word is None:
+            rank_word = jnp.zeros((R, L), jnp.int32)
+        slot_elig = (sband == 0) | issue
+        cum = jnp.cumsum(slot_elig.astype(jnp.int32), axis=1)  # inclusive
+        staken = slot_elig & (cum <= C)
+        # slot rank: eligible entries take 0..n_elig-1 in priority order,
+        # non-eligible entries fill the remainder (their lanes are never
+        # taken — placeholder rows, valid=0 on the wire)
+        srank = jnp.where(slot_elig, cum - 1, cum[:, -1:] + pos - cum)
+        # ONE scatter, two regions of a (R, L+C) target: the per-lane
+        # verdict word [taken<<21 | issue<<20 | chain_rank] through the
+        # permutation, and each slot's owning lane id at L+srank.  Targets
+        # are unique (si is a permutation; srank is a bijection), so
+        # max == set.
+        word = ((staken.astype(jnp.int32) << 21)
+                | (issue.astype(jnp.int32) << 20) | rank_word)
+        gz = jnp.zeros((R * (L + C),), jnp.int32)
+        ridx = jnp.arange(R, dtype=jnp.int32)[:, None] * (L + C)
+        tgt = jnp.concatenate(
+            [ridx + si,
+             jnp.where(srank < C, ridx + L + srank, R * (L + C))], axis=1)
+        upd = jnp.concatenate([word, si], axis=1)
+        flat = gz.at[tgt].max(upd, mode="drop").reshape(R, L + C)
+        lane_word = flat[:, :L]
+        slot_lane = flat[:, L:]
+        taken_lane = (lane_word & (1 << 21)) != 0
+        win = want & ((lane_word[:, :S] & (1 << 20)) != 0)
+        if cfg.chain_writes:
+            chain_rank = jnp.where(win, lane_word[:, :S] & 0xFFFF, 0)
+        lane_fresh = jnp.concatenate(
+            [win, jnp.zeros_like(replay.active)], axis=1)
+    else:
+        sess_elig = (win | waiting) & ~frozen
+        fresh_s = win & ~frozen
+        lane_elig = jnp.concatenate(
+            [sess_elig, replay.active & ~frozen], axis=1)
+        lane_fresh = jnp.concatenate(
+            [fresh_s, jnp.zeros_like(replay.active)], axis=1
+        )
+        if C == L:
+            # budget covers every lane: slots ARE lanes, no compaction sort
+            slot_lane = lane_idx
+            taken_lane = lane_elig
+        else:
+            # Single-operand sort: one int32 packs (band | rotation | lane)
+            # — one sort buffer, and which lanes hold a slot falls out of a
+            # THRESHOLD test against the C-th smallest packed value (values
+            # are unique — the lane id is the low bits) instead of an
+            # inverse scatter.  Band (2b): 0 = waiting/replay, 1 = fresh,
+            # 2 = ineligible.  The rotating anti-starvation tie-break is
+            # coarsened to the bits left between band and lane: rotation
+            # granularity 2^(lb-rb) lanes, with membership shifting by 127
+            # lanes per round, so every lane still reaches the front of its
+            # band within O(L) rounds.
+            lb = max(1, (L - 1).bit_length())  # lane bits
+            rb = max(0, 31 - 2 - lb)  # rotation bits
+            rot = (lane_idx + step * 127) % L
+            rotp = rot >> max(0, lb - rb)
+            band = jnp.where(lane_elig, jnp.where(lane_fresh, 1, 0), 2)
+            packed_own = (((band << min(rb, lb)) | rotp) << lb) | lane_idx
+            packed = jax.lax.sort(packed_own, dimension=1)
+            slot_lane = packed[:, :C] & ((1 << lb) - 1)  # (R, C) slot lanes
+            taken_lane = lane_elig & (packed_own <= packed[:, C - 1 : C])
+    new_pts = pack_pts(pts_ver(k_vpts) + 1 + chain_rank, fc)
 
     # fresh issues that won arbitration AND hold a slot actually happen;
     # the rest revert (stay S_ISSUE) and retry next round
@@ -820,12 +935,12 @@ def _compact_out_inv(ctl: FastCtl, lanes: "LaneBlock", slot_lane, taken_lane):
     rows8 = jnp.concatenate([head8, lanes.val], axis=-1)  # (R, L, 8+4V)
     return FastInv(
         rows8=jnp.take_along_axis(rows8, slot_lane[..., None], axis=1),
-        epoch=ctl.epoch,
-        alive=~ctl.frozen,
+        meta=(ctl.epoch << 1) | (~ctl.frozen).astype(jnp.int32),
     )
 
 
-def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv):
+def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv,
+               replay_key):
     """Follower-side ``apply_inv()`` (BASELINE.json:5) over the SOURCE-shaped
     block ``inv_src`` (fields (Rsrc, C); epoch/alive (Rsrc,)): per-key winner
     + stale-drop + idempotent re-apply via one scatter-max on the packed ts.
@@ -850,10 +965,20 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv)
     fs = _apply_inv_arb(cfg, ctl, fs, inv_src)
     key0, pts0 = inv_src.key, inv_src.pts
     v_ok = inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
-    post0 = fs.table.vpts[key0]
+    # ONE post-arbiter gather serves BOTH consumers of the settled vpts
+    # (round-6 op diet): the per-slot verdicts below AND the replay
+    # supersession test in _collect_acks (the local replay slots' keys ride
+    # the same index vector — vpts is written only by the scatter-max
+    # above, so the value is final for the round).  Gathers are priced by
+    # COUNT, not extent, on this runtime.
+    nslot = key0.size
+    joint = fs.table.vpts[jnp.concatenate(
+        [key0.reshape(-1), replay_key.reshape(-1)])]
+    post0 = joint[:nslot].reshape(key0.shape)
+    replay_post = joint[nslot:].reshape(replay_key.shape)
     win0 = v_ok & (pts0 == post0)
     ack_flags = pts0 == post0  # (Rsrc, C): ok bit for every slot of every source
-    return fs, ack_flags, win0
+    return fs, ack_flags, win0, replay_post
 
 
 def _apply_inv_arb(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
@@ -1007,10 +1132,12 @@ def _wire_acks(cfg: HermesConfig, ctl: FastCtl, inv_src: FastInv, ack_flags,
     pkf = ((inv_src.key << 2) | (ack_flags.astype(jnp.int32) << 1)
            | ok.astype(jnp.int32))
     ack8 = _i32_to_bank(jnp.stack([pkf, inv_src.pts], axis=-1))
-    out_ack = FastAck(rows8=ack8[None], epoch=ctl.epoch)
+    out_ack = FastAck(rows8=ack8[None])
     in_ack = exchange_ack(out_ack)  # (1, Rsrc, C): each source's ack of MY slots
     Rs = in_ack.pkf.shape[1]
-    epoch_ok = (in_ack.epoch == ctl.epoch[:, None])[..., None]
+    # acker epochs ride the INV meta word all-gathered THIS round (epochs
+    # are fixed per round), so the ack block needs no epoch collective
+    epoch_ok = (inv_src.epoch[None, :] == ctl.epoch[:, None])[..., None]
     matched = (
         out_inv.valid[:, None, :] & ((in_ack.pkf & 1) == 1) & epoch_ok
         & ~ctl.frozen[:, None, None]
@@ -1042,7 +1169,7 @@ def _slot_to_lane_acks(cfg: HermesConfig, gained_slot, nacked_slot, slot_lane):
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
                   gained, nacked, taken_lane, read_done,
-                  read_extra, post_lane=None):
+                  read_extra, post_lane=None, replay_post=None):
     """Coordinator-side ``poll_acks()`` + commit + VAL build
     (BASELINE.json:5).  ``gained``/``nacked`` are per-LANE (R, L): derived
     directly there in batched mode (_derived_acks), routed back from the
@@ -1086,11 +1213,13 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # Replay-slot release: a slot whose key's shared arbiter moved past the
     # slot's ts was taken over by a newer write — that writer's VAL will
     # validate the key.  (post_lane already holds vpts[key] per lane in
-    # batched mode; the sharded path gathers it here.)
+    # batched mode; the sharded path rides its per-slot verdict gather —
+    # _apply_inv's joint index vector — so neither engine pays a separate
+    # gather here.)
     if post_lane is not None:
         rowns = replay.pts == post_lane[:, S:]
     else:
-        rowns = replay.pts == table.vpts[replay.key]
+        rowns = replay.pts == replay_post
 
     racks = jnp.where(replay.active, replay.acks | gained[:, S:], replay.acks)
     rcovered = ((racks | ~live) & full) == full
@@ -1215,19 +1344,22 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
      read_extra, sub_comps) = _coordinate(cfg, ctl, fs, stream)
     out_inv = _compact_out_inv(ctl, lanes, slot_lane, taken_lane)
     inv_src = jax.tree.map(_ici_gather_src, out_inv)
-    fs, ack_flags, win0 = _apply_inv(cfg, ctl, fs, inv_src)
+    fs, ack_flags, win0, replay_post = _apply_inv(cfg, ctl, fs, inv_src,
+                                                  fs.replay.key)
     gained_slot, nacked_slot = _wire_acks(
         cfg, ctl, inv_src, ack_flags, out_inv, _ici_route_back
     )
     gained, nacked = _slot_to_lane_acks(cfg, gained_slot, nacked_slot, slot_lane)
     fs, commit_lane, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
                                           taken_lane, read_done,
-                                          read_extra)
+                                          read_extra, replay_post=replay_post)
+    # VAL phase: a bare per-slot commit-bit tensor over THIS round's INV
+    # slots — receivers reconstruct (key, ts) from the INV block they hold,
+    # and the epoch check rides the INV meta word gathered above (one
+    # all_gather for the whole phase; round-6 collective diet)
     commit_at_slot = jnp.take_along_axis(commit_lane, slot_lane, axis=1)
-    out_val = FastVal(valid=commit_at_slot, key=None, pts=None, epoch=ctl.epoch)
-    val_bits = _ici_gather_src(out_val.valid)
-    val_epochs = _ici_gather_src(out_val.epoch)
-    fs = _apply_commit(cfg, ctl, fs, inv_src, win0, val_bits, val_epochs)
+    val_bits = _ici_gather_src(commit_at_slot)
+    fs = _apply_commit(cfg, ctl, fs, inv_src, win0, val_bits, inv_src.epoch)
     if sub_comps:
         comp = tuple(sub_comps) + (comp,)
     return fs, comp
@@ -1301,12 +1433,12 @@ def _ici_gather_src(x):
 
 def _ici_route_back(block):
     # out[p][0, q, ...] answers q's INVs; all_to_all on axis 1 delivers
-    # in[q][0, p, ...] = p's acks of q's slots.  1-D per-block scalars
-    # (epoch, local shape (1,)) ride an all_gather instead.
+    # in[q][0, p, ...] = p's acks of q's slots.  (The ack block is the
+    # single rows8 tensor since round-6 — the acker epochs ride the INV
+    # meta all_gather instead of a second collective here.)
     def one(x):
-        if x.ndim == 1:  # per-block epoch, local (1,) -> (1, Rsrc)
-            return jax.lax.all_gather(x[0], "replica", axis=0, tiled=False)[None]
-        return jax.lax.all_to_all(x, "replica", split_axis=1, concat_axis=1, tiled=True)
+        return jax.lax.all_to_all(x, "replica", split_axis=1, concat_axis=1,
+                                  tiled=True)
 
     return jax.tree.map(one, block)
 

@@ -2,7 +2,9 @@
 event-timeline tracing (SURVEY.md §5.5; the reference's stats thread,
 grown into a subsystem).
 
-Three pillars:
+Three pillars (plus the round-6 op-census/profiler module
+``hermes_tpu.obs.profile`` — imported explicitly, not re-exported here,
+since it pulls the engine modules in):
 
   1. **Device-side phase metrics** — the Meta columns (core/state.Meta):
      base op counters + the phase counters/histograms the fast round sums

@@ -1,0 +1,389 @@
+"""Per-fusion round profiler + StableHLO op census (hermes_tpu/obs).
+
+The engine's measured cost model (ARCHITECTURE.md "Sparse-op COUNT
+dominates") prices a protocol round as (#sparse ops on the chain) x
+~1.3-2.4 ms plus a dense tail, nearly independent of operand size — so the
+single number that predicts round time on the target chip is the OP CENSUS
+of the lowered program, and the way a refactor regresses the round is by
+quietly re-adding a gather/scatter/sort to the chain.  This module is the
+measurement half of the round-6 "op diet": it makes the census and the
+per-fusion cost attribution first-class obs artifacts so CI can police
+them (scripts/check_op_census.py, the same measure-then-gate pattern as
+scripts/check_obs_overhead.py).
+
+Three entry points:
+
+  * ``op_census(cfg, backend, mesh)`` — StableHLO op counts of ONE lowered
+    protocol round at cfg's shape (abstract lowering, nothing
+    materialized; backend-independent by construction).
+  * ``round_ledger(cfg, ...)`` — the per-fusion ledger: the batched round
+    ablated into its protocol fusions (coordinate / apply_inv /
+    acks+commit), each stage attributed the DELTA of sparse ops it adds
+    and (optionally) the measured ms-per-round delta of scan-chunked
+    timing, plus the full-round census.  Timing uses the honest protocol
+    for this runtime (force-synchronous readback first; see bench.py).
+  * ``check_budget(census_by_engine, budget)`` — the CI gate predicate:
+    every budgeted count must not exceed its checked-in ceiling
+    (OP_BUDGET.json at the repo root is the budget the gate script
+    enforces).
+
+Records export through the PR-1 obs run-log schema: ``export_profile``
+writes one JSONL record per ledger row via ``JsonlExporter(stamp=True)``
+(every record gets ``t`` + ``kind="profile"``), so scripts/obs_report.py
+and any JSONL consumer read profiles like any other obs stream.
+
+CLI (the promoted scripts/profile_round.py):
+
+    python -m hermes_tpu.obs.profile [S] [C] [--rounds N] [--reps N]
+        [--census-only] [--out PROFILE_JSONL]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import time
+from typing import Optional
+
+# the ops the TPU cost model prices individually (sparse chain) and the
+# wire collectives; everything else is the fused dense tail
+SPARSE = ("stablehlo.gather", "stablehlo.scatter", "stablehlo.sort",
+          "stablehlo.dynamic_gather")
+COLLECTIVE = ("stablehlo.all_gather", "stablehlo.all_to_all",
+              "stablehlo.collective_permute", "stablehlo.all_reduce")
+
+# ARCHITECTURE.md cost model (round-2, measured): ~1.3-2.4 ms per dynamic
+# sparse op.  Single source of truth — scripts/sharded_census.py's
+# projection and the ledger's modeled pricing both import from here.
+COST_LO, COST_MID, COST_HI = 1.3, 1.8, 2.4
+
+
+def census_text(txt: str) -> dict:
+    """Count the cost-model ops in StableHLO text (one lowered program)."""
+    counts: dict = {}
+    static_gathers = 0
+    for line in txt.splitlines():
+        m = re.search(r'= "?(stablehlo\.[a-z_]+)"?[( ]', line)
+        if not m:
+            continue
+        op = m.group(1)
+        if op == "stablehlo.gather" and "indices_are_sorted = true" in line:
+            # byte-plane extraction (faststep._bank_to_i32): a strided
+            # slice that jax lowers as a gather from STATIC iota indices
+            # (hence sorted+unique) — XLA fuses these like slices; they are
+            # not the ~1.3-2.4 ms dynamic sparse ops the cost model prices
+            static_gathers += 1
+            continue
+        counts[op] = counts.get(op, 0) + 1
+    out = {k: counts.get(k, 0) for k in SPARSE + COLLECTIVE}
+    out["static_strided_gathers"] = static_gathers
+    out["sparse_total"] = sum(counts.get(k, 0) for k in SPARSE)
+    out["collective_total"] = sum(counts.get(k, 0) for k in COLLECTIVE)
+    return out
+
+
+def _abstract_round_args(cfg, n_local=None):
+    import jax
+
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.workload import ycsb
+
+    fs = jax.eval_shape(lambda: fst.init_fast_state(cfg, n_local=n_local))
+    stream = jax.eval_shape(lambda: fst.prep_stream(ycsb.stub_stream(cfg)))
+    ctl = jax.eval_shape(lambda: fst.make_fast_ctl(cfg, 0))
+    return fs, stream, ctl
+
+
+def census_shape(cfg) -> dict:
+    """The config knobs that identify a census cell (the ``bench_shape`` /
+    ``shape`` section of every census artifact and ledger) — ONE place, so
+    adding a knob to the census identity cannot drift between the artifact
+    writers (scripts/sharded_census.py, scripts/check_op_census.py --update,
+    round_ledger)."""
+    return dict(n_replicas=cfg.n_replicas, n_keys=cfg.n_keys,
+                n_sessions=cfg.n_sessions, lane_budget=cfg.lane_budget,
+                value_words=cfg.value_words, chain_writes=cfg.chain_writes,
+                arb_mode=cfg.arb_mode, fused_sort=cfg.use_fused_sort)
+
+
+def op_census(cfg, backend: str = "batched", mesh=None) -> dict:
+    """StableHLO op counts of ONE protocol round at cfg's shape (abstract
+    lowering — nothing is materialized).  Backend-independent: the census
+    of the lowered program is the same on CPU and TPU, which is what lets
+    CI police the TPU cost model without a chip."""
+    from hermes_tpu.core import faststep as fst
+
+    if backend == "batched":
+        fn = fst.build_fast_batched(cfg)
+        n_local = None
+    elif backend == "sharded":
+        if mesh is None:
+            raise ValueError("sharded census needs a mesh")
+        fn = fst.build_fast_sharded(cfg, mesh, rounds=1, donate=False)
+        n_local = cfg.n_replicas
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    fs, stream, ctl = _abstract_round_args(cfg, n_local)
+    return census_text(fn.lower(fs, stream, ctl).as_text())
+
+
+# --------------------------------------------------------------------------
+# Per-fusion ledger (the promoted scripts/profile_round.py methodology)
+# --------------------------------------------------------------------------
+
+
+def _stage_fns(cfg):
+    """Ordered ablation prefixes of the batched round: each stage runs the
+    round UP TO a protocol fusion boundary, so consecutive deltas attribute
+    ops and time to the fusion added between them."""
+    from hermes_tpu.core import faststep as fst
+
+    def coordinate(ctl, fs, stream):
+        fs2, *_ = fst._coordinate(cfg, ctl, fs, stream)
+        return fs2
+
+    def apply_inv(ctl, fs, stream):
+        fs2, lanes, slot_lane, taken_lane, *_ = fst._coordinate(
+            cfg, ctl, fs, stream)
+        return fst._apply_inv_lanes(cfg, ctl, fs2, lanes, taken_lane)
+
+    def full(ctl, fs, stream):
+        nxt, _ = fst.fast_round_batched(cfg, ctl, fs, stream)
+        return nxt
+
+    return [
+        ("coordinate", coordinate),   # intake/reads/arbiter+compaction sort
+        ("apply_inv", apply_inv),     # + broadcast + ts scatter-max
+        ("acks_commit_val", full),    # + ack derivation + winner row write
+    ]
+
+
+def _scan_chunk(cfg, round_fn, rounds: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chunk(fs, stream, ctl):
+        def body(carry, off):
+            return round_fn(ctl._replace(step=ctl.step + off), carry, stream), None
+
+        fs, _ = jax.lax.scan(body, fs, jnp.arange(rounds, dtype=jnp.int32))
+        return fs
+
+    return chunk
+
+
+def _timed_chunk(cfg, chunk, rounds: int, reps: int) -> float:
+    """Median ms/round of a compiled scan chunk under the honest protocol
+    for this runtime: a readback first (execution through the tunneled PJRT
+    link is DEFERRED until the first device-to-host fetch), then timed
+    dispatches synced per rep."""
+    import jax
+
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.workload import ycsb
+
+    fs = jax.device_put(fst.init_fast_state(cfg))
+    # a REAL op stream (host-generated YCSB, same as the script this module
+    # replaces): stub_stream is all-NOP — shape-correct for the census but
+    # an idle round, which would make every timed cell a lie
+    stream = jax.device_put(fst.prep_stream(ycsb.make_streams(cfg)))
+    fs = chunk(fs, stream, fst.make_fast_ctl(cfg, 0))
+    jax.block_until_ready(fs)
+    jax.device_get(jax.tree.leaves(fs)[0].ravel()[:1])  # force sync mode
+    ts = []
+    for c in range(1, 1 + reps):
+        t0 = time.perf_counter()
+        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * rounds))
+        jax.block_until_ready(fs)
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2] / rounds * 1e3
+
+
+def round_ledger(cfg, rounds: int = 30, reps: int = 3,
+                 time_stages: bool = True) -> dict:
+    """The per-fusion cost ledger of the batched round at cfg's shape:
+    ``stages`` rows carry each fusion's sparse-op delta (from censusing the
+    ablation prefixes), the cost-model pricing of that delta, and — when
+    ``time_stages`` — the measured ms/round delta.  ``census`` is the full
+    single-round census; ``round_ms`` the measured full round (None when
+    census-only)."""
+    import jax
+
+    stages = _stage_fns(cfg)
+    fs, stream, ctl = _abstract_round_args(cfg)
+    rows = []
+    prev_census: Optional[dict] = None
+    prev_ms: Optional[float] = None
+    full_census = None
+    for name, fn in stages:
+        chunk = _scan_chunk(cfg, fn, rounds)
+        cen = census_text(jax.jit(chunk).lower(fs, stream, ctl).as_text())
+        ms = _timed_chunk(cfg, chunk, rounds, reps) if time_stages else None
+        ops = {
+            k: cen[k] - (prev_census[k] if prev_census else 0)
+            for k in SPARSE + COLLECTIVE
+            if cen[k] - (prev_census[k] if prev_census else 0)
+        }
+        d_sparse = cen["sparse_total"] - (
+            prev_census["sparse_total"] if prev_census else 0)
+        rows.append({
+            "fusion": name,
+            "ops": ops,
+            "sparse_delta": d_sparse,
+            "modeled_ms": [round(d_sparse * COST_LO, 2),
+                           round(d_sparse * COST_HI, 2)],
+            "ms": (None if ms is None
+                   else round(ms - (prev_ms or 0.0), 3)),
+        })
+        prev_census, prev_ms, full_census = cen, ms, cen
+    return {
+        "shape": census_shape(cfg),
+        "rounds": rounds if time_stages else 0,
+        "census": full_census,
+        "stages": rows,
+        "round_ms": None if prev_ms is None else round(prev_ms, 3),
+    }
+
+
+# --------------------------------------------------------------------------
+# Budget gate + JSONL export
+# --------------------------------------------------------------------------
+
+
+def check_budget(census_by_engine: dict, budget: dict) -> list:
+    """CI gate predicate: for every engine in ``budget``, every budgeted
+    count in the measured census must not exceed its ceiling.  Returns the
+    list of human-readable failures (empty = gate passes).  A budgeted
+    engine missing from the census is itself a failure — a silently
+    skipped engine must not read as a pass."""
+    failures = []
+    for engine, limits in sorted(budget.items()):
+        cen = census_by_engine.get(engine)
+        if cen is None:
+            failures.append(f"{engine}: no census measured for budgeted engine")
+            continue
+        for metric, ceiling in sorted(limits.items()):
+            got = cen.get(metric)
+            if got is None:
+                failures.append(f"{engine}: census lacks budgeted metric "
+                                f"{metric!r}")
+            elif got > ceiling:
+                failures.append(
+                    f"{engine}: {metric} = {got} exceeds budget {ceiling} — "
+                    f"a sparse/collective op crept back onto the round chain "
+                    f"(each is ~{COST_LO}-{COST_HI} ms/round on the target "
+                    f"chip); re-diet the round or consciously raise "
+                    f"OP_BUDGET.json")
+    return failures
+
+
+def export_profile(path_or_fp, records, extra: Optional[dict] = None) -> None:
+    """Write profile records as obs run-log JSONL (kind="profile", shared
+    monotonic ``t`` stamp — the PR-1 schema scripts/obs_report.py merges)."""
+    from hermes_tpu.obs.metrics import JsonlExporter
+
+    own = isinstance(path_or_fp, str)
+    fp = open(path_or_fp, "w") if own else path_or_fp
+    try:
+        exp = JsonlExporter(fp, stamp=True)
+        for rec in records:
+            if extra:
+                rec = {**extra, **rec}
+            exp.write(rec, kind="profile")
+    finally:
+        if own:
+            fp.close()
+
+
+def round_record(census: dict, **extra) -> dict:
+    """One obs "round" profile record: the census plus its cost-model
+    pricing.  The single constructor for every producer (bench.py
+    --profile-out, the cli's --profile-out), so the JSONL schema cannot
+    drift between them."""
+    return dict(
+        record="round", census=census,
+        modeled_sparse_ms=[round(census["sparse_total"] * COST_LO, 1),
+                           round(census["sparse_total"] * COST_HI, 1)],
+        **extra)
+
+
+def ledger_records(ledger: dict) -> list:
+    """Flatten a round_ledger() result into per-row JSONL records: one
+    summary record (census + round_ms) + one record per fusion stage."""
+    head = {k: ledger[k] for k in ("shape", "rounds", "census", "round_ms")}
+    head["record"] = "round"
+    rows = [{"record": "fusion", **row} for row in ledger["stages"]]
+    return [head] + rows
+
+
+# --------------------------------------------------------------------------
+# CLI (the promoted scripts/profile_round.py)
+# --------------------------------------------------------------------------
+
+
+def _cli_cfg(S: int, C: int, arb_mode: str = "race", chain_writes: int = 0,
+             fused_sort: bool = True):
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    return HermesConfig(
+        n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=S,
+        replay_slots=256, ops_per_session=128, wrap_stream=True,
+        lane_budget_cfg=C, rebroadcast_every=4, replay_scan_every=32,
+        arb_mode=arb_mode, chain_writes=chain_writes, fused_sort=fused_sort,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m hermes_tpu.obs.profile",
+        description="Per-fusion cost ledger + op census of the fast round "
+        "(honest timing protocol for the tunneled runtime; see module doc).")
+    ap.add_argument("sessions", nargs="?", type=int, default=16384)
+    ap.add_argument("lane_budget", nargs="?", type=int, default=None,
+                    help="default: sessions // 2")
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--arb-mode", choices=["race", "sort"], default="race",
+                    help="historical profile_round.py default is race; the "
+                    "bench operating point is sort (+--chain-writes 128)")
+    ap.add_argument("--chain-writes", type=int, default=0)
+    ap.add_argument("--split-sort", action="store_true",
+                    help="profile the split two-sort program (the fused-"
+                    "sort A/B baseline; sort arbiter only)")
+    ap.add_argument("--census-only", action="store_true",
+                    help="skip timing (abstract lowering only; CPU-safe at "
+                    "any shape)")
+    ap.add_argument("--out", default=None, metavar="PROFILE_JSONL",
+                    help="additionally export the ledger as obs-schema "
+                    "JSONL records (kind=profile)")
+    args = ap.parse_args(argv)
+
+    cfg = _cli_cfg(args.sessions, args.lane_budget or args.sessions // 2,
+                   arb_mode=args.arb_mode, chain_writes=args.chain_writes,
+                   fused_sort=not args.split_sort)
+    led = round_ledger(cfg, rounds=args.rounds, reps=args.reps,
+                       time_stages=not args.census_only)
+    print(f"S={cfg.n_sessions} C={cfg.lane_budget} "
+          f"fused_sort={cfg.use_fused_sort}", file=sys.stderr)
+    for row in led["stages"]:
+        ms = "      -" if row["ms"] is None else f"{row['ms']:7.2f}"
+        print(f"  {row['fusion']:<16}: {ms} ms  +{row['sparse_delta']} sparse "
+              f"{row['ops']}", file=sys.stderr)
+    print(f"  census: sparse_total={led['census']['sparse_total']} "
+          f"collective_total={led['census']['collective_total']} "
+          f"round_ms={led['round_ms']}", file=sys.stderr)
+    if args.out:
+        export_profile(args.out, ledger_records(led))
+    print(json.dumps(dict(sparse_total=led["census"]["sparse_total"],
+                          collective_total=led["census"]["collective_total"],
+                          round_ms=led["round_ms"])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,8 +60,11 @@ def save(path: str, rt) -> None:
         # re-anchored from the wrong era and silently corrupt checker
         # histories.  quiesce/rebases/_next_rebase_at ride along so the
         # restored runtime resumes the exact rebase posture.
+        # never-rebased runtimes write a ZERO-LENGTH sentinel, not n_keys of
+        # int64 zeros (~8 MB of dead payload per snapshot at the 1M-key
+        # shape); load() keys on the shape (round-5 advice #2)
         arrays["ctl.ver_base"] = (
-            np.zeros(rt.cfg.n_keys, np.int64) if rt._ver_base is None
+            np.zeros(0, np.int64) if rt._ver_base is None
             else np.asarray(rt._ver_base)
         )
         arrays["ctl.rebases"] = np.int64(rt.rebases)
@@ -210,8 +213,11 @@ def load(path: str, rt) -> None:
     rt.live[:] = z["ctl.live"]
     rt.frozen[:] = z["ctl.frozen"]
     if hasattr(rt, "_ver_base") and "ctl.ver_base" in z:
+        # zero-length = the never-rebased sentinel (round-6 archives); a
+        # full-length all-zeros array is the pre-round-6 encoding of the
+        # same fact and still maps to None
         vb = np.asarray(z["ctl.ver_base"]).astype(np.int64)
-        rt._ver_base = vb.copy() if vb.any() else None
+        rt._ver_base = vb.copy() if vb.size and vb.any() else None
         rt.rebases = int(z["ctl.rebases"])
         rt._next_rebase_at = int(z["ctl.next_rebase_at"])
         rt.quiesce = bool(z["ctl.quiesce"])

@@ -1,0 +1,116 @@
+"""Round-chain op-census gate (CI): the fast round's sparse/collective op
+counts must stay within the checked-in budget, and the committed
+SHARDED_CENSUS.json census section must match what the code actually
+lowers to.
+
+Why a gate: the engine's measured cost model (ARCHITECTURE.md "Sparse-op
+COUNT dominates") prices a protocol round as (#sparse ops) x ~1.3-2.4 ms
+nearly independent of operand size, so ONE gather/scatter/sort quietly
+re-added by a refactor costs ~6% of the headline writes/sec — and nothing
+else in CI would notice.  Same measure-then-gate pattern as
+scripts/check_obs_overhead.py.
+
+The census is computed by abstract lowering (hermes_tpu.obs.profile.
+op_census) at the exact bench shape — backend-independent, so this runs on
+the CPU env; the TPU-only timing cells of SHARDED_CENSUS.json
+(tpu_r1_delta) are never touched here.
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_op_census.py [--update]
+
+``--update`` rewrites the census section (and the census-derived
+projection) of SHARDED_CENSUS.json in place after an INTENTIONAL op-count
+change — the diff then shows up in review instead of drifting silently.
+Exits non-zero on any budget breach or un-updated drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from hermes_tpu.obs import profile as prof  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", default="OP_BUDGET.json")
+    ap.add_argument("--census", default="SHARDED_CENSUS.json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the census (+ derived projection) section "
+                    "of the census artifact instead of failing on drift")
+    args = ap.parse_args()
+
+    import bench
+
+    cfg = bench._cfg("a")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+    print(f"censusing bench shape (S={cfg.n_sessions}, C={cfg.lane_budget}, "
+          f"K={cfg.n_keys}, fused_sort={cfg.use_fused_sort})...",
+          file=sys.stderr)
+    measured = {
+        "batched": prof.op_census(cfg, "batched"),
+        "sharded": prof.op_census(cfg, "sharded", mesh),
+    }
+
+    with open(args.budget) as f:
+        budget = {k: v for k, v in json.load(f).items()
+                  if not k.startswith("_")}
+    failures = prof.check_budget(measured, budget)
+
+    # drift check: the committed artifact's census must equal the lowered
+    # program's (count keys only; the artifact may carry more context)
+    drift = []
+    try:
+        with open(args.census) as f:
+            artifact = json.load(f)
+        recorded = artifact.get("census", {})
+    except FileNotFoundError:
+        artifact, recorded = None, {}
+        drift.append(f"{args.census} missing")
+    for engine, cen in measured.items():
+        rec = recorded.get(engine, {})
+        for k, v in cen.items():
+            if rec.get(k) != v:
+                drift.append(f"{engine}.{k}: artifact has {rec.get(k)}, "
+                             f"code lowers to {v}")
+
+    if drift and args.update and artifact is not None:
+        from sharded_census import projection
+
+        artifact["census"] = measured
+        artifact["bench_shape"] = prof.census_shape(cfg)
+        artifact["v5e8_projection"] = projection(measured["batched"],
+                                                 measured["sharded"])
+        with open(args.census, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"updated {args.census} census section", file=sys.stderr)
+        drift = []
+
+    out = dict(ok=not failures and not drift,
+               budget=budget, census=measured,
+               budget_failures=failures, census_drift=drift)
+    print(json.dumps(dict(ok=out["ok"],
+                          sparse_batched=measured["batched"]["sparse_total"],
+                          sparse_sharded=measured["sharded"]["sparse_total"],
+                          collectives_sharded=measured["sharded"][
+                              "collective_total"],
+                          budget_failures=failures, census_drift=drift)))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

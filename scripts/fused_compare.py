@@ -1,0 +1,67 @@
+"""Fused vs split round-sort A/B at the exact bench shape (round-6
+tentpole evidence): one process, one chip claim, every cell through
+bench.run_mix's measurement protocol — the scripts/arb_compare.py pattern,
+with ``over=dict(fused_sort=...)`` as the toggle.
+
+Cells: the primary YCSB-A mix and the contended zipfian mix (deep chains
+stress the equal-key-run logic the fusion rewrote), fused on/off.  The
+fused cell at mix "a" IS the bench operating point; the cost model
+predicts the split cell ~1.3-2.4 ms/round slower (one extra lax.sort).
+
+Writes FUSED_COMPARE.json and prints one JSON line per cell to stderr,
+plus a summary line to stdout.  Run on the real chip (default env, no
+other TPU process, no timeout-kill).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import bench
+
+CELLS = [
+    ("a", {"fused_sort": True}),
+    ("a", {"fused_sort": False}),
+    ("zipfian", {"fused_sort": True}),
+    ("zipfian", {"fused_sort": False}),
+]
+
+
+def main() -> None:
+    ok, info = bench.probe_backend(
+        float(os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
+    if not ok:
+        print(json.dumps({"error": info}))
+        sys.exit(1)
+
+    results = []
+    for mix, over in CELLS:
+        t0 = time.perf_counter()
+        r = bench.run_mix(mix, over=over)
+        r["fused_sort"] = over["fused_sort"]
+        r["cell_wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(r)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+        # rewrite after every cell: a mid-matrix chip failure must not
+        # discard the completed cells' artifact
+        with open("FUSED_COMPARE.json", "w") as f:
+            json.dump(results, f, indent=1)
+
+    summary = {}
+    for r in results:
+        summary.setdefault(r["mix"], {})[
+            "fused" if r["fused_sort"] else "split"] = dict(
+                writes_per_sec=r["writes_per_sec"], round_us=r["round_us"])
+    for mix, cells in summary.items():
+        if "fused" in cells and "split" in cells:
+            cells["round_ms_saved"] = round(
+                (cells["split"]["round_us"] - cells["fused"]["round_us"])
+                / 1e3, 2)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,83 +1,20 @@
 """Honest phase-level profiling of the fast round on the target TPU.
 
-Methodology (measured; see ARCHITECTURE.md): through the tunneled PJRT
-runtime, execution is DEFERRED until the first device-to-host readback and
-`block_until_ready` alone does not execute queued work — so this script (a)
-forces synchronous mode with an initial readback, and (b) times scan-chunked
-variants of the round with pieces ablated, attributing the difference.  Run:
+Promoted (round-6) into ``hermes_tpu.obs.profile`` — the per-fusion cost
+ledger, the StableHLO op census, the obs-schema JSONL exporter and the
+budget-gate predicate all live there now; this wrapper keeps the
+historical entry point and argument shape:
 
     python scripts/profile_round.py [S] [C]
+
+is exactly ``python -m hermes_tpu.obs.profile [S] [C]``.
 """
 
 import sys
-import time
 
 sys.path.insert(0, ".")
 
-import jax
-import jax.numpy as jnp
+from hermes_tpu.obs.profile import main  # noqa: E402
 
-from hermes_tpu.config import HermesConfig, WorkloadConfig
-from hermes_tpu.core import faststep as fst
-from hermes_tpu.workload import ycsb
-
-jax.device_get(jnp.zeros(8) + 1)  # force synchronous (honest) mode
-
-S = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
-C = int(sys.argv[2]) if len(sys.argv) > 2 else S // 2
-
-cfg = HermesConfig(
-    n_replicas=8, n_keys=1 << 20, value_words=8, n_sessions=S,
-    replay_slots=256, ops_per_session=128, wrap_stream=True,
-    lane_budget_cfg=C, rebroadcast_every=4, replay_scan_every=32,
-    workload=WorkloadConfig(read_frac=0.5, seed=0),
-)
-
-
-def timed_chunk(round_fn, rounds=30, reps=3):
-    fs = jax.device_put(fst.init_fast_state(cfg))
-    stream = jax.device_put(fst.prep_stream(ycsb.make_streams(cfg)))
-
-    @jax.jit
-    def chunk(fs, stream, ctl):
-        def body(carry, off):
-            nxt = round_fn(ctl._replace(step=ctl.step + off), carry, stream)
-            return nxt, None
-        fs, _ = jax.lax.scan(body, fs, jnp.arange(rounds, dtype=jnp.int32))
-        return fs
-
-    fs = chunk(fs, stream, fst.make_fast_ctl(cfg, 0))
-    jax.block_until_ready(fs)
-    jax.device_get(jax.tree.map(lambda x: x.ravel()[0], fs))
-    t0 = time.perf_counter()
-    for c in range(1, 1 + reps):
-        fs = chunk(fs, stream, fst.make_fast_ctl(cfg, c * rounds))
-    jax.block_until_ready(fs)
-    jax.device_get(jax.tree.map(lambda x: x.ravel()[0], fs))
-    return (time.perf_counter() - t0) / reps / rounds * 1e3
-
-
-def full(ctl, fs, stream):
-    nxt, _ = fst.fast_round_batched(cfg, ctl, fs, stream)
-    return nxt
-
-
-def coordinate_only(ctl, fs, stream):
-    fs2, *_ = fst._coordinate(cfg, ctl, fs, stream)
-    return fs2
-
-
-def through_apply_inv(ctl, fs, stream):
-    fs2, lanes, slot_lane, taken_lane, *_ = fst._coordinate(cfg, ctl, fs, stream)
-    fs3 = fst._apply_inv_lanes(cfg, ctl, fs2, lanes, taken_lane)
-    return fs3
-
-
-t_full = timed_chunk(full)
-t_coord = timed_chunk(coordinate_only)
-t_ainv = timed_chunk(through_apply_inv)
-print(f"S={S} C={C}")
-print(f"  full round          : {t_full:7.2f} ms")
-print(f"  coordinate only     : {t_coord:7.2f} ms")
-print(f"  + bcast + apply_inv : {t_ainv:7.2f} ms  (apply_inv ~= {t_ainv - t_coord:.2f})")
-print(f"  acks+commit+val     : ~{t_full - t_ainv:.2f} ms (by difference)")
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 import time
 
@@ -52,20 +51,9 @@ from jax.sharding import Mesh
 
 from hermes_tpu.config import HermesConfig, WorkloadConfig
 from hermes_tpu.core import faststep as fst
+from hermes_tpu.obs.profile import (  # single source of the cost model
+    COST_HI, COST_LO, COST_MID, op_census)
 from hermes_tpu.workload import ycsb
-
-# the ops the TPU cost model prices individually (sparse chain) and the
-# wire collectives; everything else is the fused dense tail
-SPARSE = ("stablehlo.gather", "stablehlo.scatter", "stablehlo.sort",
-          "stablehlo.dynamic_gather")
-COLLECTIVE = ("stablehlo.all_gather", "stablehlo.all_to_all",
-              "stablehlo.collective_permute", "stablehlo.all_reduce")
-
-# ARCHITECTURE.md cost model (round-2, measured): ~1.3-2.4 ms per dynamic
-# sparse op.  The --tpu-r1 cell exists to test this pricing at wire shapes;
-# single source here so the projection and the measured-vs-model cell
-# cannot disagree.
-COST_LO, COST_MID, COST_HI = 1.3, 1.8, 2.4
 
 
 def bench_cfg():
@@ -75,39 +63,10 @@ def bench_cfg():
 
 
 def census(cfg, backend: str, mesh=None) -> dict:
-    """StableHLO op counts of ONE protocol round at cfg's shape (abstract
-    lowering — nothing is materialized)."""
-    if backend == "batched":
-        fn = fst.build_fast_batched(cfg)
-        n_local = None
-    else:
-        fn = fst.build_fast_sharded(cfg, mesh, rounds=1, donate=False)
-        n_local = cfg.n_replicas
-    fs = jax.eval_shape(lambda: fst.init_fast_state(cfg, n_local=n_local))
-    stream = jax.eval_shape(
-        lambda: fst.prep_stream(ycsb.stub_stream(cfg)))
-    ctl = jax.eval_shape(lambda: fst.make_fast_ctl(cfg, 0))
-    txt = fn.lower(fs, stream, ctl).as_text()
-    counts: dict = {}
-    static_gathers = 0
-    for line in txt.splitlines():
-        m = re.search(r'= "?(stablehlo\.[a-z_]+)"?[( ]', line)
-        if not m:
-            continue
-        op = m.group(1)
-        if op == "stablehlo.gather" and "indices_are_sorted = true" in line:
-            # byte-plane extraction (faststep._bank_to_i32): a strided
-            # slice that jax lowers as a gather from STATIC iota indices
-            # (hence sorted+unique) — XLA fuses these like slices; they are
-            # not the ~1.3-2.4 ms dynamic sparse ops the cost model prices
-            static_gathers += 1
-            continue
-        counts[op] = counts.get(op, 0) + 1
-    out = {k: counts.get(k, 0) for k in SPARSE + COLLECTIVE}
-    out["static_strided_gathers"] = static_gathers
-    out["sparse_total"] = sum(counts.get(k, 0) for k in SPARSE)
-    out["collective_total"] = sum(counts.get(k, 0) for k in COLLECTIVE)
-    return out
+    """StableHLO op counts of ONE protocol round at cfg's shape — the
+    canonical implementation lives in hermes_tpu.obs.profile (round-6);
+    this wrapper keeps the historical entry point."""
+    return op_census(cfg, backend, mesh)
 
 
 def _prep_backend(cfg, mesh, backend: str, rounds: int):
@@ -292,17 +251,29 @@ def main() -> None:
     ratio = measured_ratio()
     print(f"  {ratio}", file=sys.stderr)
     proj = projection(cen_b, cen_s)
+    from hermes_tpu.obs.profile import census_shape
+
     out = dict(
-        bench_shape=dict(n_replicas=cfg.n_replicas, n_keys=cfg.n_keys,
-                         n_sessions=cfg.n_sessions,
-                         lane_budget=cfg.lane_budget,
-                         value_words=cfg.value_words,
-                         chain_writes=cfg.chain_writes,
-                         arb_mode=cfg.arb_mode),
+        bench_shape=census_shape(cfg),
         census=dict(batched=cen_b, sharded=cen_s),
         cpu_mesh_ratio=ratio,
         v5e8_projection=proj,
     )
+    try:
+        # a CPU regeneration must not discard the chip-measured cell: the
+        # census/ratio/projection are backend-independent or CPU-sourced,
+        # the tpu_r1 routing delta is TPU-only and carries over
+        with open("SHARDED_CENSUS.json") as f:
+            prev = json.load(f)
+        if "tpu_r1_delta" in prev:
+            out["tpu_r1_delta"] = prev["tpu_r1_delta"]
+    except FileNotFoundError:
+        pass
+    except Exception as e:
+        # the cell is irreplaceable without a chip — losing it must be LOUD
+        print(f"WARNING: could not carry tpu_r1_delta over from the "
+              f"existing SHARDED_CENSUS.json ({e}); re-run the chip cell "
+              f"(--tpu-r1) to restore it", file=sys.stderr)
     with open("SHARDED_CENSUS.json", "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(dict(

@@ -97,10 +97,33 @@ SLOW_TESTS = {
 
 
 def pytest_collection_modifyitems(config, items):
+    matched = set()
     for item in items:
         base = item.name.split("[")[0]
         if base in SLOW_TESTS:
             item.add_marker(pytest.mark.slow)
+            matched.add(base)
+    # Tier-drift guard (round-5 advice #1): a renamed or mistyped test
+    # silently drops out of the slow tier — the entry lingers here matching
+    # nothing, and the test runs in the wrong tier forever.  When the FULL
+    # suite was collected, every entry must have matched something.  Partial
+    # collections (single file, or a module that failed to import under
+    # --continue-on-collection-errors) legitimately miss entries, so the
+    # guard only fires when every test module on disk made it into the
+    # collected set.  (This hook runs before pytest's own -m/-k deselection
+    # — it must, for the slow markers it adds to be filterable — so marker
+    # expressions like 'not slow' never hide items from this check.)
+    unmatched = SLOW_TESTS - matched
+    if unmatched:
+        import pathlib
+
+        here = pathlib.Path(__file__).parent
+        on_disk = {p.name for p in here.glob("test_*.py")}
+        collected = {pathlib.Path(str(item.fspath)).name for item in items}
+        if on_disk <= collected:
+            raise pytest.UsageError(
+                "SLOW_TESTS entries matched no collected test (renamed or "
+                f"mistyped? fix tests/conftest.py): {sorted(unmatched)}")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,125 @@
+"""Round-6 op-diet tooling: the StableHLO op census, the per-fusion cost
+ledger, the obs-schema JSONL export, and the census budget gate
+(hermes_tpu/obs/profile.py; the CI entry is scripts/check_op_census.py).
+
+These pin (a) the census SCHEMA the gate consumes, (b) the gate's
+pass/fail semantics, and (c) the tentpole itself: the fused
+arbiter+compaction sort lowers to exactly ONE lax.sort per round, one
+fewer sparse op than the split program.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.obs import profile as prof
+
+
+def _cfg(**over):
+    kw = dict(
+        n_replicas=4, n_keys=1 << 9, value_words=2, n_sessions=16,
+        replay_slots=4, ops_per_session=16, wrap_stream=True,
+        arb_mode="sort", chain_writes=4, lane_budget_cfg=12,
+        rebroadcast_every=4, replay_scan_every=32,
+        workload=WorkloadConfig(read_frac=0.5, seed=0),
+    )
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def test_census_schema_and_fused_sort_diet():
+    cen = prof.op_census(_cfg())
+    for k in prof.SPARSE + prof.COLLECTIVE:
+        assert isinstance(cen[k], int) and cen[k] >= 0
+    assert cen["sparse_total"] == sum(cen[k] for k in prof.SPARSE)
+    assert cen["collective_total"] == sum(cen[k] for k in prof.COLLECTIVE)
+    assert cen["collective_total"] == 0  # batched: no wire
+    # THE tentpole: one fused arbiter+compaction sort per round; the split
+    # fallback pays two — census totals differ by exactly that sort
+    assert cen["stablehlo.sort"] == 1
+    split = prof.op_census(_cfg(fused_sort=False))
+    assert split["stablehlo.sort"] == 2
+    assert cen["sparse_total"] == split["sparse_total"] - 1
+
+
+def test_sharded_census_counts_wire_collectives(cpu_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(cpu_devices[:8]), ("replica",))
+    cen = prof.op_census(_cfg(n_replicas=8), "sharded", mesh)
+    # round-6 wire diet: INV rows8+meta all_gathers, ONE ack all_to_all,
+    # ONE VAL-bit all_gather — epoch words ride the INV meta gather
+    assert cen["stablehlo.all_to_all"] == 1
+    assert cen["stablehlo.all_gather"] == 3
+    assert cen["collective_total"] == 4
+    assert cen["stablehlo.sort"] == 1
+
+
+def test_budget_gate_pass_and_fail_paths():
+    cen = {"batched": {"sparse_total": 12, "collective_total": 0,
+                       "stablehlo.sort": 1}}
+    assert prof.check_budget(cen, {"batched": {"sparse_total": 12}}) == []
+    fails = prof.check_budget(cen, {"batched": {"sparse_total": 11}})
+    assert len(fails) == 1 and "sparse_total" in fails[0]
+    assert "12" in fails[0] and "11" in fails[0]
+    # a budgeted engine with no census must FAIL, not silently pass
+    assert prof.check_budget({}, {"batched": {"sparse_total": 99}})
+    # a budgeted metric the census lacks must fail too
+    assert prof.check_budget(cen, {"batched": {"no_such_metric": 1}})
+
+
+def test_ledger_schema_and_jsonl_export(tmp_path):
+    led = prof.round_ledger(_cfg(), time_stages=False)
+    assert [r["fusion"] for r in led["stages"]] == [
+        "coordinate", "apply_inv", "acks_commit_val"]
+    # stage deltas telescope to the full round: the ledger accounts for
+    # every sparse op exactly once
+    assert (sum(r["sparse_delta"] for r in led["stages"])
+            == led["census"]["sparse_total"])
+    for r in led["stages"]:
+        assert r["ms"] is None  # census-only mode
+        lo, hi = r["modeled_ms"]
+        assert lo == round(r["sparse_delta"] * prof.COST_LO, 2)
+        assert hi == round(r["sparse_delta"] * prof.COST_HI, 2)
+    assert led["round_ms"] is None
+    assert led["shape"]["fused_sort"] is True
+
+    p = tmp_path / "prof.jsonl"
+    prof.export_profile(str(p), prof.ledger_records(led))
+    recs = [json.loads(line) for line in p.read_text().splitlines()]
+    assert len(recs) == 1 + len(led["stages"])
+    # PR-1 obs run-log schema: every record stamped with t + kind
+    assert all(r["kind"] == "profile" and "t" in r for r in recs)
+    assert [r["t"] for r in recs] == sorted(r["t"] for r in recs)
+    assert recs[0]["record"] == "round"
+    assert recs[0]["census"]["sparse_total"] == led["census"]["sparse_total"]
+    assert {r["record"] for r in recs[1:]} == {"fusion"}
+
+
+def test_ledger_timed_smoke():
+    """time_stages=True runs the honest-timing protocol (functional smoke
+    on CPU — the numbers are only meaningful on the chip)."""
+    led = prof.round_ledger(_cfg(), rounds=3, reps=1, time_stages=True)
+    assert led["round_ms"] is not None and led["round_ms"] > 0
+    assert all(r["ms"] is not None for r in led["stages"])
+
+
+def test_repo_budget_file_matches_diet():
+    """The checked-in OP_BUDGET.json must gate both engines at the round-6
+    diet ceilings ISSUE 2 committed to (batched <= 12, sharded <= 15
+    sparse / <= 5 collectives) — loosening it is a conscious, reviewed
+    act."""
+    root = pathlib.Path(__file__).resolve().parent.parent
+    with open(root / "OP_BUDGET.json") as f:
+        budget = {k: v for k, v in json.load(f).items()
+                  if not k.startswith("_")}
+    assert budget["batched"]["sparse_total"] <= 12
+    assert budget["sharded"]["sparse_total"] <= 15
+    assert budget["sharded"]["collective_total"] <= 5
+    assert budget["batched"]["stablehlo.sort"] == 1
+    # and the gate predicate accepts a census exactly at the ceilings
+    at_ceiling = {eng: dict(lim) for eng, lim in budget.items()}
+    assert prof.check_budget(at_ceiling, budget) == []

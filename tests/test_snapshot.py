@@ -191,6 +191,33 @@ def test_snapshot_carries_rebase_bookkeeping(tmp_path):
         snapshot.load(old, b)
 
 
+def test_never_rebased_snapshot_writes_sentinel_not_zeros(tmp_path):
+    """Round-5 advice #2: a runtime that never rebased must not ship n_keys
+    of int64 zeros as ctl.ver_base (~8 MB dead payload at the 1M-key
+    shape) — it writes a zero-length sentinel, load() keys on the shape,
+    and the truncation checks still see the entry."""
+    cfg = HermesConfig(n_replicas=3, n_keys=256, n_sessions=8, replay_slots=4,
+                       ops_per_session=16, workload=WorkloadConfig(seed=71))
+    a = FastRuntime(cfg)
+    a.run(5)
+    assert a._ver_base is None
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, a)
+    z = np.load(p)
+    assert "ctl.ver_base" in z  # still present: truncation checks intact
+    assert z["ctl.ver_base"].size == 0
+
+    b = FastRuntime(cfg)
+    snapshot.load(p, b)
+    assert b._ver_base is None
+    assert b.step_idx == 5
+    # and a REBASED runtime still round-trips its real deltas (non-empty)
+    a.run(25)
+    if a.rebase_versions() > 0:
+        snapshot.save(p, a)
+        assert np.load(p)["ctl.ver_base"].size == cfg.n_keys
+
+
 def test_sharded_snapshot_roundtrip(tmp_path):
     """Snapshot/restore over the sharded (tpu_ici-shaped) backend: the
     global device arrays flatten and rebuild with the same values, and the
