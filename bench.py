@@ -341,6 +341,11 @@ def main() -> None:
                     "+ cost-model pricing + measured round time as obs "
                     "profile records (hermes_tpu.obs.profile; abstract "
                     "lowering, no extra device work)")
+    ap.add_argument("--analyze", default=None, metavar="FINDINGS_JSONL",
+                    help="additionally run the static jaxpr invariant "
+                    "analyzer (hermes_tpu.analysis) on each measured mix's "
+                    "round program and write the findings as obs analysis "
+                    "records (abstract tracing, no extra device work)")
     ap.add_argument("--probe-timeout", type=float, default=float(
         os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
     args = ap.parse_args()
@@ -402,6 +407,20 @@ def main() -> None:
         from hermes_tpu.obs import profile as prof
 
         prof.export_profile(args.profile_out, profile_recs)
+
+    if args.analyze:
+        # invariant accountability next to the measured number: the
+        # analyzer's verdict on the exact programs just timed (host-side
+        # abstract tracing — the chip is not touched again)
+        from hermes_tpu import analysis as ana
+
+        reports = []
+        for mix in mixes:
+            for r in ana.analyze_config(_cfg(mix), engines=("batched",)):
+                for f in r["findings"]:
+                    f.engine = f"{mix}:{f.engine}"
+                reports.append(r)
+        ana.export_findings(args.analyze, reports)
 
     if args.mix == "all":
         # latency operating point at three scales (round-3 verdict item 7):

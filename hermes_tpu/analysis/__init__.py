@@ -1,0 +1,108 @@
+"""hermes_tpu.analysis — static jaxpr invariant analyzer (ISSUE 3).
+
+The fast engines re-encode Hermes's model-checked invariants as
+hand-packed int32 bitfields; this package proves, at TRACE time, that the
+packing is sound under the config's declared bounds — before a round ever
+runs, and long before the runtime linearizability checker could notice a
+corrupted history.  It walks the closed jaxpr of a protocol round with an
+abstract interval/bitwidth interpreter (interp.py, domain.py) seeded from
+``HermesConfig`` + the declared field layouts (core/layouts.py), and runs
+four passes (passes.py):
+
+  bitpack   every shift/or pack overlap-free and int32-sign-safe
+  dtype     no silent 64-bit/float upcasts; converts value-preserving
+  scatter   set-scatters carry injectivity evidence; donation aliasable
+  sharding  collectives name real mesh axes with agreeing sizes
+
+Findings export in the obs run-log JSONL schema (kind="analysis") and are
+CI-gated by scripts/check_analysis.py against ANALYSIS_BASELINE.json —
+the same measure-then-gate pattern as the op census.  CLI:
+
+    python -m hermes_tpu.analysis [--engine both] [--split-sort] ...
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+from hermes_tpu.analysis.domain import AbsVal, iv  # noqa: F401
+from hermes_tpu.analysis.engines import (  # noqa: F401
+    Program, analyze_config, analyze_program, trace_program)
+from hermes_tpu.analysis.passes import (  # noqa: F401
+    ERROR, INFO, WARN, Finding, default_passes)
+
+GATING = (ERROR, WARN)  # severities that fail the CI gate
+
+
+def findings_of(reports: Iterable[dict]) -> List[Finding]:
+    out: List[Finding] = []
+    for r in reports:
+        out.extend(r["findings"])
+    return out
+
+
+def key_counts(findings: Iterable[Finding]) -> dict:
+    """Stable multiset of gating finding keys (baseline currency).  The
+    key leads with the finding's ``engine`` field — callers analyzing
+    several configs stamp it ``"<config>:<engine>"`` first (as the gate
+    script does), so a finding grandfathered at one shape cannot silently
+    excuse the same site at another."""
+    counts: dict = {}
+    for f in findings:
+        if f.severity not in GATING:
+            continue
+        counts[f.key] = counts.get(f.key, 0) + f.count
+    return counts
+
+
+def diff_baseline(measured: dict, baseline: dict) -> tuple:
+    """(new, stale): keys exceeding their grandfathered count, and
+    baseline keys the code no longer produces (stale entries are reported
+    but do not fail the gate — ``--update`` prunes them)."""
+    new = {k: c - baseline.get(k, 0) for k, c in measured.items()
+           if c > baseline.get(k, 0)}
+    stale = {k: c for k, c in baseline.items() if measured.get(k, 0) < c}
+    return new, stale
+
+
+def load_baseline(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return {}
+    g = doc.get("grandfathered", {})
+    return {k: (v["count"] if isinstance(v, dict) else int(v))
+            for k, v in g.items()}
+
+
+def export_findings(path_or_fp, reports: Iterable[dict],
+                    extra: Optional[dict] = None) -> None:
+    """Write analyzer output as obs run-log JSONL (kind="analysis"):
+    one summary record per analyzed program, one record per finding —
+    mergeable by scripts/obs_report.py like any other obs stream."""
+    from hermes_tpu.obs.metrics import JsonlExporter
+
+    own = isinstance(path_or_fp, str)
+    fp = open(path_or_fp, "w") if own else path_or_fp
+    try:
+        exp = JsonlExporter(fp, stamp=True)
+        for r in reports:
+            head = dict(record="program", engine=r["engine"],
+                        n_eqns=r["n_eqns"], proved=r["proved"],
+                        n_findings=len(r["findings"]),
+                        by_severity={s: sum(1 for f in r["findings"]
+                                            if f.severity == s)
+                                     for s in (ERROR, WARN, INFO)})
+            if extra:
+                head = {**extra, **head}
+            exp.write(head, kind="analysis")
+            for f in r["findings"]:
+                rec = f.record()
+                if extra:
+                    rec = {**extra, **rec}
+                exp.write(rec, kind="analysis")
+    finally:
+        if own:
+            fp.close()

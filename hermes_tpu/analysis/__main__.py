@@ -1,0 +1,116 @@
+"""CLI: ``python -m hermes_tpu.analysis`` — analyze the fast engines.
+
+Prints the findings (and the proof counts) for the chosen config/engines;
+``--out`` additionally exports obs-schema JSONL.  Exit code 1 iff any
+ERROR-severity finding exists (the CI gate with baseline support is
+scripts/check_analysis.py).
+
+CPU-safe at any shape: programs are traced abstractly, nothing is
+materialized.  Set JAX_PLATFORMS=cpu (and
+XLA_FLAGS=--xla_force_host_platform_device_count=8 for --engine
+sharded/both) when running next to a TPU claim.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _named_cfg(name: str, args):
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    if name == "default":
+        return HermesConfig()
+    if name == "bench":
+        # the bench operating shape (bench._cfg YCSB-A, sort arbiter +
+        # chaining + fused sort), kept importable without the bench script
+        from hermes_tpu.obs.profile import _cli_cfg
+
+        return _cli_cfg(args.sessions, args.lane_budget
+                        or (3 * args.sessions) // 4,
+                        arb_mode="sort", chain_writes=128,
+                        fused_sort=True)
+    if name == "rmw":
+        from hermes_tpu.obs.profile import _cli_cfg
+
+        cfg = _cli_cfg(args.sessions, args.lane_budget
+                       or (3 * args.sessions) // 4,
+                       arb_mode="sort", chain_writes=0, fused_sort=True)
+        import dataclasses
+
+        return dataclasses.replace(
+            cfg, rmw_retries=16,
+            workload=WorkloadConfig(read_frac=0.5, rmw_frac=1.0, seed=0))
+    raise KeyError(name)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hermes_tpu.analysis",
+        description="Static jaxpr invariant analyzer: prove the packed "
+        "words (bit-pack intervals, dtype promotion, scatter hazards, "
+        "sharding consistency) of the fast protocol round.")
+    ap.add_argument("--config", choices=["default", "bench", "rmw"],
+                    default="default")
+    ap.add_argument("--sessions", type=int, default=16384,
+                    help="bench/rmw config session count")
+    ap.add_argument("--lane-budget", type=int, default=None)
+    ap.add_argument("--engine", choices=["batched", "sharded", "both"],
+                    default="batched")
+    ap.add_argument("--split-sort", action="store_true",
+                    help="analyze ONLY the split two-sort program")
+    ap.add_argument("--no-variants", action="store_true",
+                    help="analyze the config as-is (skip the split-sort "
+                    "A/B program)")
+    ap.add_argument("--out", default=None, metavar="FINDINGS_JSONL",
+                    help="export findings as obs-schema JSONL "
+                    "(kind=analysis)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON summary line instead of the "
+                    "human report")
+    args = ap.parse_args(argv)
+
+    from hermes_tpu import analysis as ana
+
+    cfg = _named_cfg(args.config, args)
+    if args.split_sort:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, fused_sort=False)
+    engines = (("batched", "sharded") if args.engine == "both"
+               else (args.engine,))
+    variants = "as-is" if (args.no_variants or args.split_sort) else "both"
+    reports = ana.analyze_config(cfg, engines=engines, variants=variants)
+
+    n_err = n_warn = 0
+    for r in reports:
+        errs = [f for f in r["findings"] if f.severity == ana.ERROR]
+        warns = [f for f in r["findings"] if f.severity == ana.WARN]
+        infos = [f for f in r["findings"] if f.severity == ana.INFO]
+        n_err += len(errs)
+        n_warn += len(warns)
+        if not args.json:
+            proved = " ".join(f"{k}={v}" for k, v in r["proved"].items())
+            print(f"== {r['engine']} @ {args.config}: {r['n_eqns']} eqns, "
+                  f"proved [{proved}], {len(errs)} error / {len(warns)} "
+                  f"warn / {len(infos)} info", file=sys.stderr)
+            for f in r["findings"]:
+                tag = f" (audit: {f.audit})" if f.audit else ""
+                print(f"  [{f.severity:<5}] {f.pass_name}/{f.code} "
+                      f"{f.site} in {f.fn} x{f.count}{tag}\n"
+                      f"          {f.message}", file=sys.stderr)
+    if args.out:
+        ana.export_findings(args.out, reports, extra={"config": args.config})
+    print(json.dumps(dict(
+        config=args.config, engines=list(engines),
+        programs=[r["engine"] for r in reports],
+        errors=n_err, warnings=n_warn,
+        infos=sum(1 for r in reports for f in r["findings"]
+                  if f.severity == ana.INFO))))
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

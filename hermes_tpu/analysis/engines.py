@@ -1,0 +1,166 @@
+"""Trace the engine programs and run the analyzer over them.
+
+``trace_program`` closes a protocol round — batched or sharded, fused or
+split sort — over a config into a jaxpr (abstract: ``jax.eval_shape``
+shapes in, nothing materialized, so a 2^29-key mutation config analyzes
+fine on a laptop), pairs it with the config-seeded input bounds
+(seeds.py) and the engine's declared mesh/donation facts, and
+``analyze_program`` walks it with the passes.
+
+``analyze_config`` is the driver the CLI and the CI gate share: both
+engines x (fused + split when the config resolves the fused sort) at one
+config."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from hermes_tpu.analysis import seeds as seeds_lib
+from hermes_tpu.analysis.interp import Ctx, eval_jaxpr
+from hermes_tpu.analysis.passes import Finding, ScatterHazardPass, \
+    default_passes
+from hermes_tpu.config import HermesConfig
+
+
+@dataclasses.dataclass
+class Program:
+    """One traced engine program + the facts the passes need."""
+
+    engine: str  # "batched" | "sharded"
+    variant: str  # "fused" | "split" | "race"
+    closed_jaxpr: object
+    in_avs: list
+    mesh_axes: Optional[dict]  # {} for batched (no collectives allowed)
+    donated: frozenset  # invar indices donated by the scan builders
+    cfg: HermesConfig
+
+    @property
+    def name(self) -> str:
+        return f"{self.engine}/{self.variant}"
+
+
+def _flat_seeds(cfg: HermesConfig, shapes, seed_tree) -> list:
+    import jax
+
+    want = jax.tree.structure(shapes)
+    have = jax.tree.structure(seed_tree)
+    if want != have:
+        raise ValueError(
+            "seed pytree no longer matches the engine state structure — "
+            "a state field was added/renamed without declaring its bound "
+            f"in analysis/seeds.py (engine {want}, seeds {have})")
+    return jax.tree.leaves(seed_tree)
+
+
+def variant_of(cfg: HermesConfig) -> str:
+    if cfg.use_fused_sort:
+        return "fused"
+    return "split" if cfg.arb_mode == "sort" else "race"
+
+
+def trace_program(cfg: HermesConfig, engine: str = "batched",
+                  mesh=None) -> Program:
+    import jax
+
+    from hermes_tpu.core import compat
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.workload import ycsb
+
+    if engine == "batched":
+        n_local = None
+
+        def fn(fs, stream, ctl):
+            return fst.fast_round_batched(cfg, ctl, fs, stream)
+
+        mesh_axes: Optional[dict] = {}
+    elif engine == "sharded":
+        from jax.sharding import Mesh
+        import numpy as np
+
+        if mesh is None:
+            devs = jax.devices()
+            if len(devs) < cfg.n_replicas:
+                raise RuntimeError(
+                    f"sharded analysis needs {cfg.n_replicas} devices, have "
+                    f"{len(devs)} (force a CPU mesh with XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=N)")
+            mesh = Mesh(np.array(devs[:cfg.n_replicas]), ("replica",))
+        n_local = cfg.n_replicas
+        mesh_axes = {name: int(size) for name, size in
+                     dict(mesh.shape).items()}
+
+        from jax.sharding import PartitionSpec as P
+
+        rspec = P("replica")
+        ctl_spec = fst.FastCtl(step=P(), my_cid=P(), epoch=rspec,
+                               live_mask=rspec, frozen=rspec, quiesce=P())
+
+        def shard_body(fs, stream, ctl):
+            import jax.numpy as jnp
+
+            my = jax.lax.axis_index("replica").astype(jnp.int32)
+            lctl = fst.FastCtl(step=ctl.step, my_cid=my[None],
+                               epoch=ctl.epoch, live_mask=ctl.live_mask,
+                               frozen=ctl.frozen, quiesce=ctl.quiesce)
+            return fst.fast_round_sharded(cfg, lctl, fs, stream)
+
+        fn = compat.shard_map(shard_body, mesh=mesh,
+                              in_specs=(rspec, rspec, ctl_spec),
+                              out_specs=(rspec, rspec))
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+
+    fs = jax.eval_shape(lambda: fst.init_fast_state(cfg, n_local=n_local))
+    stream = jax.eval_shape(lambda: fst.prep_stream(ycsb.stub_stream(cfg)))
+    ctl = jax.eval_shape(lambda: fst.make_fast_ctl(cfg, 0))
+    closed = jax.make_jaxpr(fn)(fs, stream, ctl)
+
+    seed_tree = seeds_lib.seed_round_args(cfg, has_uval=False)
+    in_avs = _flat_seeds(cfg, (fs, stream, ctl), seed_tree)
+    n_fs = len(jax.tree.leaves(fs))
+    return Program(engine=engine, variant=variant_of(cfg),
+                   closed_jaxpr=closed, in_avs=in_avs,
+                   mesh_axes=mesh_axes,
+                   # the scan builders donate the state pytree (leaves 0..n)
+                   donated=frozenset(range(n_fs)), cfg=cfg)
+
+
+def analyze_program(prog: Program, passes=None) -> dict:
+    """Run the passes over one traced program.  Returns the report dict:
+    findings (engine-stamped), proof counts, eqn count."""
+    ps = passes if passes is not None else default_passes(
+        allow_float=prog.cfg.device_stream)
+    ctx = Ctx(cfg=prog.cfg, mesh_axes=prog.mesh_axes, passes=ps,
+              donated=prog.donated)
+    jaxpr = prog.closed_jaxpr.jaxpr
+    eval_jaxpr(jaxpr, list(prog.in_avs), ctx,
+               consts=list(prog.closed_jaxpr.consts))
+    findings: List[Finding] = []
+    proved = {}
+    for p in ps:
+        if isinstance(p, ScatterHazardPass):
+            p.check_donation(ctx, jaxpr)
+        p.finalize(ctx)
+        for f in p.results():
+            f.engine = prog.name
+            findings.append(f)
+        proved[p.name] = p.n_proved
+    return dict(engine=prog.name, n_eqns=ctx.n_eqns, proved=proved,
+                findings=findings)
+
+
+def analyze_config(cfg: HermesConfig, engines=("batched", "sharded"),
+                   variants: str = "both", mesh=None) -> List[dict]:
+    """The shared driver: each engine x (as-configured + the split-sort
+    A/B program when the config resolves the fused sort).  ``variants``:
+    "both" | "as-is"."""
+    cfgs = [cfg]
+    if variants == "both" and cfg.use_fused_sort:
+        cfgs.append(dataclasses.replace(cfg, fused_sort=False))
+    reports = []
+    for engine in engines:
+        for c in cfgs:
+            prog = trace_program(c, engine, mesh=mesh)
+            reports.append(analyze_program(prog))
+    return reports

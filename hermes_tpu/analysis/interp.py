@@ -1,0 +1,629 @@
+"""Abstract interpreter over closed jaxprs (the analyzer's engine).
+
+Walks a traced protocol round equation by equation, propagating one
+``domain.AbsVal`` per array through ~50 primitive transfer rules, and
+invokes the registered analysis passes on every equation with the
+computed operand/result abstractions plus an ``wrapped`` overflow flag.
+Higher-order primitives recurse: ``pjit``/``closed_call`` bodies inline,
+``cond`` branches join, ``scan``/``while`` carries run a small widening
+loop, ``shard_map`` pushes its mesh's axis sizes (for ``axis_index`` and
+the sharding-consistency pass).  ``pallas_call`` bodies are SKIPPED —
+kernel-internal state primitives (get/swap) are not part of the round's
+packing surface; outputs become dtype-TOP.
+
+Unknown primitives are sound by construction: outputs default to the
+dtype's full range.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from hermes_tpu.analysis import domain as D
+from hermes_tpu.analysis.domain import AbsVal
+from hermes_tpu.core.layouts import AUDIT_PREFIX
+
+_AUDIT_RE = re.compile(re.escape(AUDIT_PREFIX) + r"\[([^\]]+)\]")
+
+
+def _jaxpr_types():
+    from jax.extend.core import ClosedJaxpr, Jaxpr
+
+    return Jaxpr, ClosedJaxpr
+
+
+def eqn_site(eqn) -> tuple:
+    """(file, line, function) of the closest user frame — for the engines
+    that is the hermes_tpu call site that built the op."""
+    try:
+        import jax._src.source_info_util as siu
+
+        fr = siu.user_frame(eqn.source_info)
+        if fr is None:
+            return ("<unknown>", 0, "<unknown>")
+        fname = fr.file_name
+        for root in ("hermes_tpu/", "tests/", "scripts/"):
+            i = fname.rfind(root)
+            if i >= 0:
+                fname = fname[i:]
+                break
+        else:
+            fname = fname.rsplit("/", 1)[-1]
+        return (fname, int(fr.start_line), fr.function_name)
+    except Exception:
+        return ("<unknown>", 0, "<unknown>")
+
+
+def eqn_audit(eqn) -> Optional[str]:
+    """The ``layouts.audited(tag)`` annotation covering this equation, if
+    any (the tag rides the jaxpr name stack)."""
+    try:
+        m = _AUDIT_RE.search(str(eqn.source_info.name_stack))
+        return m.group(1) if m else None
+    except Exception:
+        return None
+
+
+class Ctx:
+    """Interpreter context shared with the passes."""
+
+    def __init__(self, cfg=None, mesh_axes=None, passes=(), donated=None):
+        self.cfg = cfg
+        #: declared mesh axes {name: size}; {} = batched (no collectives
+        #: allowed); None = don't check
+        self.mesh_axes = mesh_axes
+        self.passes = list(passes)
+        self.axis_sizes: Dict[str, int] = {}  # live axis env (shard_map)
+        self.defs: Dict = {}  # Var -> defining eqn
+        self.env: Dict = {}  # Var -> AbsVal (flat across nesting)
+        #: Var -> Var/Literal across call boundaries (a pjit's outvar IS
+        #: its body's outvar; a body invar IS the caller's operand) — what
+        #: lets resolve() see the select_n inside a jnp.where wrapper
+        self.aliases: Dict = {}
+        self.donated = set(donated or ())
+        self.n_eqns = 0
+
+    # -- dataflow helpers for passes --------------------------------------
+    def canon(self, atom):
+        from jax.extend.core import Literal
+
+        seen = 0
+        while (not isinstance(atom, Literal) and atom in self.aliases
+               and seen < 256):
+            atom = self.aliases[atom]
+            seen += 1
+        return atom
+
+    def aval_of(self, atom) -> AbsVal:
+        from jax.extend.core import Literal
+
+        if isinstance(atom, Literal):
+            return D.from_concrete(atom.val)
+        if atom in self.env:
+            return self.env[atom]
+        atom = self.canon(atom)
+        if isinstance(atom, Literal):
+            return D.from_concrete(atom.val)
+        return self.env.get(atom, D.top(atom.aval.dtype))
+
+    def def_of(self, atom):
+        from jax.extend.core import Literal
+
+        atom = self.canon(atom)
+        if isinstance(atom, Literal):
+            return None
+        return self.defs.get(atom)
+
+    _TRANSPARENT = ("broadcast_in_dim", "reshape", "squeeze", "transpose",
+                    "copy", "rev", "convert_element_type", "stop_gradient")
+
+    def resolve(self, atom):
+        """Skip through shape/dtype-transparent defs to the value-defining
+        equation (None for inputs/literals)."""
+        seen = 0
+        while True:
+            e = self.def_of(atom)
+            if e is None or e.primitive.name not in self._TRANSPARENT:
+                return e
+            atom = e.invars[0]
+            seen += 1
+            if seen > 64:
+                return e
+
+    def is_const_like(self, atom) -> bool:
+        """Literal, constant abstract value, or a select over const-like
+        cases (the ``where(flag, CONST, 0)`` pack idiom)."""
+        av = self.aval_of(atom)
+        if av.is_const:
+            return True
+        e = self.resolve(atom)
+        if e is None:
+            return self.def_of(atom) is None and av.is_const
+        if e.primitive.name == "select_n":
+            return all(self.is_const_like(a) for a in e.invars[1:])
+        return False
+
+
+# --------------------------------------------------------------------------
+# Primitive transfer rules
+# --------------------------------------------------------------------------
+
+RULES: Dict[str, Callable] = {}
+
+
+def rule(*names):
+    def deco(fn):
+        for n in names:
+            RULES[n] = fn
+        return fn
+
+    return deco
+
+
+def _bool_out(eqn, ins, ctx):
+    return [D.iv(0, 1)]
+
+
+for _n in ("eq", "ne", "lt", "le", "gt", "ge", "reduce_or", "reduce_and",
+           "is_finite"):
+    RULES[_n] = _bool_out
+
+
+@rule("add")
+def _(eqn, ins, ctx):
+    return [D.add(ins[0], ins[1])]
+
+
+@rule("sub")
+def _(eqn, ins, ctx):
+    return [D.sub(ins[0], ins[1])]
+
+
+@rule("mul")
+def _(eqn, ins, ctx):
+    return [D.mul(ins[0], ins[1])]
+
+
+@rule("neg")
+def _(eqn, ins, ctx):
+    return [D.neg(ins[0])]
+
+
+@rule("max")
+def _(eqn, ins, ctx):
+    return [D.max_(ins[0], ins[1])]
+
+
+@rule("min")
+def _(eqn, ins, ctx):
+    return [D.min_(ins[0], ins[1])]
+
+
+@rule("and")
+def _(eqn, ins, ctx):
+    return [D.and_(ins[0], ins[1])]
+
+
+@rule("or")
+def _(eqn, ins, ctx):
+    return [D.or_(ins[0], ins[1])]
+
+
+@rule("xor")
+def _(eqn, ins, ctx):
+    return [D.xor(ins[0], ins[1])]
+
+
+@rule("not")
+def _(eqn, ins, ctx):
+    if D.is_bool(eqn.outvars[0].aval.dtype):
+        a = ins[0]
+        return [D.AbsVal(1 - min(a.hi, 1), 1 - max(a.lo, 0))]
+    return [D.not_(ins[0])]
+
+
+@rule("shift_left")
+def _(eqn, ins, ctx):
+    return [D.shl(ins[0], ins[1])]
+
+
+@rule("shift_right_arithmetic")
+def _(eqn, ins, ctx):
+    return [D.shr_arith(ins[0], ins[1])]
+
+
+@rule("shift_right_logical")
+def _(eqn, ins, ctx):
+    nbits = D.dtype_bits(eqn.invars[0].aval.dtype)
+    return [D.shr_logical(ins[0], ins[1], nbits)]
+
+
+@rule("rem")
+def _(eqn, ins, ctx):
+    return [D.rem(ins[0], ins[1])]
+
+
+@rule("div")
+def _(eqn, ins, ctx):
+    if D.is_int(eqn.outvars[0].aval.dtype):
+        return [D.div(ins[0], ins[1])]
+    return [D.top(eqn.outvars[0].aval.dtype)]
+
+
+@rule("abs")
+def _(eqn, ins, ctx):
+    return [D.abs_(ins[0])]
+
+
+@rule("sign")
+def _(eqn, ins, ctx):
+    return [D.iv(-1, 1)]
+
+
+@rule("clamp")
+def _(eqn, ins, ctx):
+    return [D.clamp3(ins[0], ins[1], ins[2])]
+
+
+def _base_atom(ctx, atom):
+    """Walk transparent defs (broadcast/reshape/convert/...) to the
+    underlying canonical atom, for identity comparisons."""
+    seen = 0
+    while True:
+        e = ctx.def_of(atom)
+        if e is None or e.primitive.name not in Ctx._TRANSPARENT:
+            return ctx.canon(atom)
+        atom = e.invars[0]
+        seen += 1
+        if seen > 64:
+            return ctx.canon(atom)
+
+
+def _refine_neg_index_select(eqn, ins, ctx):
+    """Path-sensitive refinement for jnp's negative-index normalization
+    ``select(x < 0, x + N, x)``: the joined hull [x.lo, x.hi + N] would
+    flag every basic-indexing gather as possibly OOB; splitting on the
+    guard gives the exact [0, N) bound the idiom guarantees."""
+    if len(eqn.invars) != 3:
+        return None
+    pred = ctx.resolve(eqn.invars[0])
+    if pred is None or pred.primitive.name != "lt":
+        return None
+    zav = ctx.aval_of(pred.invars[1])
+    if not (zav.is_const and zav.lo == 0):
+        return None
+    x_base = _base_atom(ctx, pred.invars[0])
+    xav = ctx.aval_of(pred.invars[0])
+    # false case must be x itself; true case must be x + const
+    if _base_atom(ctx, eqn.invars[1]) is not x_base:
+        return None
+    t_eqn = ctx.resolve(eqn.invars[2])
+    if t_eqn is None or t_eqn.primitive.name != "add":
+        return None
+    n_av = None
+    for a, b in (t_eqn.invars, reversed(t_eqn.invars)):
+        if _base_atom(ctx, a) is x_base and ctx.aval_of(b).is_const:
+            n_av = ctx.aval_of(b)
+            break
+    if n_av is None:
+        return None
+    n = n_av.lo
+    cases = []
+    if xav.hi >= 0:  # pred-false branch feasible: x >= 0
+        cases.append(AbsVal(max(0, xav.lo), xav.hi))
+    if xav.lo < 0:  # pred-true branch feasible: x < 0, shifted by N
+        cases.append(AbsVal(xav.lo + n, min(-1, xav.hi) + n))
+    return D.join_all(cases) if cases else None
+
+
+@rule("select_n")
+def _(eqn, ins, ctx):
+    refined = _refine_neg_index_select(eqn, ins, ctx)
+    if refined is not None:
+        return [refined]
+    return [D.join_all(ins[1:])]
+
+
+@rule("broadcast_in_dim", "reshape", "squeeze", "transpose", "copy", "rev",
+      "stop_gradient", "reduce_precision", "slice", "dynamic_slice",
+      "reduce_max", "reduce_min", "cummax", "cummin", "real",
+      "optimization_barrier", "all_gather", "all_to_all", "pmax", "pmin",
+      "ppermute", "expand_dims")
+def _passthrough(eqn, ins, ctx):
+    return [ins[0] for _ in eqn.outvars]
+
+
+@rule("convert_element_type")
+def _(eqn, ins, ctx):
+    # raw value unchanged; the dtype clamp downstream decides wrap
+    return [ins[0]]
+
+
+@rule("bitcast_convert_type")
+def _(eqn, ins, ctx):
+    # explicit reinterpret: value-preserving when it happens to fit,
+    # dtype-TOP otherwise — never reported as an implicit wrap
+    out_dtype = eqn.outvars[0].aval.dtype
+    av, wrapped = D.clamp(ins[0], out_dtype)
+    return [av if not wrapped else D.top(out_dtype)]
+
+
+@rule("iota")
+def _(eqn, ins, ctx):
+    shape = eqn.outvars[0].aval.shape
+    dim = eqn.params.get("dimension", 0)
+    n = shape[dim] if shape else 1
+    return [D.iv(0, max(0, n - 1))]
+
+
+@rule("concatenate")
+def _(eqn, ins, ctx):
+    return [D.join_all(ins)]
+
+
+@rule("pad")
+def _(eqn, ins, ctx):
+    return [D.join(ins[0], ins[1])]
+
+
+@rule("gather")
+def _(eqn, ins, ctx):
+    # OOB indices fill (default 0) or clamp — join keeps it sound
+    return [D.join(ins[0], D.iv(0))]
+
+
+@rule("scatter", "scatter-max", "scatter-min")
+def _(eqn, ins, ctx):
+    return [D.join(ins[0], ins[2] if len(ins) > 2 else ins[-1])]
+
+
+@rule("scatter-add", "scatter-mul")
+def _(eqn, ins, ctx):
+    upd = ins[2] if len(ins) > 2 else ins[-1]
+    n = max(1, int(np.prod(eqn.invars[-1].aval.shape or (1,))))
+    return [D.join(ins[0], D.add(ins[0], D.sum_n(upd, n)))]
+
+
+@rule("dynamic_update_slice")
+def _(eqn, ins, ctx):
+    return [D.join(ins[0], ins[1])]
+
+
+@rule("reduce_sum")
+def _(eqn, ins, ctx):
+    axes = eqn.params.get("axes", ())
+    shape = eqn.invars[0].aval.shape
+    n = 1
+    for a in axes:
+        n *= shape[a]
+    return [D.sum_n(ins[0], n)]
+
+
+@rule("cumsum")
+def _(eqn, ins, ctx):
+    axis = eqn.params.get("axis", 0)
+    n = eqn.invars[0].aval.shape[axis] if eqn.invars[0].aval.shape else 1
+    return [D.prefix_sums(ins[0], n)]
+
+
+@rule("argmax", "argmin")
+def _(eqn, ins, ctx):
+    axes = eqn.params.get("axes", (0,))
+    shape = eqn.invars[0].aval.shape
+    n = shape[axes[0]] if shape else 1
+    return [D.iv(0, max(0, n - 1))]
+
+
+@rule("sort")
+def _(eqn, ins, ctx):
+    # a joint sort permutes every operand identically: value sets (and
+    # therefore bounds) are preserved per operand
+    return list(ins)
+
+
+@rule("top_k")
+def _(eqn, ins, ctx):
+    shape = eqn.invars[0].aval.shape
+    n = shape[-1] if shape else 1
+    return [ins[0], D.iv(0, max(0, n - 1))]
+
+
+@rule("axis_index")
+def _(eqn, ins, ctx):
+    name = eqn.params.get("axis_name")
+    size = ctx.axis_sizes.get(name)
+    if size is None:
+        return [D.top(eqn.outvars[0].aval.dtype)]
+    return [D.iv(0, max(0, size - 1))]
+
+
+@rule("psum", "psum2")
+def _(eqn, ins, ctx):
+    axes = eqn.params.get("axes", ())
+    n = 1
+    for a in axes:
+        if isinstance(a, str):
+            n *= ctx.axis_sizes.get(a, 1)
+    return [D.sum_n(x, n) for x in ins]
+
+
+# --------------------------------------------------------------------------
+# The walk
+# --------------------------------------------------------------------------
+
+_CALL_JAXPR_PRIMS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "named_call": "call_jaxpr",
+    "remat2": "jaxpr",
+    "checkpoint": "jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+}
+
+_SKIP_INNER = {"pallas_call"}  # kernel-internal state prims: outputs TOP
+
+
+def _as_open(j):
+    Jaxpr, ClosedJaxpr = _jaxpr_types()
+    if isinstance(j, ClosedJaxpr):
+        return j.jaxpr, list(j.consts)
+    return j, []
+
+
+def eval_jaxpr(jaxpr, in_avs: List[AbsVal], ctx: Ctx,
+               consts: Optional[list] = None) -> List[AbsVal]:
+    env = ctx.env
+    for v, c in zip(jaxpr.constvars, consts or []):
+        env[v] = D.from_concrete(c)
+    for v, av in zip(jaxpr.invars, in_avs):
+        env[v] = av
+    for eqn in jaxpr.eqns:
+        ctx.n_eqns += 1
+        ins = [ctx.aval_of(a) for a in eqn.invars]
+        outs, wrapped = _eval_eqn(eqn, ins, ctx)
+        for p in ctx.passes:
+            p.on_eqn(ctx, eqn, ins, outs, wrapped)
+        for v, av in zip(eqn.outvars, outs):
+            env[v] = av
+            ctx.defs[v] = eqn
+    return [ctx.aval_of(a) for a in jaxpr.outvars]
+
+
+def _eval_eqn(eqn, ins, ctx):
+    name = eqn.primitive.name
+    if name in _SKIP_INNER:
+        return [D.top(v.aval.dtype) for v in eqn.outvars], False
+    if name == "shard_map":
+        return _eval_shard_map(eqn, ins, ctx), False
+    if name == "cond":
+        return _eval_cond(eqn, ins, ctx), False
+    if name == "while":
+        return _eval_while(eqn, ins, ctx), False
+    if name == "scan":
+        return _eval_scan(eqn, ins, ctx), False
+    if name in _CALL_JAXPR_PRIMS:
+        inner = eqn.params.get(_CALL_JAXPR_PRIMS[name])
+        if inner is not None:
+            j, consts = _as_open(inner)
+            for inner_v, outer_a in zip(j.invars, eqn.invars):
+                ctx.aliases[inner_v] = outer_a
+            outs = eval_jaxpr(j, list(ins), ctx, consts)
+            for outer_v, inner_a in zip(eqn.outvars, j.outvars):
+                ctx.aliases[outer_v] = inner_a
+            return _refine_named_call(eqn, ins, outs, ctx), False
+
+    fn = RULES.get(name)
+    if fn is None:
+        return [D.top(v.aval.dtype) for v in eqn.outvars], False
+    raw = fn(eqn, ins, ctx)
+    outs, wrapped = [], False
+    for v, av in zip(eqn.outvars, raw):
+        c, w = D.clamp(av, v.aval.dtype)
+        outs.append(c)
+        wrapped = wrapped or w
+    return outs, wrapped
+
+
+def _refine_named_call(eqn, ins, outs, ctx):
+    """Contract-based refinement for jnp ops that lower as named pjit
+    wrappers.  ``jnp.remainder``/``jnp.mod`` build floor-mod from
+    trunc-rem plus a sign-fix select whose abstract join spans
+    [-(y-1), 2y-1]; the OP's contract for a positive divisor is [0, y-1],
+    which is what makes ``(key + rot) % n`` provably in-bounds."""
+    if eqn.params.get("name") in ("remainder", "mod") and len(ins) == 2:
+        b = ins[1]
+        if b.lo > 0 and len(outs) == 1:
+            m = b.hi - 1
+            o = outs[0]
+            return [AbsVal(max(0, min(o.lo, m)), max(0, min(o.hi, m)))]
+    return outs
+
+
+def _eval_shard_map(eqn, ins, ctx):
+    mesh = eqn.params.get("mesh")
+    saved = dict(ctx.axis_sizes)
+    try:
+        if mesh is not None:
+            for name, size in dict(mesh.shape).items():
+                ctx.axis_sizes[name] = int(size)
+        j, consts = _as_open(eqn.params["jaxpr"])
+        for inner_v, outer_a in zip(j.invars, eqn.invars):
+            ctx.aliases[inner_v] = outer_a
+        outs = eval_jaxpr(j, list(ins), ctx, consts)
+        for outer_v, inner_a in zip(eqn.outvars, j.outvars):
+            ctx.aliases[outer_v] = inner_a
+        return outs
+    finally:
+        ctx.axis_sizes = saved
+
+
+def _eval_cond(eqn, ins, ctx):
+    outs = None
+    for br in eqn.params["branches"]:
+        j, consts = _as_open(br)
+        o = eval_jaxpr(j, list(ins[1:]), ctx, consts)
+        outs = o if outs is None else [D.join(a, b) for a, b in zip(outs, o)]
+    return outs
+
+
+def _widen_loop(body_fn, init: List[AbsVal], max_iter: int = 3):
+    """Small widening loop for scan/while carries: join until stable,
+    then give unstable elements dtype-free TOP-ish bounds via join."""
+    carry = list(init)
+    last = None
+    for _ in range(max_iter):
+        out = body_fn(carry)
+        nxt = [D.join(c, o) for c, o in zip(carry, out)]
+        if last is not None and all(
+                n.lo == c.lo and n.hi == c.hi for n, c in zip(nxt, carry)):
+            return nxt, out
+        last = carry
+        carry = nxt
+    # not stabilized: widen hard
+    widened = []
+    for c, i in zip(carry, init):
+        widened.append(AbsVal(min(c.lo, -(1 << 63)), max(c.hi, 1 << 63))
+                       if not (c.lo == i.lo and c.hi == i.hi) else c)
+    out = body_fn(widened)
+    return widened, out
+
+
+def _eval_scan(eqn, ins, ctx):
+    nc = eqn.params.get("num_consts", 0)
+    ncar = eqn.params.get("num_carry", 0)
+    j, jconsts = _as_open(eqn.params["jaxpr"])
+    consts, init, xs = ins[:nc], ins[nc:nc + ncar], ins[nc + ncar:]
+
+    ys_box = []
+
+    def body(carry):
+        o = eval_jaxpr(j, consts + carry + xs, ctx, jconsts)
+        ys_box[:] = o[ncar:]
+        return o[:ncar]
+
+    carry, _last = _widen_loop(body, list(init))
+    outs = carry + list(ys_box)
+    # clamp everything back to the declared out dtypes
+    return [D.clamp(a, v.aval.dtype)[0] for a, v in zip(outs, eqn.outvars)]
+
+
+def _eval_while(eqn, ins, ctx):
+    cn = eqn.params.get("cond_nconsts", 0)
+    bn = eqn.params.get("body_nconsts", 0)
+    bj, bconsts = _as_open(eqn.params["body_jaxpr"])
+    cconsts_avs = ins[:cn]
+    bconsts_avs = ins[cn:cn + bn]
+    init = ins[cn + bn:]
+
+    def body(carry):
+        return eval_jaxpr(bj, bconsts_avs + carry, ctx, bconsts)
+
+    carry, _ = _widen_loop(body, list(init))
+    del cconsts_avs
+    return [D.clamp(a, v.aval.dtype)[0] for a, v in zip(carry, eqn.outvars)]

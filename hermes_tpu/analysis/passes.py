@@ -1,0 +1,400 @@
+"""The analyzer's pluggable passes and their finding records.
+
+Four passes ship (ISSUE 3):
+
+  * ``BitPackPass`` — every shift/or pack in the traced round must be
+    overlap-free and sign-safe under the config-seeded bounds.  A pack
+    site is an ``or`` whose operand is a shift result or a constant-like
+    mask (the ``key | where(flag, BIT, 0)`` idiom); plain bitmap unions
+    (ack aggregation) are not pack sites and are never flagged.
+  * ``DtypePromotionPass`` — no silent 64-bit widening, no floats in an
+    integer round, and every integer convert must be value-preserving
+    under the seeded bounds (a wrapping convert must be an explicit
+    same-width ``bitcast_convert_type`` — see faststep's byte codec).
+  * ``ScatterHazardPass`` — set-scatters need injectivity evidence
+    (``unique_indices=True``, or a ``layouts.audited`` justification for
+    protocol-invariant uniqueness); commutative scatters (max/min) are
+    exempt.  Donated buffers must have an aliasable output.
+  * ``ShardingConsistencyPass`` — collectives name declared mesh axes
+    with matching sizes, shard_map meshes agree with the engine's
+    declaration, batched programs contain no collectives at all.
+
+Severity contract (the CI gate, scripts/check_analysis.py):
+
+  * ``error``  — a violation provable from config-seeded facts; fails the
+    gate unless explicitly grandfathered in ANALYSIS_BASELINE.json.
+  * ``warn``   — a structural hazard the analyzer cannot discharge; fails
+    the gate unless baselined.
+  * ``info``   — a discharged assumption (audited sites, annotation
+    trusts): never gates, always listed, so suppressions stay visible.
+
+Findings inside a ``layouts.audited(tag)`` scope are downgraded to info
+and carry the tag — the audit is the documented proof obligation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from hermes_tpu.analysis import domain as D
+from hermes_tpu.analysis.interp import Ctx, eqn_audit, eqn_site
+
+ERROR, WARN, INFO = "error", "warn", "info"
+_SEV_RANK = {ERROR: 2, WARN: 1, INFO: 0}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer fact, keyed stably for baseline matching (the key
+    excludes the line number so a pure-motion refactor does not churn
+    ANALYSIS_BASELINE.json; ``--update`` handles intentional changes)."""
+
+    pass_name: str
+    code: str
+    severity: str
+    message: str
+    file: str = "<unknown>"
+    line: int = 0
+    fn: str = "<unknown>"
+    op: str = ""
+    engine: str = ""
+    audit: Optional[str] = None
+    count: int = 1
+
+    @property
+    def key(self) -> str:
+        return "|".join((self.engine, self.pass_name, self.code, self.file,
+                         self.fn, self.op))
+
+    @property
+    def site(self) -> str:
+        return f"{self.file}:{self.line}"
+
+    def record(self) -> dict:
+        """Obs run-log JSONL payload (kind="analysis")."""
+        return dict(record="finding", pass_=self.pass_name, code=self.code,
+                    severity=self.severity, engine=self.engine,
+                    site=self.site, fn=self.fn, op=self.op, audit=self.audit,
+                    count=self.count, message=self.message, key=self.key)
+
+
+class Pass:
+    """Base: dedups findings by (code, site, op) and counts proof sites."""
+
+    name = "pass"
+
+    def __init__(self):
+        self.findings: Dict[tuple, Finding] = {}
+        self.n_proved = 0
+
+    def on_eqn(self, ctx: Ctx, eqn, ins, outs, wrapped) -> None:
+        pass
+
+    def finalize(self, ctx: Ctx) -> None:
+        pass
+
+    def emit(self, eqn, code: str, severity: str, message: str) -> None:
+        file, line, fn = eqn_site(eqn)
+        audit = eqn_audit(eqn)
+        if audit is not None and severity != INFO:
+            message = f"audited[{audit}]: {message}"
+            severity = INFO
+        k = (code, file, line, fn, eqn.primitive.name, audit)
+        f = self.findings.get(k)
+        if f is None:
+            self.findings[k] = Finding(
+                pass_name=self.name, code=code, severity=severity,
+                message=message, file=file, line=line, fn=fn,
+                op=eqn.primitive.name, audit=audit)
+        else:
+            f.count += 1
+
+    def results(self) -> List[Finding]:
+        return sorted(self.findings.values(),
+                      key=lambda f: (-_SEV_RANK[f.severity], f.file, f.line))
+
+
+# --------------------------------------------------------------------------
+# 1. bit-pack interval analysis
+# --------------------------------------------------------------------------
+
+
+class BitPackPass(Pass):
+    name = "bitpack"
+
+    def _is_pack_operand(self, ctx: Ctx, atom) -> bool:
+        e = ctx.resolve(atom)
+        # a shift result, a previous pack (chained `a | b | c`), or a
+        # constant-like mask makes the `or` a field pack
+        if e is not None and e.primitive.name in ("shift_left", "or"):
+            return True
+        return ctx.is_const_like(atom)
+
+    def on_eqn(self, ctx: Ctx, eqn, ins, outs, wrapped) -> None:
+        name = eqn.primitive.name
+        if name == "shift_left":
+            if not D.is_int(eqn.outvars[0].aval.dtype):
+                return
+            if wrapped:
+                a, s = ins
+                self.emit(
+                    eqn, "pack-shift-overflow", ERROR,
+                    f"left shift can escape {eqn.outvars[0].aval.dtype}: "
+                    f"operand {a} << {s} — the shifted field can reach the "
+                    f"sign bit / wrap; widen the layout or bound the field")
+            else:
+                self.n_proved += 1
+            return
+        if name != "or" or D.is_bool(eqn.outvars[0].aval.dtype):
+            return
+        a_pack = self._is_pack_operand(ctx, eqn.invars[0])
+        b_pack = self._is_pack_operand(ctx, eqn.invars[1])
+        if not (a_pack or b_pack):
+            return  # a bitmap union, not a field pack
+        a, b = ins
+        if a.lo < 0 or b.lo < 0:
+            self.emit(
+                eqn, "pack-negative-operand", ERROR,
+                f"pack operand may be negative ({a} | {b}): a sign-extended "
+                f"value sets every high bit and aliases all fields above it")
+            return
+        overlap = a.ones & b.ones
+        if overlap:
+            self.emit(
+                eqn, "pack-overlap", ERROR,
+                f"packed fields may overlap on mask 0x{overlap:x} "
+                f"({a} | {b}): a field value can alias its neighbor's bits")
+            return
+        self.n_proved += 1
+
+
+# --------------------------------------------------------------------------
+# 2. dtype promotion lint
+# --------------------------------------------------------------------------
+
+
+class DtypePromotionPass(Pass):
+    name = "dtype"
+
+    def __init__(self, allow_float: bool = False):
+        super().__init__()
+        self.allow_float = allow_float
+
+    def on_eqn(self, ctx: Ctx, eqn, ins, outs, wrapped) -> None:
+        import numpy as np
+
+        name = eqn.primitive.name
+        for v in eqn.outvars:
+            dt = np.dtype(getattr(v.aval, "dtype", np.int32))
+            if dt.itemsize == 8 and dt.kind in "iuf":
+                self.emit(eqn, "silent-64bit", ERROR,
+                          f"{name} produces {dt}: a 64-bit value on the "
+                          f"round chain (x64 should be off; an i64/f64 "
+                          f"upcast doubles wire/HBM bytes silently)")
+            elif (not self.allow_float and dt.kind == "f"
+                  and name != "convert_element_type"):
+                self.emit(eqn, "float-in-round", WARN,
+                          f"{name} produces {dt} in an integer protocol "
+                          f"round (only device_stream zipfian sampling may "
+                          f"use floats)")
+        if name != "convert_element_type":
+            return
+        src = np.dtype(eqn.invars[0].aval.dtype)
+        dst = np.dtype(eqn.outvars[0].aval.dtype)
+        if src.kind == "f" and dst.kind in "iu" and not self.allow_float:
+            self.emit(eqn, "float-to-int", WARN,
+                      f"float->int convert ({src}->{dst}) in an integer "
+                      f"round")
+            return
+        if src.kind not in "iub" or dst.kind not in "iub":
+            return
+        if wrapped:
+            self.emit(
+                eqn, "implicit-wrap-convert", WARN,
+                f"convert {src}->{dst} can change the value "
+                f"(operand {ins[0]} escapes {dst}): a silent two's-"
+                f"complement wrap — make the reinterpretation explicit "
+                f"with a same-width lax.bitcast_convert_type, or mask "
+                f"first (see faststep._bank_to_i32)")
+        else:
+            self.n_proved += 1
+
+
+# --------------------------------------------------------------------------
+# 3. scatter/gather hazard detector
+# --------------------------------------------------------------------------
+
+
+class ScatterHazardPass(Pass):
+    name = "scatter"
+
+    def on_eqn(self, ctx: Ctx, eqn, ins, outs, wrapped) -> None:
+        name = eqn.primitive.name
+        if name == "gather":
+            self._check_bounds(ctx, eqn, ins, operand_idx=0, index_idx=1,
+                               dims=eqn.params["dimension_numbers"]
+                               .start_index_map)
+            return
+        if not name.startswith("scatter"):
+            return
+        dn = eqn.params["dimension_numbers"]
+        self._check_bounds(ctx, eqn, ins, operand_idx=0, index_idx=1,
+                           dims=dn.scatter_dims_to_operand_dims)
+        if name != "scatter":
+            self.n_proved += 1  # max/min/add: duplicate indices commute
+            return
+        if eqn.params.get("unique_indices"):
+            self.emit(
+                eqn, "scatter-unique-annotated", INFO,
+                "set-scatter trusts its unique_indices=True annotation "
+                "(XLA behavior is undefined if violated); covered by the "
+                "analyzer only as an assumption")
+            return
+        self.emit(
+            eqn, "scatter-set-not-injective", WARN,
+            "set-scatter without injectivity evidence: duplicate indices "
+            "make the written row unspecified (XLA picks one).  Prove it "
+            "(unique_indices=True), or audit the protocol invariant that "
+            "makes duplicates deterministic (layouts.audited)")
+
+    def _check_bounds(self, ctx: Ctx, eqn, ins, operand_idx, index_idx,
+                      dims) -> None:
+        from jax.lax import GatherScatterMode
+
+        mode = eqn.params.get("mode")
+        if mode != GatherScatterMode.PROMISE_IN_BOUNDS:
+            return  # FILL_OR_DROP / CLIP: OOB is defined (the mask idiom)
+        idx = ins[index_idx]
+        shape = eqn.invars[operand_idx].aval.shape
+        cap = min((shape[d] for d in dims), default=None)
+        if cap is None:
+            return
+        if idx.lo < 0 or idx.hi >= cap:
+            self.emit(
+                eqn, "oob-promised-index", ERROR,
+                f"indices {idx} can leave [0, {cap}) but the op PROMISES "
+                f"in-bounds: out-of-bounds behavior is undefined")
+        else:
+            self.n_proved += 1
+
+    def check_donation(self, ctx: Ctx, jaxpr) -> None:
+        """Donated-buffer aliasing: every donated input must have a
+        shape/dtype-matched output XLA can alias it to, or the donation
+        silently buys nothing (jax warns at RUN time; this is the static
+        version, findable before a chip is involved)."""
+        if not ctx.donated:
+            return
+        outs = {}
+        for o in jaxpr.outvars:
+            aval = getattr(o, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                k = (tuple(aval.shape), str(aval.dtype))
+                outs[k] = outs.get(k, 0) + 1
+        for i in sorted(ctx.donated):
+            if i >= len(jaxpr.invars):
+                continue
+            v = jaxpr.invars[i]
+            k = (tuple(v.aval.shape), str(v.aval.dtype))
+            if outs.get(k, 0) > 0:
+                outs[k] -= 1
+                self.n_proved += 1
+            else:
+                self.findings[("donation-wasted", "<program>", 0, "<io>",
+                               str(i))] = Finding(
+                    pass_name=self.name, code="donation-wasted",
+                    severity=WARN, file="<program>", fn="<io>", op=f"arg{i}",
+                    message=f"donated argument {i} {k} has no shape/dtype-"
+                            f"matched output to alias: the donation cannot "
+                            f"be honored and XLA will copy")
+
+
+# --------------------------------------------------------------------------
+# 4. sharding consistency
+# --------------------------------------------------------------------------
+
+_COLLECTIVES = ("all_gather", "all_to_all", "psum", "psum2", "pmax", "pmin",
+                "ppermute", "all_reduce", "reduce_scatter", "pgather",
+                "axis_index")
+
+
+class ShardingConsistencyPass(Pass):
+    name = "sharding"
+
+    def _axis_names(self, eqn) -> list:
+        names = eqn.params.get("axis_name",
+                               eqn.params.get("axes",
+                                              eqn.params.get("axis_names")))
+        if names is None:
+            return []
+        if not isinstance(names, (tuple, list)):
+            names = (names,)
+        return [n for n in names if isinstance(n, str)]
+
+    def on_eqn(self, ctx: Ctx, eqn, ins, outs, wrapped) -> None:
+        declared = ctx.mesh_axes
+        if declared is None:
+            return
+        name = eqn.primitive.name
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            if mesh is not None:
+                for ax, size in dict(mesh.shape).items():
+                    if ax not in declared:
+                        self.emit(eqn, "unknown-mesh-axis", ERROR,
+                                  f"shard_map mesh axis {ax!r} is not a "
+                                  f"declared engine axis {sorted(declared)}")
+                    elif declared[ax] != int(size):
+                        self.emit(eqn, "axis-size-mismatch", ERROR,
+                                  f"shard_map axis {ax!r} has size {size}, "
+                                  f"engine declares {declared[ax]} "
+                                  f"(per-replica shapes will disagree)")
+                    else:
+                        self.n_proved += 1
+            return
+        if name not in _COLLECTIVES:
+            return
+        if not declared:
+            self.emit(eqn, "collective-in-batched-engine", ERROR,
+                      f"{name} in the batched (single-chip) engine: the "
+                      f"lockstep emulation must not contain wire ops")
+            return
+        ok = True
+        for ax in self._axis_names(eqn):
+            if ax not in declared:
+                ok = False
+                self.emit(eqn, "unknown-mesh-axis", ERROR,
+                          f"{name} names mesh axis {ax!r}; declared axes "
+                          f"are {sorted(declared)}")
+        if name == "all_gather":
+            sz = eqn.params.get("axis_size")
+            axs = self._axis_names(eqn)
+            want = 1
+            for ax in axs:
+                want *= declared.get(ax, 1)
+            if sz is not None and axs and int(sz) != want:
+                ok = False
+                self.emit(eqn, "axis-size-mismatch", ERROR,
+                          f"all_gather axis_size={sz} but the declared "
+                          f"axes {axs} multiply to {want}")
+        if name == "all_to_all":
+            split = eqn.params.get("split_axis")
+            axs = self._axis_names(eqn)
+            size = 1
+            for ax in axs:
+                size *= declared.get(ax, 1)
+            shape = eqn.invars[0].aval.shape
+            if (split is not None and size > 1 and split < len(shape)
+                    and shape[split] % size != 0):
+                ok = False
+                self.emit(eqn, "uneven-all-to-all", ERROR,
+                          f"all_to_all splits dim {split} of {shape} by "
+                          f"axis size {size}: not divisible — per-replica "
+                          f"shapes disagree")
+        if ok:
+            self.n_proved += 1
+
+
+def default_passes(allow_float: bool = False) -> list:
+    return [BitPackPass(), DtypePromotionPass(allow_float=allow_float),
+            ScatterHazardPass(), ShardingConsistencyPass()]

@@ -1,0 +1,113 @@
+"""Config-seeded abstract bounds for the fast engines' inputs.
+
+The analyzer's theorems are only as strong as the facts it starts from.
+This module turns a ``HermesConfig`` plus the declared field layouts
+(core/layouts.py) into one ``AbsVal`` per input leaf of the round
+programs — sess.key is in [0, n_keys), a packed ts fits the declared
+ver budget, ctl.step fits the SST step field, op_idx fits the write-uid
+budget the config validates, and so on.  Facts that are PROTOCOL
+invariants rather than config facts (e.g. "a winner-row pts mirror holds
+a watermark-bounded ts") are deliberately NOT seeded: the engine audits
+those sites explicitly (layouts.audited) so the assumption shows up in
+the findings stream instead of being silently assumed here.
+
+The seed pytrees mirror the state containers field by field — a renamed
+or added FastState field breaks the structure match loudly (by design:
+new state must state its bounds)."""
+
+from __future__ import annotations
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import layouts
+from hermes_tpu.core import state as st
+from hermes_tpu.analysis.domain import AbsVal, iv, top
+
+import numpy as np
+
+I32_TOP = top(np.int32)
+I8_TOP = top(np.int8)
+BOOL = iv(0, 1)
+COUNTER = iv(0, (1 << 31) - 1)  # monotone device counters (non-negative)
+
+
+def pts_seed(cfg: HermesConfig) -> AbsVal:
+    """Any legally minted packed timestamp: ver within the declared budget
+    (enforced by the Meta.max_pts watermark + auto-rebase), any fc."""
+    return iv(0, (layouts.MAX_KEY_VERSIONS << layouts.PTS_FC_BITS)
+              | layouts.FC_MASK)
+
+
+def step_seed(cfg: HermesConfig) -> AbsVal:
+    """The round counter, bounded by the declared SST step field (the
+    packed state+age word is the binding constraint: 2^28 rounds)."""
+    return iv(0, layouts.MAX_STEPS - 1)
+
+
+def op_idx_seed(cfg: HermesConfig) -> AbsVal:
+    """Per-session op counter.  Clip mode tops out at ops_per_session;
+    wrap mode grows until the write-uid formula op_idx*S + s would leave
+    int31 — the budget HermesConfig documents and validates."""
+    if cfg.wrap_stream:
+        return iv(0, max(cfg.ops_per_session,
+                         (1 << 31) // max(1, cfg.n_sessions) - 1))
+    return iv(0, cfg.ops_per_session)
+
+
+def seed_fast_state(cfg: HermesConfig):
+    from hermes_tpu.core import faststep as fst
+
+    key = iv(0, cfg.n_keys - 1)
+    pts = pts_seed(cfg)
+    stp = step_seed(cfg)
+    acks = iv(0, cfg.full_mask)
+    meta = st.Meta(
+        last_seen=stp, n_read=COUNTER, n_write=COUNTER, n_rmw=COUNTER,
+        n_abort=COUNTER, lat_sum=COUNTER, lat_cnt=COUNTER, lat_hist=COUNTER,
+        max_pts=pts, n_inv=COUNTER, n_rebcast=COUNTER, n_nack=COUNTER,
+        n_retry=COUNTER, replay_peak=iv(0, cfg.replay_slots),
+        qwait_sum=COUNTER, qwait_hist=COUNTER,
+    )
+    return fst.FastState(
+        table=fst.FastTable(vpts=pts, bank=I8_TOP),
+        sess=fst.FastSess(
+            status=iv(0, 4),  # types.S_IDLE..S_DONE
+            op=iv(0, 3),  # types.OP_NOP..OP_RMW
+            op_idx=op_idx_seed(cfg),
+            key=key,
+            val=I8_TOP,
+            pts=pts,
+            acks=acks,
+            rd_val=I8_TOP,
+            invoke_step=stp,
+            retries=iv(0, max(1, cfg.rmw_retries)),
+            issue_step=stp,
+        ),
+        replay=fst.FastReplay(active=BOOL, key=key, pts=pts, val=I8_TOP,
+                              acks=acks),
+        meta=meta,
+    )
+
+
+def seed_stream(cfg: HermesConfig, has_uval: bool = False):
+    return st.OpStream(op=iv(0, 3), key=iv(0, cfg.n_keys - 1),
+                       uval=I8_TOP if has_uval else None)
+
+
+def seed_fast_ctl(cfg: HermesConfig):
+    from hermes_tpu.core import faststep as fst
+
+    return fst.FastCtl(
+        step=step_seed(cfg),
+        my_cid=iv(0, cfg.n_replicas - 1),
+        epoch=iv(0, layouts.BLOCK_META.field("epoch").cap - 1),
+        live_mask=iv(0, cfg.full_mask),
+        frozen=BOOL,
+        quiesce=BOOL,
+    )
+
+
+def seed_round_args(cfg: HermesConfig, has_uval: bool = False) -> tuple:
+    """(fs, stream, ctl) seed pytrees, structure-matched to the round
+    builders' arguments."""
+    return (seed_fast_state(cfg), seed_stream(cfg, has_uval),
+            seed_fast_ctl(cfg))

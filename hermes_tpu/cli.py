@@ -93,6 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the run config's round op census + cost-model"
                     " pricing as obs profile records (fast backends only; "
                     "abstract lowering — adds no device work to the run)")
+    ap.add_argument("--analyze", type=str, default=None,
+                    metavar="FINDINGS_JSONL",
+                    help="run the static jaxpr invariant analyzer "
+                    "(hermes_tpu.analysis) on the run config's round program "
+                    "and write the findings as obs analysis records (fast "
+                    "backends only; abstract tracing — no device work)")
     return ap
 
 
@@ -121,6 +127,12 @@ def main(argv=None) -> int:
     if args.profile_out and args.acceptance:
         ap.error("--profile-out does not apply to acceptance runs (they "
                  "build their own configs); census a run config instead")
+    if args.analyze and args.backend not in ("fast", "fast-sharded"):
+        ap.error("--analyze traces the fast round (core/faststep.py); "
+                 "use --backend fast or fast-sharded")
+    if args.analyze and args.acceptance:
+        ap.error("--analyze does not apply to acceptance runs (they build "
+                 "their own configs); analyze a run config instead")
 
     from hermes_tpu import stats as stats_lib
     from hermes_tpu.config import HermesConfig, WorkloadConfig
@@ -279,6 +291,17 @@ def main(argv=None) -> int:
         eng = "batched" if args.backend == "fast" else "sharded"
         prof_mod.export_profile(args.profile_out, [prof_mod.round_record(
             prof_mod.op_census(cfg, eng, mesh), backend=eng)])
+
+    if args.analyze:
+        from hermes_tpu import analysis as ana
+
+        eng = "batched" if args.backend == "fast" else "sharded"
+        reports = [ana.analyze_program(ana.trace_program(cfg, eng,
+                                                         mesh=mesh))]
+        ana.export_findings(args.analyze, reports)
+        n_gating = sum(1 for r in reports for f in r["findings"]
+                       if f.severity in ana.GATING)
+        print(f"analysis: {n_gating} gating finding(s) -> {args.analyze}")
 
     try:
         if args.check:

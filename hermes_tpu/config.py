@@ -11,6 +11,14 @@ from __future__ import annotations
 import dataclasses
 from typing import Literal, Optional
 
+from hermes_tpu.core import layouts
+
+# The declared chain-rank field must hold every legal chain_writes value
+# (the [0, 4096] protocol bound below); a layout edit that shrinks the
+# field without revisiting the bound fails at import, not at runtime.
+assert 4096 < layouts.LANE_WORD.field("chain_rank").cap
+assert 4096 < layouts.ARB_WORD.field("chain_rank").cap
+
 
 @dataclasses.dataclass(frozen=True)
 class WorkloadConfig:
@@ -197,10 +205,12 @@ class HermesConfig:
             )
         if not (0 <= self.rmw_retries <= (1 << 20)):
             raise ValueError("rmw_retries must be in [0, 2^20]")
-        if self.n_keys > (1 << 29):
+        if self.n_keys > layouts.INV_PKF.field("key").cap:
             raise ValueError(
-                "n_keys must fit 29 bits (faststep packs key|fresh|valid "
-                "into one int32 INV word)"
+                "n_keys must fit the declared INV key field "
+                f"({layouts.INV_PKF.field('key').bits} bits — faststep "
+                "packs key|fresh|valid into one int32 INV word; see "
+                "core/layouts.py)"
             )
         if self.value_words < 2:
             raise ValueError("value_words >= 2 (words 0-1 carry the unique write id)")
@@ -229,12 +239,13 @@ class HermesConfig:
     def use_fused_sort(self) -> bool:
         """Resolved fused-sort switch (faststep._coordinate): the single
         arbiter+compaction sort needs the sort arbiter and a packed key of
-        (band 2b | sub 29b) — sub holds the rotated key for issue runs and
-        the rotation index for waiting/replay lanes, so both n_keys
-        (config-enforced) and n_lanes must fit 29 bits.  Anything else
-        falls back to the split two-sort program."""
+        (band 2b | sub 29b, layouts.FUSED_KEY) — sub holds the rotated key
+        for issue runs and the rotation index for waiting/replay lanes, so
+        both n_keys (config-enforced) and n_lanes must fit the declared
+        sub field.  Anything else falls back to the split two-sort
+        program."""
         return (self.arb_mode == "sort" and self.fused_sort
-                and self.n_lanes <= (1 << 29))
+                and self.n_lanes <= layouts.FUSED_KEY.field("sub").cap)
 
     @property
     def lane_budget(self) -> int:
@@ -246,8 +257,9 @@ class HermesConfig:
     @property
     def max_key_versions(self) -> int:
         """faststep's packed-ts limit: versions one key can take before the
-        int32 sign bit corrupts the Lamport compare (core/faststep.py)."""
-        return 1 << (31 - 10 - 1)
+        int32 sign bit corrupts the Lamport compare (the declared ver-field
+        budget, core/layouts.py PTS)."""
+        return layouts.MAX_KEY_VERSIONS
 
     @property
     def arb_slots(self) -> int:

@@ -73,12 +73,17 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hermes_tpu.config import HermesConfig
-from hermes_tpu.core import compat, kernels
+from hermes_tpu.core import compat, kernels, layouts
 from hermes_tpu.core import state as st
 from hermes_tpu.core import types as t
 
-PTS_FC_BITS = 10  # fc = (flag << 8) | cid fits 10 bits (flag 2b, cid 8b)
-FC_MASK = (1 << PTS_FC_BITS) - 1
+# Packed-word constants all derive from the declared field-layout table
+# (core/layouts.py) — the same table hermes_tpu/analysis proves the traced
+# program against, so the masks here and the theorems there cannot drift.
+PTS_FC_BITS = layouts.PTS_FC_BITS  # fc = (flag << 8) | cid (flag 2b, cid 8b)
+FC_MASK = layouts.FC_MASK
+SST_STEP_SHIFT = layouts.SST.field("step").shift
+SST_STATE_MASK = layouts.SST.field("state").mask
 I32_MIN = jnp.iinfo(jnp.int32).min
 
 # bank row layout (FastTable.bank, int8): bytes of [pts | sst | val words].
@@ -91,13 +96,29 @@ BANK_PTS = 0  # int32-word index of the mirrored packed-ts
 BANK_SST = 1  # int32-word index of sst within a bank row
 BANK_VAL = 2  # first int32-word index of the value
 
-# FastInv.pkf packing: key | fresh-bit | valid-bit (keys fit 29 bits — HBM
-# bounds n_keys far below 2^29; config validates).  One packed word means
-# the compaction needs ONE take_along for (valid, fresh, key) and the
-# sharded all_gather moves one tensor instead of three.
-INV_KEY_MASK = (1 << 29) - 1
-INV_FRESH = jnp.int32(1 << 29)
-INV_VALID = jnp.int32(1 << 30)
+# FastInv.pkf packing: key | fresh-bit | valid-bit (keys fit the declared
+# 29-bit field — HBM bounds n_keys far below that; config validates against
+# layouts.INV_PKF).  One packed word means the compaction needs ONE
+# take_along for (valid, fresh, key) and the sharded all_gather moves one
+# tensor instead of three.
+INV_KEY_MASK = layouts.INV_PKF.field("key").mask
+INV_FRESH = jnp.int32(layouts.INV_PKF.field("fresh").mask)
+INV_VALID = jnp.int32(layouts.INV_PKF.field("valid").mask)
+
+# Fused arbiter+compaction sort key (band | sub) and the per-lane verdict
+# word its permutation scatter routes back (layouts.FUSED_KEY / LANE_WORD).
+FUSED_BAND_SHIFT = layouts.FUSED_KEY.field("band").shift
+LANE_CHAIN_MASK = layouts.LANE_WORD.field("chain_rank").mask
+LANE_ISSUE_SHIFT = layouts.LANE_WORD.field("issue").shift
+LANE_TAKEN_SHIFT = layouts.LANE_WORD.field("taken").shift
+
+# ACK wire header (key | ok | valid) and the INV block scalars
+# (epoch | alive) — layouts.ACK_PKF / BLOCK_META.
+ACK_KEY_SHIFT = layouts.ACK_PKF.field("key").shift
+ACK_OK_MASK = layouts.ACK_PKF.field("ok").mask
+ACK_VALID_MASK = layouts.ACK_PKF.field("valid").mask
+META_EPOCH_SHIFT = layouts.BLOCK_META.field("epoch").shift
+META_ALIVE_MASK = layouts.BLOCK_META.field("alive").mask
 
 
 def pack_pts(ver, fc):
@@ -113,15 +134,29 @@ def pts_fc(pts):
 
 
 def pack_sst(step, state):
-    return (step << 3) | state
+    return (step << SST_STEP_SHIFT) | state
 
 
 def sst_state(sst):
-    return sst & 7
+    return sst & SST_STATE_MASK
 
 
 def sst_step(sst):
-    return sst >> 3
+    return sst >> SST_STEP_SHIFT
+
+
+def _rotated(idx, step, n: int):
+    """Per-round anti-starvation rotation ``(idx + step*stride) % n``,
+    computed mod-first: ``step * 127`` wraps int32 once step exceeds ~1.7e7
+    rounds, and jax's sign-following ``rem`` turns the wrapped product
+    NEGATIVE — which would bleed into the fused sort key's band bits.
+    ``(step % n) * stride`` is the same rotation (congruence mod n) and
+    provably fits: n <= layouts.ROT_CAP keeps the product under 2^31; the
+    (unreachably large) shapes past ROT_CAP fall back to stride 1, still a
+    per-round bijection.  The static-analysis bit-pack pass proves the
+    bound; tests/test_analysis.py keeps the overflow from regressing."""
+    stride = layouts.ROT_STRIDE if n <= layouts.ROT_CAP else 1
+    return (idx + (step % n) * stride) % n
 
 
 # --------------------------------------------------------------------------
@@ -199,22 +234,34 @@ def _bank_to_i32(rows8):
     static-index form that lowers to true slices was A/B-measured ~3%
     SLOWER on-chip at bench shape, so the strided form stays and the op
     census classifies gathers by the sorted-indices attribute,
-    scripts/sharded_census.py.)"""
-    u = rows8.astype(jnp.uint8).astype(jnp.uint32)
+    scripts/sharded_census.py.)
+
+    Promotion discipline (analysis dtype pass): the int8->uint8 and
+    uint32->int32 steps REINTERPRET bits (a negative byte is a high byte
+    value; a word with byte3 >= 0x80 is a negative int32), so they are
+    same-width ``bitcast_convert_type``s — explicit, value-changing by
+    declared intent, and free (no relayout: the byte plane is unchanged).
+    The only arithmetic promotion left is the value-preserving uint8 ->
+    uint32 widen.  An ``astype`` here would be a silent two's-complement
+    wrap the analyzer flags as an implicit convert."""
+    u = jax.lax.bitcast_convert_type(rows8, jnp.uint8).astype(jnp.uint32)
     w = (u[..., 0::4] | (u[..., 1::4] << 8)
          | (u[..., 2::4] << 16) | (u[..., 3::4] << 24))
-    return w.astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(w, jnp.int32)
 
 
 def _i32_to_bank(rows32):
     """int32 words (..., W) -> int8 byte rows (..., 4*W); inverse of
-    _bank_to_i32 (same byte order), fusable elementwise."""
-    u = rows32.astype(jnp.uint32)
+    _bank_to_i32 (same byte order + same promotion discipline: same-width
+    bitcasts for the sign reinterpretations, a masked value-preserving
+    narrow for the byte extraction), fusable elementwise."""
+    u = jax.lax.bitcast_convert_type(rows32, jnp.uint32)
     parts = jnp.stack(
         [((u >> (8 * k)) & 0xFF).astype(jnp.uint8) for k in range(4)],
         axis=-1,
     )
-    return parts.reshape(rows32.shape[:-1] + (4 * rows32.shape[-1],)).astype(jnp.int8)
+    b = parts.reshape(rows32.shape[:-1] + (4 * rows32.shape[-1],))
+    return jax.lax.bitcast_convert_type(b, jnp.int8)
 
 
 class FastSess(NamedTuple):
@@ -275,11 +322,11 @@ class FastInv(NamedTuple):
 
     @property
     def epoch(self):
-        return self.meta >> 1
+        return self.meta >> META_EPOCH_SHIFT
 
     @property
     def alive(self):
-        return (self.meta & 1) != 0
+        return (self.meta & META_ALIVE_MASK) != 0
 
     @property
     def pkf(self):
@@ -461,7 +508,13 @@ def _run_issue(cfg: HermesConfig, first, in_run, sop, pos):
     rank = pos - start
     issue = in_run & (
         first | (~bad & (last_bad < start) & (rank < cfg.chain_writes)))
-    return issue, jnp.where(issue, rank, 0)
+    # clip is a no-op on issuing entries (0 <= rank < chain_writes holds
+    # whenever issue does: the run head's position is the cummax) but
+    # makes the bound a THEOREM for the chain-rank field pack downstream
+    # (analysis bitpack pass: the unclipped pos - start is abstractly
+    # negative outside runs, which would sign-contaminate the win word)
+    return issue, jnp.where(
+        issue, jnp.clip(rank, 0, cfg.chain_writes - 1), 0)
 
 
 def _stream_idx(cfg: HermesConfig, op_idx):
@@ -663,14 +716,17 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         in_run = sk < cfg.n_keys
         issue, rank = _run_issue(cfg, first, in_run, so, idxs)
         if cfg.chain_writes:
-            packed = jnp.where(issue, (jnp.int32(1) << 20) | rank, 0)
+            packed = jnp.where(
+                issue, jnp.int32(layouts.ARB_WORD.field("win").mask) | rank,
+                0)
         else:
             packed = issue.astype(jnp.int32)
         wz = jnp.zeros((R * S,), jnp.int32)
         p_flat = wz.at[_gkey(wz, si)].max(packed, mode="drop").reshape(R, S)
         win = want & (p_flat != 0)
         if cfg.chain_writes:
-            chain_rank = jnp.where(win, p_flat & 0xFFFF, 0)
+            chain_rank = jnp.where(
+                win, p_flat & layouts.ARB_WORD.field("chain_rank").mask, 0)
     else:
         # hash-slot race: scatter-min of the session index into a small
         # table; colliding sessions (same slot) defer to the lowest index;
@@ -735,9 +791,14 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         )
         mark = jnp.concatenate(
             [ckrow8[..., : 4 * BANK_SST], mark_sst, ckval8], axis=-1)
-        new_bank = table.bank.at[
-            jnp.where(take_ok, ck, table.bank.shape[0])
-        ].set(mark, mode="drop")
+        # set-scatter with duplicate indices only among the OOB-masked rows
+        # (mode=drop discards them before the write; live rows are distinct
+        # candidates taken by distinct free slots) — audited for the
+        # analysis scatter pass, which cannot prove take-injectivity.
+        with layouts.audited("replay-mark-dup-oob-dropped"):
+            new_bank = table.bank.at[
+                jnp.where(take_ok, ck, table.bank.shape[0])
+            ].set(mark, mode="drop")
         return table._replace(bank=new_bank), new_replay
 
     table, replay = jax.lax.cond(
@@ -789,21 +850,33 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         # wire path.  Unfilled slots receive non-eligible lanes (never
         # taken, so their wire rows carry valid=0), mirroring the split
         # path's threshold behavior.
+        # Trace-time theorem check (layouts.FUSED_KEY; regression-tested in
+        # tests/test_analysis.py): a max-valued sub (rotated key or rotation
+        # index) must not reach the band shift, or band 1 entries alias
+        # band 2 and the arbiter admits ineligible lanes.  config enforces
+        # both bounds (n_keys validation + use_fused_sort), so this only
+        # fires if a caller bypassed config validation.
+        sub_cap = layouts.FUSED_KEY.field("sub").cap
+        assert cfg.n_keys <= sub_cap and L <= sub_cap, (
+            f"fused sort key overflow: sub holds keys (n_keys={cfg.n_keys})"
+            f" and rotation indices (n_lanes={L}); both must fit the "
+            f"declared {layouts.FUSED_KEY.field('sub').bits}-bit sub field")
         lane_key = jnp.concatenate([sess.key, replay.key], axis=1)
         lane_want = jnp.concatenate(
             [want, jnp.zeros_like(replay.active)], axis=1)
         lane_wait = jnp.concatenate(
             [waiting, replay.active], axis=1) & ~frozen
         band = jnp.where(lane_wait, 0, jnp.where(lane_want, 1, 2))
-        rot = (lane_idx + step * 127) % L
-        rkey = (lane_key + step * 127) % cfg.n_keys
+        rot = _rotated(lane_idx, step, L)
+        rkey = _rotated(lane_key, step, cfg.n_keys)
         sub = jnp.where(band == 0, rot, jnp.where(band == 1, rkey, 0))
         lane_sop = jnp.concatenate(
             [jnp.where(want, sess.op, 0), jnp.zeros_like(replay.key)],
             axis=1)
-        sp, si, so = jax.lax.sort((((band << 29) | sub), lane_idx, lane_sop),
-                                  dimension=1, num_keys=1)
-        sband = sp >> 29
+        sp, si, so = jax.lax.sort(
+            (((band << FUSED_BAND_SHIFT) | sub), lane_idx, lane_sop),
+            dimension=1, num_keys=1)
+        sband = sp >> FUSED_BAND_SHIFT
         first = jnp.concatenate(
             [jnp.ones((R, 1), bool), sp[:, 1:] != sp[:, :-1]], axis=1)
         in_run = sband == 1
@@ -823,8 +896,8 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         # permutation, and each slot's owning lane id at L+srank.  Targets
         # are unique (si is a permutation; srank is a bijection), so
         # max == set.
-        word = ((staken.astype(jnp.int32) << 21)
-                | (issue.astype(jnp.int32) << 20) | rank_word)
+        word = ((staken.astype(jnp.int32) << LANE_TAKEN_SHIFT)
+                | (issue.astype(jnp.int32) << LANE_ISSUE_SHIFT) | rank_word)
         gz = jnp.zeros((R * (L + C),), jnp.int32)
         ridx = jnp.arange(R, dtype=jnp.int32)[:, None] * (L + C)
         tgt = jnp.concatenate(
@@ -834,10 +907,10 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         flat = gz.at[tgt].max(upd, mode="drop").reshape(R, L + C)
         lane_word = flat[:, :L]
         slot_lane = flat[:, L:]
-        taken_lane = (lane_word & (1 << 21)) != 0
-        win = want & ((lane_word[:, :S] & (1 << 20)) != 0)
+        taken_lane = (lane_word & (1 << LANE_TAKEN_SHIFT)) != 0
+        win = want & ((lane_word[:, :S] & (1 << LANE_ISSUE_SHIFT)) != 0)
         if cfg.chain_writes:
-            chain_rank = jnp.where(win, lane_word[:, :S] & 0xFFFF, 0)
+            chain_rank = jnp.where(win, lane_word[:, :S] & LANE_CHAIN_MASK, 0)
         lane_fresh = jnp.concatenate(
             [win, jnp.zeros_like(replay.active)], axis=1)
     else:
@@ -865,14 +938,20 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             # band within O(L) rounds.
             lb = max(1, (L - 1).bit_length())  # lane bits
             rb = max(0, 31 - 2 - lb)  # rotation bits
-            rot = (lane_idx + step * 127) % L
+            rot = _rotated(lane_idx, step, L)
             rotp = rot >> max(0, lb - rb)
             band = jnp.where(lane_elig, jnp.where(lane_fresh, 1, 0), 2)
             packed_own = (((band << min(rb, lb)) | rotp) << lb) | lane_idx
             packed = jax.lax.sort(packed_own, dimension=1)
             slot_lane = packed[:, :C] & ((1 << lb) - 1)  # (R, C) slot lanes
             taken_lane = lane_elig & (packed_own <= packed[:, C - 1 : C])
-    new_pts = pack_pts(pts_ver(k_vpts) + 1 + chain_rank, fc)
+    # The minted ts packs a ver read from the winner-row mirror, whose
+    # bound is a PROTOCOL invariant (ver <= max_key_versions, enforced by
+    # the Meta.max_pts runtime watermark + auto-rebase), not a config
+    # fact — audited so the analysis bit-pack pass reports the assumption
+    # instead of an unprovable overflow.
+    with layouts.audited("pts-mint-ver-bounded-by-watermark"):
+        new_pts = pack_pts(pts_ver(k_vpts) + 1 + chain_rank, fc)
 
     # fresh issues that won arbitration AND hold a slot actually happen;
     # the rest revert (stay S_ISSUE) and retry next round
@@ -935,7 +1014,8 @@ def _compact_out_inv(ctl: FastCtl, lanes: "LaneBlock", slot_lane, taken_lane):
     rows8 = jnp.concatenate([head8, lanes.val], axis=-1)  # (R, L, 8+4V)
     return FastInv(
         rows8=jnp.take_along_axis(rows8, slot_lane[..., None], axis=1),
-        meta=(ctl.epoch << 1) | (~ctl.frozen).astype(jnp.int32),
+        meta=((ctl.epoch << META_EPOCH_SHIFT)
+              | (~ctl.frozen).astype(jnp.int32)),
     )
 
 
@@ -971,9 +1051,19 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv,
     # the same index vector — vpts is written only by the scatter-max
     # above, so the value is final for the round).  Gathers are priced by
     # COUNT, not extent, on this runtime.
+    #
+    # The inbound key is an untrusted 29-bit WIRE field (layouts.INV_PKF)
+    # while the local table has only K rows: a corrupt peer's slot would
+    # index out of bounds in this promised-in-bounds gather (undefined),
+    # so clamp — a correct peer never sends key >= K, the min fuses into
+    # the index computation (no new sparse op), and a clamped bogus slot
+    # yields a garbage-but-defined verdict its v_ok mask already ignores.
+    # (The scatter path needs no clamp: mode="drop".)  Surfaced by the
+    # analysis scatter pass (oob-promised-index).
     nslot = key0.size
-    joint = fs.table.vpts[jnp.concatenate(
-        [key0.reshape(-1), replay_key.reshape(-1)])]
+    kcap = fs.table.vpts.shape[0] - 1
+    joint = fs.table.vpts[jnp.minimum(jnp.concatenate(
+        [key0.reshape(-1), replay_key.reshape(-1)]), kcap)]
     post0 = joint[:nslot].reshape(key0.shape)
     replay_post = joint[nslot:].reshape(replay_key.shape)
     win0 = v_ok & (pts0 == post0)
@@ -1023,7 +1113,14 @@ def _winner_row_scatter(ctl: FastCtl, table: FastTable, keys, pts, vals,
     upd8 = jnp.concatenate([head8, vals], axis=-1)
     write0 = win & (fresh | vbit)
     rows = jnp.where(write0, keys, table.bank.shape[0])
-    return table._replace(bank=table.bank.at[rows].set(upd8, mode="drop"))
+    # set-scatter whose duplicate (key, ts) rows are masked to DETERMINISTIC
+    # writers (fresh rows unique per (key, ts); committing re-broadcast
+    # duplicates all write the identical VALID row — the _apply_commit
+    # soundness argument).  Audited: injectivity is a protocol invariant,
+    # not provable from config bounds by the analysis scatter pass.
+    with layouts.audited("winner-row-dup-writes-identical"):
+        bank = table.bank.at[rows].set(upd8, mode="drop")
+    return table._replace(bank=bank)
 
 
 def _apply_inv_lanes(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
@@ -1129,7 +1226,8 @@ def _wire_acks(cfg: HermesConfig, ctl: FastCtl, inv_src: FastInv, ack_flags,
         inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
         & ~ctl.frozen[0]
     )
-    pkf = ((inv_src.key << 2) | (ack_flags.astype(jnp.int32) << 1)
+    pkf = ((inv_src.key << ACK_KEY_SHIFT)
+           | (ack_flags.astype(jnp.int32) << 1)
            | ok.astype(jnp.int32))
     ack8 = _i32_to_bank(jnp.stack([pkf, inv_src.pts], axis=-1))
     out_ack = FastAck(rows8=ack8[None])
@@ -1139,12 +1237,13 @@ def _wire_acks(cfg: HermesConfig, ctl: FastCtl, inv_src: FastInv, ack_flags,
     # are fixed per round), so the ack block needs no epoch collective
     epoch_ok = (inv_src.epoch[None, :] == ctl.epoch[:, None])[..., None]
     matched = (
-        out_inv.valid[:, None, :] & ((in_ack.pkf & 1) == 1) & epoch_ok
+        out_inv.valid[:, None, :]
+        & ((in_ack.pkf & ACK_VALID_MASK) == ACK_VALID_MASK) & epoch_ok
         & ~ctl.frozen[:, None, None]
-        & ((in_ack.pkf >> 2) == out_inv.key[:, None, :])
+        & ((in_ack.pkf >> ACK_KEY_SHIFT) == out_inv.key[:, None, :])
         & (in_ack.pts == out_inv.pts[:, None, :])
     )
-    aok = (in_ack.pkf & 2) == 2
+    aok = (in_ack.pkf & ACK_OK_MASK) == ACK_OK_MASK
     bit = jnp.int32(1) << jnp.arange(Rs, dtype=jnp.int32)[None, :, None]
     gained_slot = jnp.sum(jnp.where(matched, bit, 0), axis=1).astype(jnp.int32)
     nacked_slot = jnp.any(matched & ~aok, axis=1)
@@ -1158,13 +1257,15 @@ def _slot_to_lane_acks(cfg: HermesConfig, gained_slot, nacked_slot, slot_lane):
     slot_lane is injective per replica, so set/max are equivalent)."""
     R, C = gained_slot.shape
     L = cfg.n_lanes
+    gshift = layouts.SLOT_ACK.field("gained").shift
+    nmask = layouts.SLOT_ACK.field("nacked").mask
     packed_slot = (
-        (gained_slot.astype(jnp.uint32) << 1)
+        (gained_slot.astype(jnp.uint32) << gshift)
         | nacked_slot.astype(jnp.uint32)
     )
     lz = jnp.zeros((R * L,), jnp.uint32)
     lanes = lz.at[_gkey(lz, slot_lane)].max(packed_slot, mode="drop").reshape(R, L)
-    return (lanes >> 1).astype(jnp.int32), (lanes & 1) != 0
+    return (lanes >> gshift).astype(jnp.int32), (lanes & nmask) != 0
 
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,

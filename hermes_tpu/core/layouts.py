@@ -1,0 +1,239 @@
+"""Declared bit layouts of every hand-packed word in the fast engines.
+
+The TPU engines re-encode the Hermes protocol's model-checked invariants
+(Katsarakis et al., ASPLOS 2020) as packed int32 bitfields — the Lamport
+timestamp ``(ver << 10) | fc``, the INV header ``(valid << 30) |
+(fresh << 29) | key``, the fused arbiter+compaction sort key
+``(band << 29) | sub`` — and a field that silently aliases a neighbor's
+bits corrupts arbitration without any runtime error.  Before this module
+the layouts existed only as scattered magic literals (``1 << 29`` in
+config validation, ``& 0xFFFF`` masks in faststep) that could drift apart
+silently.
+
+This table is the single source of truth, consumed by THREE clients so the
+declarations cannot drift from the code:
+
+  * ``core/faststep.py`` derives its runtime shift/mask constants from the
+    fields declared here;
+  * ``hermes_tpu/config.py`` derives its validation bounds (``n_keys`` must
+    fit the INV key field, ``chain_writes`` the chain-rank field, ...);
+  * ``hermes_tpu/analysis`` (the static jaxpr analyzer) proves, at trace
+    time, that every shift/or pack in the lowered round respects these
+    layouts under the config's seeded bounds — the CI gate
+    ``scripts/check_analysis.py`` polices it.
+
+Every layout targets a 32-bit word.  ``word_bits=31`` means the sign bit
+must stay clear (the word is compared or max-scattered as a SIGNED int32 —
+e.g. the packed timestamp, whose integer compare must equal the
+lexicographic (ver, fc) compare); ``word_bits=32`` marks unsigned words
+that may use all 32 bits.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import NamedTuple, Tuple
+
+
+class Field(NamedTuple):
+    """One bitfield: ``bits`` wide starting at ``shift``."""
+
+    name: str
+    shift: int
+    bits: int
+
+    @property
+    def mask(self) -> int:
+        """Word mask selecting this field's bits."""
+        return ((1 << self.bits) - 1) << self.shift
+
+    @property
+    def cap(self) -> int:
+        """Exclusive upper bound on the field's (unshifted) value."""
+        return 1 << self.bits
+
+
+class Layout(NamedTuple):
+    """A packed word: named disjoint fields in a 31/32-bit budget."""
+
+    name: str
+    doc: str
+    fields: Tuple[Field, ...]
+    word_bits: int = 31  # 31 = signed int32, sign bit must stay clear
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(f"layout {self.name!r} has no field {name!r}")
+
+    def validate(self) -> None:
+        used = 0
+        for f in self.fields:
+            if f.shift < 0 or f.bits <= 0:
+                raise ValueError(f"{self.name}.{f.name}: bad shift/bits")
+            if f.shift + f.bits > self.word_bits:
+                raise ValueError(
+                    f"{self.name}.{f.name}: bits [{f.shift}, "
+                    f"{f.shift + f.bits}) exceed the {self.word_bits}-bit "
+                    f"word budget")
+            if used & f.mask:
+                raise ValueError(f"{self.name}.{f.name}: overlaps a "
+                                 f"previously declared field")
+            used |= f.mask
+
+
+# --------------------------------------------------------------------------
+# The packed words (see ARCHITECTURE.md "Static invariants" for the prose
+# table: word, field, bound, and which analyzer pass proves it).
+# --------------------------------------------------------------------------
+
+#: Packed Lamport timestamp (core/timestamps.py, faststep.pack_pts):
+#: integer compare == lexicographic (ver, flag, cid) compare, which is what
+#: turns per-key conflict resolution into one scatter-max.  The ver field
+#: spans 21 bits but the enforced version budget is 2^20
+#: (config.max_key_versions): one spare bit of headroom so chain minting
+#: (ver + 1 + chain_rank) and overlapping per-replica ranges can never
+#: carry into the sign bit between watermark polls.
+PTS = Layout("pts", "packed Lamport timestamp (ver | flag | cid)", (
+    Field("cid", 0, 8),     # replica id (tie-break; n_replicas <= 31)
+    Field("flag", 8, 2),    # write-kind flag (types.FLAG_WRITE beats RMW)
+    Field("ver", 10, 21),   # key version; budget 2^20 (one headroom bit)
+))
+
+#: Packed per-key state+age word (faststep.pack_sst): the state machine
+#: word and the replay-age step stamp travel in one scatter.  The step
+#: field bounds how long a run may go before the age compare would wrap:
+#: 2^28 rounds (~50 days at 60 rounds/s) — the analyzer seeds ctl.step
+#: from this declared budget.
+SST = Layout("sst", "packed key state + last-change step", (
+    Field("state", 0, 3),   # types.VALID..REPLAY (5 states)
+    Field("step", 3, 28),   # last-change step (replay age origin)
+))
+
+#: INV wire-header word (FastInv.pkf): key + fresh/valid bits in one word
+#: so compaction is one take_along and the sharded wire one all_gather.
+INV_PKF = Layout("inv_pkf", "INV header (valid | fresh | key)", (
+    Field("key", 0, 29),    # bounds n_keys (config validation)
+    Field("fresh", 29, 1),  # first broadcast of this ts (unique (key, ts))
+    Field("valid", 30, 1),  # slot holds a live INV
+))
+
+#: ACK wire-header word (FastAck.pkf, faststep._wire_acks): the echoed key
+#: plus the conflict verdict and validity bits.
+ACK_PKF = Layout("ack_pkf", "ACK header (key | ok | valid)", (
+    Field("valid", 0, 1),   # acker saw a live INV in this slot
+    Field("ok", 1, 1),      # conflict flag (False = the RMW nack)
+    Field("key", 2, 29),    # echoed key (same capacity as inv_pkf.key)
+))
+
+#: Round-6 fused arbiter+compaction sort key (faststep._coordinate): band
+#: 0 = waiting/replay (sub = rotation index over lanes), band 1 = fresh
+#: issue runs (sub = per-round ROTATED key, keeping equal-key runs
+#: contiguous), band 2 = ineligible.  sub must hold both n_keys and
+#: n_lanes; the rotation arithmetic additionally bounds both by ROT_CAP
+#: (see below), which config.use_fused_sort enforces.
+FUSED_KEY = Layout("fused_key", "fused lane-sort key (band | sub)", (
+    Field("sub", 0, 29),    # rotated key (band 1) / rotation index (band 0)
+    Field("band", 29, 2),   # 0 waiting/replay, 1 fresh runs, 2 ineligible
+))
+
+#: Per-lane verdict word routed back through the fused sort's one
+#: permutation scatter: chain rank + issue/taken bits (bits 16-19 spare).
+LANE_WORD = Layout("lane_word", "fused-path per-lane verdict", (
+    Field("chain_rank", 0, 16),  # rank within an equal-key run (chaining)
+    Field("issue", 20, 1),       # won arbitration this round
+    Field("taken", 21, 1),       # holds a compaction slot this round
+))
+
+#: Split sort-arbiter win word (the fused path's A/B baseline): same
+#: chain-rank field, win bit at the same position as lane_word.issue so
+#: the two programs stay visually diffable.
+ARB_WORD = Layout("arb_word", "split sort-arbiter win verdict", (
+    Field("chain_rank", 0, 16),
+    Field("win", 20, 1),
+))
+
+#: Sharded slot->lane ack routing word (faststep._slot_to_lane_acks):
+#: uint32, so the gained bitmap can use 31 bits above the nack bit.
+SLOT_ACK = Layout("slot_ack", "sharded per-slot ack word (uint32)", (
+    Field("nacked", 0, 1),
+    Field("gained", 1, 31),  # replica bitmap of acks gained this round
+), word_bits=32)
+
+#: Per-block wire scalars (FastInv.meta): a replica's whole batch shares
+#: one epoch, so epoch+alive ride one collective operand.
+BLOCK_META = Layout("block_meta", "INV block scalars (epoch | alive)", (
+    Field("alive", 0, 1),
+    Field("epoch", 1, 30),
+))
+
+#: Split-path single-operand compaction key (faststep._coordinate, C < L):
+#: (band | rotation | lane) with lane/rotation widths chosen per shape at
+#: trace time — declared here as a NOTE, not a fixed layout: the analyzer
+#: proves it per-config from the traced constants.
+
+ALL = (PTS, SST, INV_PKF, ACK_PKF, FUSED_KEY, LANE_WORD, ARB_WORD,
+       SLOT_ACK, BLOCK_META)
+for _l in ALL:
+    _l.validate()
+
+# cross-layout consistency: the ACK echoes the INV's key verbatim
+assert ACK_PKF.field("key").bits == INV_PKF.field("key").bits
+
+# --------------------------------------------------------------------------
+# Derived budgets (the constants the runtime + config consume)
+# --------------------------------------------------------------------------
+
+#: fc = (flag << 8) | cid — the low-word of the packed ts.
+PTS_FC_BITS = PTS.field("ver").shift
+FC_MASK = PTS.field("flag").mask | PTS.field("cid").mask
+assert FC_MASK == (1 << PTS_FC_BITS) - 1
+
+#: Enforced version budget: one headroom bit under the declared ver field
+#: (see PTS doc) — config.max_key_versions and the runtime watermark guard.
+MAX_KEY_VERSIONS = 1 << (PTS.field("ver").bits - 1)
+
+SST_STATE_BITS = SST.field("state").shift + 0  # == 3
+MAX_STEPS = SST.field("step").cap  # analyzer seed bound for ctl.step
+
+#: Anti-starvation rotation stride (fused + split compaction paths): the
+#: priority rotation advances by ROT_STRIDE lanes/keys per round.  The
+#: rotation product ``(step % n) * ROT_STRIDE + n`` must fit int32, which
+#: bounds the rotated domain at ROT_CAP entries (config.use_fused_sort
+#: enforces it; far above any reachable shape — 2^24 lanes/keys).
+ROT_STRIDE = 127
+ROT_CAP = (1 << 31) // (ROT_STRIDE + 1)
+
+
+# --------------------------------------------------------------------------
+# Audit annotations (consumed by hermes_tpu/analysis)
+# --------------------------------------------------------------------------
+
+AUDIT_PREFIX = "hermes_audit"
+
+
+def audited(tag: str):
+    """Trace-time audit annotation: marks the ops built inside the scope as
+    REVIEWED exceptions to a static-analysis rule, with ``tag`` naming the
+    invariant that justifies them (e.g. a set-scatter whose duplicate
+    indices provably write identical rows).  Implemented as a
+    ``jax.named_scope`` so the marker rides the jaxpr's name stack into
+    the analyzer — no runtime cost, no lowering change.  The analyzer
+    downgrades findings inside an audited scope to ``info`` and carries
+    the tag into the finding record, so every suppression is visible in
+    the findings stream instead of silently absent."""
+    import jax
+
+    if not tag or any(c in tag for c in "[]"):
+        raise ValueError("audit tag must be a non-empty string without "
+                         "square brackets")
+    return jax.named_scope(f"{AUDIT_PREFIX}[{tag}]")
+
+
+@contextlib.contextmanager
+def unaudited():
+    """Test hook: a no-op scope with the same surface as audited() —
+    monkeypatching ``audited`` to this must make the analyzer flag the
+    previously audited sites (the CI mutation test for the scatter pass)."""
+    yield

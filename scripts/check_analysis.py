@@ -1,0 +1,150 @@
+"""Static-analysis findings gate (CI): the jaxpr invariant analyzer
+(hermes_tpu/analysis) must report no NEW error/warn findings on the fast
+engines, at the default and bench configs, batched + sharded, fused +
+split sort.
+
+Why a gate: the engines' packed int32 words (timestamps, INV headers, the
+fused sort key) are protocol invariants that a refactor can silently
+alias — one widened field or one un-audited set-scatter corrupts
+arbitration with no runtime error until the linearizability checker
+trips over a mangled history.  The analyzer proves the packing at trace
+time; this script polices it the same measure-then-gate way as
+scripts/check_op_census.py and scripts/check_obs_overhead.py.
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_analysis.py [--update] [--out FINDINGS_JSONL]
+
+ANALYSIS_BASELINE.json grandfathers known findings (keyed stably without
+line numbers); ``--update`` rewrites it after an INTENTIONAL change so
+the diff shows up in review.  Exit non-zero on any finding not in the
+baseline.  Info-severity findings (audited assumptions) never gate but
+are counted, so a silently growing assumption surface is visible in the
+JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+
+def gate_configs() -> dict:
+    """The analyzed matrix: named configs -> HermesConfig.  Default (race
+    arbiter) + the bench operating shape (sort+chain+fused — the split
+    program is added automatically as the A/B variant)."""
+    from hermes_tpu.config import HermesConfig
+
+    import bench
+
+    return {
+        "default": HermesConfig(),
+        "bench": bench._cfg("a"),
+        "bench-rmw": bench._cfg("rmw"),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--baseline", default="ANALYSIS_BASELINE.json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline's grandfathered findings "
+                    "instead of failing on drift")
+    ap.add_argument("--out", default=None, metavar="FINDINGS_JSONL",
+                    help="also export every finding as obs-schema JSONL")
+    ap.add_argument("--configs", default=None,
+                    help="comma-separated subset of the gate configs")
+    args = ap.parse_args()
+
+    from hermes_tpu import analysis as ana
+
+    names = gate_configs()
+    if args.configs:
+        want = args.configs.split(",")
+        unknown = [w for w in want if w not in names]
+        if unknown:
+            # a typo must not turn into a vacuous green gate
+            print(f"unknown gate config(s) {unknown}; have {sorted(names)}",
+                  file=sys.stderr)
+            return 2
+        names = {k: names[k] for k in want}
+
+    measured: dict = {}
+    all_reports = []
+    n_err = n_warn = n_info = 0
+    for cname, cfg in names.items():
+        print(f"analyzing {cname} (S={cfg.n_sessions}, K={cfg.n_keys}, "
+              f"arb={cfg.arb_mode}, fused={cfg.use_fused_sort})...",
+              file=sys.stderr)
+        reports = ana.analyze_config(cfg)
+        for r in reports:
+            for f in r["findings"]:
+                f.engine = f"{cname}:{f.engine}"
+                if f.severity == ana.ERROR:
+                    n_err += f.count
+                elif f.severity == ana.WARN:
+                    n_warn += f.count
+                else:
+                    n_info += f.count
+        for k, v in ana.key_counts(ana.findings_of(reports)).items():
+            measured[k] = measured.get(k, 0) + v
+        all_reports.extend(reports)
+
+    baseline = ana.load_baseline(args.baseline)
+    new, stale = ana.diff_baseline(measured, baseline)
+
+    if (new or stale) and args.update:
+        by_key_note = {}
+        for r in all_reports:
+            for f in r["findings"]:
+                if f.severity in ana.GATING:
+                    by_key_note.setdefault(f.key, f.message)
+        doc = {
+            "_doc": "Grandfathered static-analysis findings "
+                    "(scripts/check_analysis.py).  Keys are line-number-"
+                    "free so refactors don't churn them; rewrite with "
+                    "--update after an INTENTIONAL change and commit the "
+                    "diff.  An empty table means the engines analyze "
+                    "clean — keep it that way.",
+            "grandfathered": {
+                k: {"count": c, "note": by_key_note.get(k, "")}
+                for k, c in sorted(measured.items())
+            },
+        }
+        with open(args.baseline, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"updated {args.baseline} ({len(measured)} grandfathered)",
+              file=sys.stderr)
+        new, stale = {}, {}
+
+    if args.out:
+        ana.export_findings(args.out, all_reports)
+
+    ok = not new
+    print(json.dumps(dict(
+        ok=ok, configs=sorted(names), errors=n_err, warnings=n_warn,
+        infos=n_info, gating_sites=len(measured),
+        new_findings=sorted(new), stale_baseline=sorted(stale))))
+    if new:
+        print("NEW findings (fix, audit with layouts.audited, or "
+              "consciously --update the baseline):", file=sys.stderr)
+        for k in sorted(new):
+            print(f"  {k} (+{new[k]})", file=sys.stderr)
+    if stale:
+        print("stale baseline entries (code no longer produces them; "
+              "--update prunes):", file=sys.stderr)
+        for k in sorted(stale):
+            print(f"  {k}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

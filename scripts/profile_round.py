@@ -10,9 +10,12 @@ historical entry point and argument shape:
 is exactly ``python -m hermes_tpu.obs.profile [S] [C]``.
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+# resolve the package from the repo root this script lives in (no
+# cwd-dependent sys.path hack: the wrapper works from any directory)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hermes_tpu.obs.profile import main  # noqa: E402
 

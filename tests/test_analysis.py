@@ -1,0 +1,551 @@
+"""Tests for the static jaxpr invariant analyzer (hermes_tpu/analysis).
+
+Covers the ISSUE-3 acceptance points: interval-domain unit tests, a
+deliberately overflowing packed key is caught, an injective permutation
+scatter is NOT flagged (false-positive guard), the gate's
+pass/fail/--update paths, and the seeded mutations (widen n_keys past
+the band shift; drop the scatter audits) flip the analysis red.  Plus
+the satellite regressions: the byte<->word codec round-trip and the
+rotation-overflow fix in faststep.
+"""
+
+import contextlib
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hermes_tpu import analysis as ana
+from hermes_tpu.analysis import domain as D
+from hermes_tpu.analysis import interp as I
+from hermes_tpu.analysis.domain import iv
+from hermes_tpu.analysis.passes import (
+    BitPackPass, DtypePromotionPass, ScatterHazardPass,
+    ShardingConsistencyPass, default_passes)
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import faststep as fst
+from hermes_tpu.core import layouts
+from hermes_tpu.core import types as t
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# domain unit tests
+# --------------------------------------------------------------------------
+
+
+class TestDomain:
+    def test_const_mask_is_exact(self):
+        # 1 << 20 has exactly one possible bit — what proves WIN | rank
+        assert iv(1 << 20).ones == 1 << 20
+        assert iv(0).ones == 0
+        assert iv(5).ones == 5
+
+    def test_interval_mask(self):
+        assert iv(0, 127).ones == 0x7F
+        assert iv(0, 128).ones == 0xFF
+        assert iv(-1, 5).ones == -1  # negative-capable: unconstrained
+
+    def test_add_disjoint_is_or(self):
+        # replica * K + key with disjoint bits keeps the exact mask
+        a = iv(0, 3 << 16, ones=3 << 16)
+        b = iv(0, 0xFFFF)
+        r = D.add(a, b)
+        assert r.ones == (3 << 16) | 0xFFFF
+        assert (r.lo, r.hi) == (0, (3 << 16) + 0xFFFF)
+
+    def test_shl_keeps_low_bits_clear(self):
+        r = D.shl(iv(0, 2047), iv(10))
+        assert r.ones & 0x3FF == 0
+
+    def test_or_and_masks(self):
+        assert D.or_(iv(0, 7), iv(8, 8)).ones == 0xF
+        assert D.and_(D.top(np.int32), iv(0xFF, 0xFF)).hi == 0xFF
+
+    def test_rem_positive_divisor(self):
+        r = D.rem(iv(0, 10**6), iv(64))
+        assert (r.lo, r.hi) == (0, 63)
+        r = D.rem(iv(-5, 10), iv(64))  # sign follows dividend
+        assert r.lo < 0 <= r.hi
+
+    def test_clamp_wrap_flag(self):
+        _, wrapped = D.clamp(iv(0, 1 << 40), np.int32)
+        assert wrapped
+        av, wrapped = D.clamp(iv(0, 100), np.int32)
+        assert not wrapped and av.hi == 100
+
+    def test_join(self):
+        j = D.join(iv(0, 10), iv(100, 200))
+        assert (j.lo, j.hi) == (0, 200)
+
+    def test_sum_n(self):
+        assert D.sum_n(iv(0, 10), 5).hi == 50
+        assert D.sum_n(iv(0, 10), 0).hi == 0
+
+    def test_and_or_sound_for_negatives(self):
+        # -5 & -3 == -7 (below both); 10 | 5 == 15 (above both)
+        r = D.clamp(D.and_(iv(-5, -3), iv(-5, -3)), np.int32)[0]
+        assert r.lo <= -7
+        r = D.clamp(D.or_(iv(-1, 10), iv(0, 5)), np.int32)[0]
+        assert r.hi >= 15
+        # the mask restore stays precise: TOP & const mask
+        assert D.and_(D.top(np.int32), iv(0xFF)).hi == 0xFF
+
+    def test_bool_clamp_widens_never_narrows(self):
+        # `not` on a bool must not collapse to a false constant
+        av, _ = D.clamp(D.not_(iv(0, 1)), np.bool_)
+        assert (av.lo, av.hi) == (0, 1)
+
+
+# --------------------------------------------------------------------------
+# interpreter: bounds propagate through real traced programs
+# --------------------------------------------------------------------------
+
+
+def _run(fn, in_avs, shapes, passes=None, mesh_axes=None, donated=None):
+    jx = jax.make_jaxpr(fn)(*shapes)
+    ctx = I.Ctx(passes=passes or [], mesh_axes=mesh_axes, donated=donated)
+    outs = I.eval_jaxpr(jx.jaxpr, in_avs, ctx, consts=list(jx.consts))
+    return outs, ctx, jx
+
+
+class TestInterp:
+    def test_basic_bounds(self):
+        s = jax.ShapeDtypeStruct((8,), jnp.int32)
+
+        def f(x, y):
+            return (x + y) * 2
+
+        outs, _, _ = _run(f, [iv(0, 10), iv(0, 5)], (s, s))
+        assert (outs[0].lo, outs[0].hi) == (0, 30)
+
+    def test_remainder_contract(self):
+        s = jax.ShapeDtypeStruct((8,), jnp.int32)
+        outs, _, _ = _run(lambda x: x % 64, [D.top(np.int32)], (s,))
+        assert (outs[0].lo, outs[0].hi) == (0, 63)
+
+    def test_negative_index_normalization_refined(self):
+        tbl = jax.ShapeDtypeStruct((4096,), jnp.int32)
+        idx = jax.ShapeDtypeStruct((16,), jnp.int32)
+        p = ScatterHazardPass()
+        _run(lambda t_, i: t_[i], [D.top(np.int32), iv(0, 4095)],
+             (tbl, idx), passes=[p])
+        assert not [f for f in p.results() if f.severity != "info"]
+
+    def test_rotation_provably_bounded(self):
+        # the faststep._rotated mod-first formula stays in [0, n)
+        s = jax.ShapeDtypeStruct((64,), jnp.int32)
+        st_ = jax.ShapeDtypeStruct((), jnp.int32)
+        outs, _, _ = _run(lambda i, stp: fst._rotated(i, stp, 64),
+                          [iv(0, 63), iv(0, layouts.MAX_STEPS - 1)],
+                          (s, st_))
+        assert (outs[0].lo, outs[0].hi) == (0, 63)
+
+
+# --------------------------------------------------------------------------
+# bit-pack pass
+# --------------------------------------------------------------------------
+
+
+class TestBitPack:
+    def test_overflowing_pack_is_caught(self):
+        # a 29-bit shift with a sub field that can reach the band bits
+        s = jax.ShapeDtypeStruct((16,), jnp.int32)
+
+        def f(band, sub):
+            return (band << 29) | sub
+
+        p = BitPackPass()
+        _run(f, [iv(0, 2), iv(0, 1 << 29)], (s, s), passes=[p])
+        errs = [f_ for f_ in p.results() if f_.severity == "error"]
+        assert any(f_.code == "pack-overlap" for f_ in errs)
+
+    def test_disjoint_pack_proved(self):
+        s = jax.ShapeDtypeStruct((16,), jnp.int32)
+
+        def f(band, sub):
+            return (band << 29) | sub
+
+        p = BitPackPass()
+        _run(f, [iv(0, 2), iv(0, (1 << 29) - 1)], (s, s), passes=[p])
+        assert not p.results()
+        assert p.n_proved >= 2  # the shift and the or
+
+    def test_negative_operand_caught(self):
+        s = jax.ShapeDtypeStruct((16,), jnp.int32)
+        p = BitPackPass()
+        _run(lambda x: (jnp.int32(1) << 20) | x, [iv(-5, 10)], (s,),
+             passes=[p])
+        assert any(f_.code == "pack-negative-operand"
+                   for f_ in p.results() if f_.severity == "error")
+
+    def test_bitmap_union_not_flagged(self):
+        # overlapping ack-bitmap union: NOT a pack site, never flagged
+        s = jax.ShapeDtypeStruct((16,), jnp.int32)
+        p = BitPackPass()
+        _run(lambda a, b: a | b, [iv(0, 7), iv(0, 7)], (s, s), passes=[p])
+        assert not p.results()
+
+    def test_not_mask_pack_overlap_caught(self):
+        # soundness regression: `~frozen` used to abstract to constant
+        # False, silently proving a deliberately overlapping epoch|alive
+        # pack clean
+        se = jax.ShapeDtypeStruct((8,), jnp.int32)
+        sb = jax.ShapeDtypeStruct((8,), jnp.bool_)
+
+        def f(epoch, frozen):
+            return (epoch << 0) | (~frozen).astype(jnp.int32)
+
+        p = BitPackPass()
+        _run(f, [iv(0, 3), iv(0, 1)], (se, sb), passes=[p])
+        assert any(f_.code == "pack-overlap" and f_.severity == "error"
+                   for f_ in p.results())
+
+    def test_audited_pack_downgrades_to_info(self):
+        s = jax.ShapeDtypeStruct((16,), jnp.int32)
+
+        def f(x):
+            with layouts.audited("test-known-bound"):
+                return (x << 29) | jnp.int32(7)
+
+        p = BitPackPass()
+        _run(f, [D.top(np.int32)], (s,), passes=[p])
+        res = p.results()
+        assert res and all(f_.severity == "info" for f_ in res)
+        assert all(f_.audit == "test-known-bound" for f_ in res)
+
+
+# --------------------------------------------------------------------------
+# dtype pass
+# --------------------------------------------------------------------------
+
+
+class TestDtype:
+    def test_wrapping_convert_flagged(self):
+        s = jax.ShapeDtypeStruct((8,), jnp.int8)
+        p = DtypePromotionPass()
+        # int8 -> uint32 astype sign-extends/wraps negatives silently
+        _run(lambda x: x.astype(jnp.uint32), [D.top(np.int8)], (s,),
+             passes=[p])
+        assert any(f_.code == "implicit-wrap-convert" for f_ in p.results())
+
+    def test_bitcast_is_explicit(self):
+        s = jax.ShapeDtypeStruct((8,), jnp.int8)
+        p = DtypePromotionPass()
+        _run(lambda x: jax.lax.bitcast_convert_type(x, jnp.uint8),
+             [D.top(np.int8)], (s,), passes=[p])
+        assert not p.results()
+
+    def test_value_preserving_convert_proved(self):
+        s = jax.ShapeDtypeStruct((8,), jnp.uint8)
+        p = DtypePromotionPass()
+        _run(lambda x: x.astype(jnp.uint32), [iv(0, 255)], (s,), passes=[p])
+        assert not p.results() and p.n_proved >= 1
+
+    def test_float_in_integer_round_warns(self):
+        s = jax.ShapeDtypeStruct((8,), jnp.int32)
+        p = DtypePromotionPass(allow_float=False)
+        _run(lambda x: (x.astype(jnp.float32) * 0.5).astype(jnp.int32),
+             [iv(0, 10)], (s,), passes=[p])
+        assert any(f_.code in ("float-in-round", "float-to-int")
+                   for f_ in p.results())
+
+
+# --------------------------------------------------------------------------
+# scatter pass
+# --------------------------------------------------------------------------
+
+
+class TestScatter:
+    def test_injective_permutation_scatter_not_flagged(self):
+        # false-positive guard: a permutation scatter annotated
+        # unique_indices=True must not gate
+        s = jax.ShapeDtypeStruct((64,), jnp.int32)
+        p = ScatterHazardPass()
+
+        def f(perm, vals):
+            return jnp.zeros((64,), jnp.int32).at[perm].set(
+                vals, unique_indices=True, mode="drop")
+
+        _run(f, [iv(0, 63), iv(0, 100)], (s, s), passes=[p])
+        assert not [f_ for f_ in p.results() if f_.severity != "info"]
+
+    def test_max_scatter_not_flagged(self):
+        s = jax.ShapeDtypeStruct((64,), jnp.int32)
+        p = ScatterHazardPass()
+        _run(lambda i, v: jnp.zeros((64,), jnp.int32).at[i].max(
+            v, mode="drop"), [iv(0, 63), iv(0, 100)], (s, s), passes=[p])
+        assert not p.results()
+
+    def test_unannotated_set_scatter_warns(self):
+        s = jax.ShapeDtypeStruct((64,), jnp.int32)
+        p = ScatterHazardPass()
+        _run(lambda i, v: jnp.zeros((64,), jnp.int32).at[i].set(
+            v, mode="drop"), [iv(0, 63), iv(0, 100)], (s, s), passes=[p])
+        assert any(f_.code == "scatter-set-not-injective"
+                   and f_.severity == "warn" for f_ in p.results())
+
+    def test_promised_oob_index_error(self):
+        tbl = jax.ShapeDtypeStruct((128,), jnp.int32)
+        idx = jax.ShapeDtypeStruct((8,), jnp.int32)
+        p = ScatterHazardPass()
+        _run(lambda t_, i: t_.at[i].get(mode="promise_in_bounds"),
+             [D.top(np.int32), iv(0, 1 << 20)], (tbl, idx), passes=[p])
+        assert any(f_.code == "oob-promised-index" and
+                   f_.severity == "error" for f_ in p.results())
+
+    def test_donation_wasted_warns(self):
+        s = jax.ShapeDtypeStruct((64,), jnp.int32)
+        p = ScatterHazardPass()
+        # donated arg 0 has no same-shaped output to alias
+        _, ctx, jx = _run(lambda x: jnp.sum(x), [iv(0, 10)], (s,),
+                          passes=[p], donated={0})
+        p.check_donation(ctx, jx.jaxpr)
+        assert any(f_.code == "donation-wasted" for f_ in p.results())
+
+
+# --------------------------------------------------------------------------
+# sharding pass
+# --------------------------------------------------------------------------
+
+
+def _tiny_sharded_fn():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from hermes_tpu.core import compat
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+
+    def body(x):
+        return jax.lax.all_gather(x[0], "replica", axis=0, tiled=False)
+
+    return compat.shard_map(body, mesh=mesh, in_specs=(P("replica"),),
+                            out_specs=P("replica"))
+
+
+class TestSharding:
+    def test_declared_axes_clean(self):
+        s = jax.ShapeDtypeStruct((8, 4), jnp.int32)
+        p = ShardingConsistencyPass()
+        _run(_tiny_sharded_fn(), [iv(0, 10)], (s,), passes=[p],
+             mesh_axes={"replica": 8})
+        assert not p.results() and p.n_proved >= 1
+
+    def test_wrong_declared_axis_flagged(self):
+        s = jax.ShapeDtypeStruct((8, 4), jnp.int32)
+        p = ShardingConsistencyPass()
+        _run(_tiny_sharded_fn(), [iv(0, 10)], (s,), passes=[p],
+             mesh_axes={"shard": 8})
+        assert any(f_.code == "unknown-mesh-axis" for f_ in p.results())
+
+    def test_collective_in_batched_engine_flagged(self):
+        s = jax.ShapeDtypeStruct((8, 4), jnp.int32)
+        p = ShardingConsistencyPass()
+        _run(_tiny_sharded_fn(), [iv(0, 10)], (s,), passes=[p],
+             mesh_axes={})  # batched declaration: no collectives allowed
+        assert any(f_.code == "collective-in-batched-engine"
+                   for f_ in p.results())
+
+
+# --------------------------------------------------------------------------
+# whole-engine analysis: clean engines, red mutations
+# --------------------------------------------------------------------------
+
+
+def _small_cfg(**kw):
+    base = dict(n_replicas=3, n_keys=1 << 12, n_sessions=16,
+                replay_slots=8, ops_per_session=8)
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def _gating(reports):
+    return [f for r in reports for f in r["findings"]
+            if f.severity in ana.GATING]
+
+
+class TestEngineAnalysis:
+    def test_batched_race_clean(self):
+        reports = ana.analyze_config(_small_cfg(), engines=("batched",))
+        assert _gating(reports) == []
+
+    def test_fused_and_split_clean_batched_and_sharded(self):
+        cfg = _small_cfg(arb_mode="sort", chain_writes=4, lane_budget_cfg=8)
+        reports = ana.analyze_config(cfg)  # both engines, fused + split
+        assert {r["engine"] for r in reports} == {
+            "batched/fused", "batched/split", "sharded/fused",
+            "sharded/split"}
+        assert _gating(reports) == []
+
+    def test_audited_assumptions_visible(self):
+        reports = ana.analyze_config(_small_cfg(), engines=("batched",))
+        audits = {f.audit for r in reports for f in r["findings"]
+                  if f.severity == "info" and f.audit}
+        assert "pts-mint-ver-bounded-by-watermark" in audits
+        assert "winner-row-dup-writes-identical" in audits
+
+    def test_mutation_wide_keys_flips_red(self):
+        # widen n_keys past the INV pkf key field (bypassing config
+        # validation): the wire-header pack must flag the alias
+        cfg = _small_cfg()
+        object.__setattr__(cfg, "n_keys", 1 << 30)
+        rep = ana.analyze_program(ana.trace_program(cfg, "sharded"))
+        errs = [f for f in rep["findings"] if f.severity == "error"]
+        assert any(f.code == "pack-overlap" for f in errs)
+
+    def test_mutation_wide_keys_trips_fused_assert(self):
+        # the fused sort key's trace-time capacity assert (satellite):
+        # band cannot collide with a max-sub value
+        cfg = _small_cfg(arb_mode="sort")
+        object.__setattr__(cfg, "n_keys", 1 << 30)
+        assert cfg.use_fused_sort
+        with pytest.raises(AssertionError, match="fused sort key overflow"):
+            ana.trace_program(cfg, "batched")
+
+    def test_mutation_drop_audit_flips_red(self, monkeypatch):
+        monkeypatch.setattr(layouts, "audited",
+                            lambda tag: contextlib.nullcontext())
+        reports = ana.analyze_config(_small_cfg(), engines=("batched",))
+        gating = _gating(reports)
+        assert any(f.code == "scatter-set-not-injective" for f in gating)
+
+
+# --------------------------------------------------------------------------
+# findings export + gate pass/fail/--update
+# --------------------------------------------------------------------------
+
+
+def _load_gate_module():
+    spec = importlib.util.spec_from_file_location(
+        "check_analysis", os.path.join(REPO, "scripts", "check_analysis.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestGate:
+    def test_export_findings_obs_schema(self, tmp_path):
+        reports = ana.analyze_config(_small_cfg(), engines=("batched",))
+        out = tmp_path / "findings.jsonl"
+        ana.export_findings(str(out), reports)
+        recs = [json.loads(line) for line in out.read_text().splitlines()]
+        assert recs
+        assert all("t" in r and r["kind"] == "analysis" for r in recs)
+        assert recs[0]["record"] == "program"
+        kinds = {r["record"] for r in recs}
+        assert kinds <= {"program", "finding"}
+
+    def test_key_counts_and_diff(self):
+        f1 = ana.Finding(pass_name="bitpack", code="pack-overlap",
+                         severity="error", message="m", file="f.py",
+                         fn="g", op="or", engine="batched/fused")
+        f2 = ana.Finding(pass_name="scatter", code="x", severity="info",
+                         message="m", engine="batched/fused")
+        f1.engine = f"bench:{f1.engine}"  # the gate's config stamp
+        counts = ana.key_counts([f1, f2])
+        assert len(counts) == 1  # info never gates
+        (k, c), = counts.items()
+        assert k.startswith("bench:batched/fused|bitpack|pack-overlap")
+        new, stale = ana.diff_baseline(counts, {})
+        assert new == counts and not stale
+        new, stale = ana.diff_baseline(counts, dict(counts))
+        assert not new and not stale
+        new, stale = ana.diff_baseline({}, dict(counts))
+        assert not new and stale == counts
+
+    def test_gate_script_pass_fail_update(self, tmp_path, monkeypatch):
+        mod = _load_gate_module()
+        monkeypatch.setattr(
+            mod, "gate_configs",
+            lambda: {"tiny": _small_cfg(n_replicas=3)})
+        baseline = tmp_path / "BASELINE.json"
+
+        def run(*argv):
+            monkeypatch.setattr(
+                "sys.argv",
+                ["check_analysis.py", "--baseline", str(baseline), *argv])
+            return mod.main()
+
+        # pass: clean engines, empty baseline
+        assert run() == 0
+        # fail: drop the audits -> new warn findings, not baselined
+        monkeypatch.setattr(layouts, "audited",
+                            lambda tag: contextlib.nullcontext())
+        assert run() == 1
+        # --update grandfathers them, then the gate passes again
+        assert run("--update") == 0
+        doc = json.loads(baseline.read_text())
+        assert doc["grandfathered"]
+        assert all(k.startswith("tiny:") for k in doc["grandfathered"])
+        assert run() == 0
+
+
+# --------------------------------------------------------------------------
+# satellite regressions in faststep
+# --------------------------------------------------------------------------
+
+
+class TestFaststepRegressions:
+    def test_codec_round_trip_negatives(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randint(-2**31, 2**31, size=(5, 7),
+                                    dtype=np.int64).astype(np.int32))
+        b = fst._i32_to_bank(x)
+        assert b.dtype == jnp.int8
+        y = fst._bank_to_i32(b)
+        assert (x == y).all()
+
+    def test_rotation_congruent_and_overflow_safe(self):
+        for n in (5, 64, 16640):
+            idx = jnp.arange(n, dtype=jnp.int32)
+            for s in (0, 1, 7, 1000, 123457):
+                old = (idx + s * layouts.ROT_STRIDE) % n  # pre-fix formula
+                assert (fst._rotated(idx, jnp.int32(s), n) == old).all()
+        # past the old formula's int32 overflow point the fix stays a
+        # bijection in [0, n) while step*127 would have wrapped negative
+        big = jnp.int32(17_000_000)
+        assert int(big) * layouts.ROT_STRIDE > 2**31  # the old hazard
+        r = fst._rotated(jnp.arange(64, dtype=jnp.int32), big, 64)
+        assert (r >= 0).all() and (r < 64).all()
+        assert len(set(np.asarray(r).tolist())) == 64
+
+    def test_run_issue_rank_clip_is_noop_on_issuers(self):
+        # the analysis-driven clip must not change which lanes issue or
+        # their chain ranks (bench-shape semantics regression)
+        cfg = _small_cfg(arb_mode="sort", chain_writes=4)
+        first = jnp.asarray([[True, False, False, True, False, False]])
+        in_run = jnp.asarray([[True, True, True, True, True, False]])
+        sop = jnp.full((1, 6), t.OP_WRITE)
+        pos = jnp.arange(6, dtype=jnp.int32)[None]
+        issue, rank = fst._run_issue(cfg, first, in_run, sop, pos)
+        assert issue.tolist() == [[True, True, True, True, True, False]]
+        assert rank.tolist() == [[0, 1, 2, 0, 1, 0]]
+
+    def test_layouts_consistency(self):
+        # the declared table and the runtime constants cannot drift
+        assert fst.INV_KEY_MASK == (1 << 29) - 1
+        assert int(fst.INV_FRESH) == 1 << 29
+        assert int(fst.INV_VALID) == 1 << 30
+        assert fst.PTS_FC_BITS == 10
+        assert HermesConfig().max_key_versions == layouts.MAX_KEY_VERSIONS
+        for lay in layouts.ALL:
+            lay.validate()
+
+    def test_fused_drive_still_drains(self):
+        cfg = _small_cfg(arb_mode="sort", chain_writes=4,
+                         ops_per_session=16, n_sessions=8)
+        from hermes_tpu.workload import ycsb
+
+        fs = fst.init_fast_state(cfg)
+        stream = fst.prep_stream(jax.tree.map(jnp.asarray,
+                                              ycsb.make_streams(cfg)))
+        step = fst.build_fast_batched(cfg)
+        for s in range(60):
+            fs, _ = step(fs, stream, fst.make_fast_ctl(cfg, s))
+        assert (fs.sess.status == t.S_DONE).all()
+        assert ((fs.table.sst & 7) == t.VALID).all()
