@@ -26,6 +26,10 @@ Workload mixes (BASELINE.json:7-9):
 ``python bench.py`` prints the primary (YCSB-A) line on stdout — the driver
 contract.  ``python bench.py --mix all`` additionally measures the other
 mixes, prints one line each to stderr, and writes BENCH_MIXES.json.
+``python bench.py --pipeline`` A/Bs the round-8 serving pipeline instead
+(sync vs async completion harvest through FastRuntime, bench shape +
+latency mode, byte-identical-Meta assertion) and writes
+PIPELINE_COMPARE.json.
 
 Measurement protocol for this runtime (measured, see faststep.py header):
 execution through the tunneled PJRT link is DEFERRED until the first
@@ -39,6 +43,7 @@ vs_baseline = value / 1e7 (the north-star aggregate target).
 """
 
 import argparse
+import dataclasses
 import json
 import math
 import os
@@ -325,6 +330,92 @@ def run_latency(n_sessions: int = 1024) -> dict:
     }
 
 
+def _runtime_cell(cfg, rounds: int, warmup: int, fetch: bool = True) -> dict:
+    """One serving-loop cell: FastRuntime step_once x rounds with the
+    completion fetch on (the client-shaped loop the round-8 pipeline
+    overlaps) or off (the pure dispatch+device wall — the device span the
+    acceptance criterion subtracts).  Returns wall + Meta counters."""
+    from hermes_tpu.runtime import FastRuntime
+
+    rt = FastRuntime(cfg)
+    rt.fetch_completions = fetch
+    for _ in range(warmup):
+        rt.step_once()
+    rt.flush_pipeline()
+    jax.block_until_ready(rt.fs)
+    jax.device_get(rt.fs.meta.n_write)  # tunneled link -> synchronous mode
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        rt.step_once()
+    rt.flush_pipeline()
+    jax.block_until_ready(rt.fs)
+    wall = time.perf_counter() - t0
+    m = jax.device_get(rt.fs.meta)
+    return {
+        "wall_s": round(wall, 4),
+        "round_us": round(wall / rounds * 1e6, 1),
+        "rounds": rounds,
+        "counters": {
+            "n_read": int(m.n_read.sum()), "n_write": int(m.n_write.sum()),
+            "n_rmw": int(m.n_rmw.sum()), "n_abort": int(m.n_abort.sum()),
+            "lat_sum": int(m.lat_sum.sum()), "lat_cnt": int(m.lat_cnt.sum()),
+            "lat_hist": m.lat_hist.sum(axis=0).tolist(),
+        },
+    }
+
+
+def run_pipeline_compare(depth: int = 4, rounds: int = 40, warmup: int = 8,
+                         mix: str = "a", over: dict | None = None) -> dict:
+    """A/B the round-8 serving pipeline (PIPELINE_COMPARE.json): the same
+    round sequence at bench shape through FastRuntime with completions
+    fetched every round — synchronous harvest (pipeline_depth=1, the
+    pre-round-8 loop) vs the depth-``depth`` async harvest ring — plus a
+    fetchless cell isolating the device span, and the latency operating
+    point (1 round/dispatch) where the ring hides the per-dispatch link
+    handshake.  Meta counters must be byte-identical between the sync and
+    pipelined cells (same rounds, same device program — the ring only
+    re-schedules the readback)."""
+    base = dict(over or {})
+    cells = {}
+    for name, d, fetch in (("sync", 1, True), ("pipelined", depth, True),
+                           ("device_only", 1, False)):
+        cfg = _cfg(mix, dict(base, pipeline_depth=d,
+                             donate_state=True))
+        cells[name] = _runtime_cell(cfg, rounds, warmup, fetch=fetch)
+        cells[name]["pipeline_depth"] = d
+
+    meta_equal = cells["sync"]["counters"] == cells["pipelined"]["counters"]
+    dev = cells["device_only"]["wall_s"]
+    overhead = lambda c: round(c["wall_s"] - dev, 4)
+
+    # latency operating point: 1 round per dispatch at small scale — the
+    # regime where the per-dispatch handshake dominates and the ring's
+    # overlap shows up directly in the per-round wall
+    lat = {}
+    for name, d in (("sync", 1), ("pipelined", depth)):
+        cfg = dataclasses.replace(_latency_cfg(1024), pipeline_depth=d)
+        lat[name] = _runtime_cell(cfg, max(rounds, 60), warmup)
+        lat[name]["pipeline_depth"] = d
+
+    return {
+        "mix": mix,
+        "pipeline_depth": depth,
+        "cells": cells,
+        "meta_equal": meta_equal,
+        "host_overhead_sync_s": overhead(cells["sync"]),
+        "host_overhead_pipelined_s": overhead(cells["pipelined"]),
+        "latency": {
+            "sync_round_us": lat["sync"]["round_us"],
+            "pipelined_round_us": lat["pipelined"]["round_us"],
+        },
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+        "note": "host_overhead_* = wall - device_only wall at bench shape "
+                "with per-round completion fetch; meta_equal pins the "
+                "sync<->pipelined state identity (byte-identical Meta)",
+    }
+
+
 # Shared with __graft_entry__.entry(): every driver entry path fails fast
 # on a wedged backend with the same bounded subprocess probe.
 from hermes_tpu.probe import probe_backend  # noqa: E402
@@ -346,6 +437,15 @@ def main() -> None:
                     "analyzer (hermes_tpu.analysis) on each measured mix's "
                     "round program and write the findings as obs analysis "
                     "records (abstract tracing, no extra device work)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="A/B the round-8 serving pipeline instead of the "
+                    "throughput mixes: sync vs pipelined completion harvest "
+                    "at bench shape + latency mode, asserting byte-identical"
+                    " Meta counters; writes PIPELINE_COMPARE.json")
+    ap.add_argument("--pipeline-depth", type=int, default=4,
+                    help="harvest-ring depth for the pipelined cells")
+    ap.add_argument("--pipeline-rounds", type=int, default=40,
+                    help="measured serving rounds per --pipeline cell")
     ap.add_argument("--probe-timeout", type=float, default=float(
         os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
     args = ap.parse_args()
@@ -364,6 +464,10 @@ def main() -> None:
         if obs_exp is not None:
             obs_exp.write(rec, kind="summary")
 
+    if args.pipeline and args.mix == "latency":
+        ap.error("--pipeline already includes the latency cell; pick a "
+                 "throughput mix for the bench-shape cells")
+
     ok, info = probe_backend(args.probe_timeout)
     if not ok:
         # one diagnosable JSON line + non-zero rc instead of inheriting
@@ -376,6 +480,26 @@ def main() -> None:
                 "unit": "writes/s", "vs_baseline": 0.0, "error": info})
         out.write(rec)
         sys.exit(1)
+
+    if args.pipeline:
+        r = run_pipeline_compare(depth=args.pipeline_depth,
+                                 rounds=args.pipeline_rounds,
+                                 mix=args.mix if args.mix != "all" else "a")
+        with open("PIPELINE_COMPARE.json", "w") as f:
+            json.dump(r, f, indent=1)
+        cell(r)
+        # the stdout line stays scalar-only (the per-cell histograms live
+        # in the JSON artifact)
+        out.write({
+            "metric": "pipeline_host_overhead_s",
+            "sync": r["host_overhead_sync_s"],
+            "pipelined": r["host_overhead_pipelined_s"],
+            "meta_equal": r["meta_equal"],
+            "latency_round_us": r["latency"],
+        })
+        if not r["meta_equal"]:
+            sys.exit(1)
+        return
 
     if args.mix == "latency":
         r = run_latency()

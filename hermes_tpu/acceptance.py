@@ -71,14 +71,20 @@ def _cfg(n: int, scale: float) -> HermesConfig:
 def run_config(n: int, scale: float = 0.01, max_steps: int = 5000,
                backend: str = "batched", mesh=None, check: bool = True,
                check_keys: Optional[int] = 512,
+               pipeline_depth: int = 1,
                log: Optional[Callable[[str], None]] = None) -> Tuple[Dict, object]:
     """Run acceptance scenario ``n``; returns (counters, Verdict|None).
     ``check_keys`` samples the checked key set (None = every touched key —
-    the full-scale artifact's setting; 512 keeps CI fast)."""
+    the full-scale artifact's setting; 512 keeps CI fast).
+    ``pipeline_depth >= 2`` runs the scenario through the round-8 harvest
+    ring (async completion readback) — protocol outcomes and checker
+    verdicts must be unchanged (cli --acceptance --pipeline-depth)."""
     from hermes_tpu.checker.fast import default_record
 
     say = log or (lambda s: None)
     cfg = _cfg(n, scale)
+    if pipeline_depth != 1:
+        cfg = dataclasses.replace(cfg, pipeline_depth=pipeline_depth)
     # columnar recorder + native witness (checker/fast.py): same verdicts
     # as the Python recorder (witness FAILs are confirmed by the exact
     # search) at a per-op cost that survives scale=1.0 histories; falls

@@ -64,6 +64,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--rmw-retries", type=int, default=0,
                     help="RMW nack retry-in-place budget (faststep; 0 = "
                          "reference abort-on-nack behavior)")
+    ap.add_argument("--pipeline-depth", type=int, default=1,
+                    help="in-flight dispatch ring depth (round-8 pipelined "
+                         "serving: depth >= 2 overlaps the completion "
+                         "readback with the next device round; 1 = "
+                         "synchronous).  Fast backends only; with "
+                         "--acceptance, runs the scenarios pipelined")
+    ap.add_argument("--no-donate", action="store_true",
+                    help="compile the round WITHOUT state-tree donation "
+                         "(the copying A/B baseline, cfg.donate_state; "
+                         "fast backends only)")
     ap.add_argument("--no-auto-rebase", action="store_true",
                     help="disable the automatic version rebase at counter "
                          "polls (restores the loud packed-ts overflow error "
@@ -121,6 +131,13 @@ def main(argv=None) -> int:
         ap.error("--arb-mode/--chain-writes/--no-auto-rebase/--rmw-retries "
                  "only affect the fast backends (core/faststep.py / runtime."
                  "FastRuntime); use --backend fast or fast-sharded")
+    if args.pipeline_depth < 1:
+        ap.error("--pipeline-depth must be >= 1")
+    if ((args.pipeline_depth > 1 or args.no_donate)
+            and args.backend not in ("fast", "fast-sharded")):
+        ap.error("--pipeline-depth/--no-donate only affect the fast "
+                 "backends (runtime.FastRuntime's harvest ring / donated "
+                 "state); use --backend fast or fast-sharded")
     if args.profile_out and args.backend not in ("fast", "fast-sharded"):
         ap.error("--profile-out censuses the fast round (core/faststep.py); "
                  "use --backend fast or fast-sharded")
@@ -151,7 +168,8 @@ def main(argv=None) -> int:
         rc = 0
         for n in which:
             counters, verdict = acceptance.run_config(
-                n, scale=args.scale, log=lambda s: print(s, file=sys.stderr)
+                n, scale=args.scale, pipeline_depth=args.pipeline_depth,
+                log=lambda s: print(s, file=sys.stderr)
             )
             ok = counters["drained"] and (verdict is None or verdict.ok)
             print(f"config {n}: {'PASS' if ok else 'FAIL'} {counters}")
@@ -171,6 +189,8 @@ def main(argv=None) -> int:
         chain_writes=args.chain_writes,
         rmw_retries=args.rmw_retries,
         auto_rebase=not args.no_auto_rebase,
+        pipeline_depth=args.pipeline_depth,
+        donate_state=not args.no_donate,
         workload=WorkloadConfig(
             distribution=args.distribution,
             zipf_theta=args.zipf_theta,
@@ -279,6 +299,9 @@ def main(argv=None) -> int:
                               hists=obs is not None)
     if obs:
         obs.summary(rec)
+        # registry totals (round-8 overlap counters host_work_s /
+        # device_wait_s + the pipeline_depth gauge, transport counters, …)
+        obs.registry_snapshot()
         rec = {k: v for k, v in rec.items()
                if k not in ("lat_hist", "qwait_hist")}
     print(rec)

@@ -170,6 +170,30 @@ class HermesConfig:
     # predate this flag and the acceptance drivers read them.
     phase_metrics: bool = True
 
+    # --- serving pipeline (round-8, runtime.FastRuntime / kvs.KVS) --------
+    # Donate the state tree to the compiled round: XLA aliases the ~46 MB
+    # FastState buffers in place instead of copying them every dispatch.
+    # On for the runtimes (the serving path never reuses a superseded
+    # state reference — holding one raises loudly, see
+    # tests/test_pipeline.py); False restores the copying program, kept as
+    # the A/B baseline bench.py --pipeline measures against.  The raw
+    # builders (build_fast_batched/...) keep their own defaults for
+    # scripts that manage state lifetime themselves.
+    donate_state: bool = True
+    # In-flight dispatch ring depth for FastRuntime.step_once (and the
+    # KVS client layer): 1 = synchronous (each round's completions are
+    # fetched before the next dispatch — the pre-round-8 behavior);
+    # depth >= 2 dispatches round k+1 before harvesting round k, so the
+    # device->host completion readback and the host-side
+    # recording/matching work overlap with the next device round.
+    # Completions still surface strictly in round order (a FIFO ring), so
+    # recorder/checker semantics are unchanged.  The KVS layer caps its
+    # effective depth at 2: round k+1's op stream must retire round k's
+    # completed slots (or idle sessions would re-issue the same client
+    # op), so only the BULK value readback + future resolution lag one
+    # round — see kvs.KVS.step.
+    pipeline_depth: int = 1
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or
@@ -205,6 +229,11 @@ class HermesConfig:
             )
         if not (0 <= self.rmw_retries <= (1 << 20)):
             raise ValueError("rmw_retries must be in [0, 2^20]")
+        if not (1 <= self.pipeline_depth <= 64):
+            raise ValueError(
+                "pipeline_depth must be in [1, 64] (each in-flight round "
+                "pins a full Completions tuple in device memory)"
+            )
         if self.n_keys > layouts.INV_PKF.field("key").cap:
             raise ValueError(
                 "n_keys must fit the declared INV key field "

@@ -1498,6 +1498,29 @@ def make_fast_ctl(cfg: HermesConfig, step: int,
     )
 
 
+@jax.jit
+def bump_step(step):
+    """Device-side round-counter increment (round-8 device-resident
+    control): the runtime's FastCtl.step rides this instead of a fresh
+    host scalar, so the steady-state round has zero H2D control
+    transfers (membership rows are cached separately behind a dirty
+    flag — see FastRuntime._ctl)."""
+    return step + jnp.int32(1)
+
+
+@jax.jit
+def pending_sessions(status, live_mask, frozen):
+    """One device-side reduction for the drain poll (round-8 satellite):
+    count sessions not yet S_DONE on live, unfrozen replicas.  Replaces
+    the full (R, S) status fetch per polling iteration with a scalar
+    readback; works for the batched and sharded layouts alike (the jit
+    respreads the cached ctl rows against the sharded status)."""
+    r = jnp.arange(status.shape[0], dtype=jnp.int32)
+    active = (((live_mask >> r) & 1) == 1) & jnp.logical_not(frozen)
+    undone = (status != t.S_DONE).astype(jnp.int32)
+    return jnp.sum(jnp.where(active[:, None], undone, 0))
+
+
 def build_fast_batched(cfg: HermesConfig, donate: bool = False):
     def step(fs, stream, ctl):
         return fast_round_batched(cfg, ctl, fs, stream)

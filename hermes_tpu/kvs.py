@@ -173,6 +173,13 @@ class KVS:
         # a raw rt.step_once() there would drop Completions on the floor and
         # strand the matching client futures forever
         self.rt.comp_sink = self.step
+        # pipelined serving (round-8, cfg.pipeline_depth >= 2): one round's
+        # BULK completion readback + future resolution is deferred so it
+        # overlaps with the next device round (see _step_pipelined); the
+        # runtime's rebase/drain boundaries force it out via this hook
+        self.rt.comp_flush = self.flush
+        self._depth = self.cfg.pipeline_depth
+        self._pending = None  # (round_idx, device comp handles, done_mask, code)
         self._queues: Dict[Tuple[int, int], collections.deque] = (
             collections.defaultdict(collections.deque)
         )
@@ -334,10 +341,18 @@ class KVS:
             if bf.all_done():
                 return True
             self.step()
+        self.flush()  # pipelined: the last round's resolution may be deferred
         return bf.all_done()
 
     def _inject_batches(self) -> None:
         free = self._kindarr == t.OP_NOP
+        if self._depth > 1:
+            # pipelined: a slot retired at the last sync point but whose
+            # resolution is still deferred looks NOP here — it must keep
+            # its (bid, bix) mapping until the deferred _resolve lands
+            free &= self._slot_bid < 0
+            for rs_key in self._inflight:
+                free[rs_key] = False
         # slots with queued per-op traffic keep their FIFO promise
         for rs_key in self._queued_slots:
             free[rs_key] = False
@@ -368,17 +383,13 @@ class KVS:
 
     _OPC = {"get": t.OP_READ, "put": t.OP_WRITE, "rmw": t.OP_RMW}
 
-    def step(self) -> int:
-        """Inject queued ops, run one protocol round, resolve completions.
-        Returns the number of ops completed this round."""
-        from hermes_tpu.core import state as st
-
-        # inject queued ops into idle slots (only slots marked ready —
-        # enqueue and completion maintain the invariant that every idle
-        # slot with queued work is in _ready).  A slot currently owned by a
-        # batch op is NOT idle: injecting over it would clobber the batch's
-        # in-flight stream entry and strand both ops — such slots wait
-        # (batch retirement re-readies them).
+    def _inject_ready(self) -> None:
+        """Inject queued per-op traffic into idle slots (only slots marked
+        ready — enqueue and completion maintain the invariant that every
+        idle slot with queued work is in _ready).  A slot currently owned
+        by a batch op is NOT idle: injecting over it would clobber the
+        batch's in-flight stream entry and strand both ops — such slots
+        wait (batch retirement re-readies them)."""
         waiting = set()
         for rs_key in self._ready:
             q = self._queues.get(rs_key)
@@ -400,32 +411,46 @@ class KVS:
             self._dirty = True
         self._ready.clear()
         self._ready |= waiting
-        if self._bat:
-            self._inject_batches()
-        if self._dirty:
-            from hermes_tpu.core import faststep as fst
 
-            self.rt.stream = fst.prep_stream(st.OpStream(
-                op=self._op, key=self._key, uval=self._uval,
-            ))
-            self._dirty = False
+    def _sync_stream(self) -> None:
+        """Push the staged host op arrays to the device-side stream."""
+        if not self._dirty:
+            return
+        from hermes_tpu.core import faststep as fst
+        from hermes_tpu.core import state as st
 
-        comp = self.rt.step_once()
-        code = np.asarray(comp.code)
-        rval = np.asarray(comp.rval)
-        wval = np.asarray(comp.wval)
-        ckey = np.asarray(comp.key)
-        # one vectorized mask finds the finished slots (kind matches code,
-        # completion echoes the injected slot id); Python touches only
-        # those, so step cost no longer scales with the in-flight count
+        self.rt.stream = fst.prep_stream(st.OpStream(
+            op=self._op, key=self._key, uval=self._uval,
+        ))
+        self._dirty = False
+
+    def _done_mask(self, code: np.ndarray, ckey: np.ndarray) -> np.ndarray:
+        """One vectorized mask finds the finished slots (kind matches code,
+        completion echoes the injected slot id); Python touches only
+        those, so step cost does not scale with the in-flight count."""
         k = self._kindarr
-        done_mask = (
+        return (
             (((k == t.OP_READ) & (code == t.C_READ))
              | ((k == t.OP_WRITE) & (code == t.C_WRITE))
              | ((k == t.OP_RMW)
                 & ((code == t.C_RMW) | (code == t.C_RMW_ABORT))))
             & (ckey == self._key[:, :, 0])
         )
+
+    def _retire(self, done_mask: np.ndarray) -> None:
+        """Blank completed slots in the staged stream so the NEXT dispatched
+        round cannot re-issue them (the idle session reloads its one-deep
+        stream slot every round).  Future/batch bookkeeping is _resolve's
+        job — in pipelined mode it runs one round later."""
+        rows, cols = np.nonzero(done_mask)
+        if rows.size:
+            self._op[rows, cols, 0] = t.OP_NOP
+            self._kindarr[rows, cols] = t.OP_NOP
+            self._dirty = True
+
+    def _resolve(self, done_mask, code, rval, wval, round_idx: int) -> int:
+        """Resolve the futures of one round's completed slots (the slots
+        were already retired by _retire).  Returns the op count."""
         ndone = 0
         # batch-owned slots: results land in the BatchFutures columns with
         # three fancy-index stores, then the slots retire vectorized
@@ -442,13 +467,10 @@ class KVS:
                 bf.code[gi] = code[rr, cc]
                 bf.value[gi] = rval[rr, cc, 2:]
                 bf.uid[gi] = wval[rr, cc, :2]
-                bf.step[gi] = self.rt.step_idx - 1
+                bf.step[gi] = round_idx
                 if b["cursor"] >= b["opc"].shape[0] and bf.all_done():
                     del self._bat[bid]
-            self._op[rows, cols, 0] = t.OP_NOP
-            self._kindarr[rows, cols] = t.OP_NOP
             self._slot_bid[rows, cols] = -1
-            self._dirty = True
             ndone += rows.size
             # freed slots with waiting per-op traffic become injectable
             # again (O(#queued slots), not O(#retired))
@@ -463,21 +485,80 @@ class KVS:
             done = Completion(
                 kind="rmw_abort" if c == t.C_RMW_ABORT else kind,
                 key=client_key,
-                step=self.rt.step_idx - 1,
+                step=round_idx,
             )
             if c in (t.C_READ, t.C_RMW):
                 done.value = rval[r, s, 2:].tolist()
             if c in (t.C_WRITE, t.C_RMW):
                 done.uid = (int(wval[r, s, 0]), int(wval[r, s, 1]))
             fut._result = done
-            # retire the slot so the session doesn't reload the same op
-            self._op[r, s, 0] = t.OP_NOP
-            self._kindarr[r, s] = t.OP_NOP
-            self._dirty = True
             if self._queues.get((r, s)):
                 self._ready.add((r, s))
             ndone += 1
         return ndone
+
+    def step(self) -> int:
+        """Inject queued ops, run one protocol round, resolve completions.
+        Returns the number of ops completed (with ``cfg.pipeline_depth >=
+        2``, the number resolved from the PREVIOUS round — resolution lags
+        one round so it overlaps with device execution)."""
+        self._inject_ready()
+        if self._bat:
+            self._inject_batches()
+        if self._depth > 1:
+            return self._step_pipelined()
+        self._sync_stream()
+        comp = self.rt.step_once()
+        code = np.asarray(comp.code)
+        done_mask = self._done_mask(code, np.asarray(comp.key))
+        self._retire(done_mask)
+        return self._resolve(done_mask, code, np.asarray(comp.rval),
+                             np.asarray(comp.wval), self.rt.step_idx - 1)
+
+    def _step_pipelined(self) -> int:
+        """Round-8 overlapped serving: dispatch round k from the staged
+        stream, then — while the device executes it — resolve round k-1's
+        futures (the BULK value readback + numpy matching + Future/batch
+        stores, via the runtime's harvest path so recording and version
+        re-anchoring are identical to the sync mode) and stage the next
+        client ops.  The only synchronous fetch is round k's small
+        code/key columns: round k+1's stream must retire round k's
+        completed slots before it dispatches, or idle sessions would
+        re-issue the same client op.  That data dependency caps the KVS
+        at one bulk-deferred round (effective depth 2) regardless of
+        cfg.pipeline_depth."""
+        self._sync_stream()
+        comp = self.rt.dispatch_round()
+        k = self.rt.step_idx - 1
+        # resolve round k-1 while the device runs round k
+        ndone = self.flush()
+        # intake freed by that resolution stages NOW — inside the
+        # device-busy window — for the round-k+1 dispatch (the next call's
+        # top-of-step injection pass runs after the sync point below, i.e.
+        # with the device idle, and only picks up ops enqueued since; it
+        # finds these queues already drained)
+        self._inject_ready()
+        if self._bat:
+            self._inject_batches()
+        # sync point: ONE fetch of the small columns only (code + echoed key)
+        code, ckey = (np.asarray(a) for a in
+                      jax.device_get((comp.code, comp.key)))
+        done_mask = self._done_mask(code, ckey)
+        self._retire(done_mask)
+        self._pending = (k, comp, done_mask, code)
+        return ndone
+
+    def flush(self) -> int:
+        """Resolve the deferred round's futures (pipelined mode; no-op at
+        depth 1).  Installed as the runtime's ``comp_flush`` hook so
+        rebase/drain boundaries force every in-flight completion out."""
+        if self._pending is None:
+            return 0
+        pk, pcomp, done_mask, code = self._pending
+        self._pending = None
+        comp_np = self.rt.harvest_comp(pcomp, round_idx=pk)
+        return self._resolve(done_mask, code, np.asarray(comp_np.rval),
+                             np.asarray(comp_np.wval), pk)
 
     def run_until(self, futures: Sequence[Future], max_steps: int = 10_000) -> bool:
         """Step until every future resolves (or the step budget runs out)."""
@@ -485,6 +566,7 @@ class KVS:
             if all(f.done() for f in futures):
                 return True
             self.step()
+        self.flush()  # pipelined: the last round's resolution may be deferred
         return all(f.done() for f in futures)
 
     # -- membership / failure passthrough ------------------------------------

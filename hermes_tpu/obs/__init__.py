@@ -16,6 +16,16 @@ since it pulls the engine modules in):
      monotonic clock as interval metrics (obs/trace.py), merged by
      ``scripts/obs_report.py`` into one causally ordered run story.
 
+Round-8 serving-pipeline metrics (fed by runtime.FastRuntime when an obs
+context is attached): the registry counters ``host_work_s`` /
+``device_wait_s`` split every step_once between host-side work and time
+blocked in the completion readback (their ratio is the overlap the
+harvest ring buys), the ``pipeline_depth`` gauge tracks the in-flight
+ring occupancy, and the ``ctl_upload`` trace event counts control-row
+H2D uploads (zero per steady-state round — membership rows are cached
+on device behind a dirty flag).  ``scripts/obs_report.py`` renders the
+overlap line from the last registry record.
+
 ``Observability`` is the facade the runtimes attach
 (``Runtime.attach_obs`` / ``FastRuntime.attach_obs``): one registry, one
 exporter (file or in-memory), one tracer, one clock.

@@ -143,6 +143,26 @@ def render_report(records: List[dict], max_timeline: Optional[int] = None
             lines.append(f"  t={r['t']:9.3f}s {kind:<10} "
                          f"{r.get('name', '')} {_fmt_fields(r)}")
 
+    # round-8 serving-pipeline overlap: the runtimes accumulate per-round
+    # host work vs device wait into the registry (runtime.step_once /
+    # harvest_comp); the last registry record carries the totals
+    last_reg = None
+    for r in records:
+        if r.get("kind") == "registry" and "device_wait_s" in r:
+            last_reg = r
+    if last_reg is not None:
+        host = float(last_reg.get("host_work_s", 0.0))
+        wait = float(last_reg["device_wait_s"])
+        tot = host + wait
+        lines.append("")
+        lines.append("-- serving-pipeline overlap --")
+        lines.append(
+            f"  host_work={host:.3f}s device_wait={wait:.3f}s"
+            + (f" (host loop blocked on readback {wait / tot:.0%}"
+               f" of its time)" if tot > 0 else "")
+            + (f" ring depth={last_reg['pipeline_depth']}"
+               if "pipeline_depth" in last_reg else ""))
+
     last_hists = None
     for r in records:
         if isinstance(r.get("lat_hist"), list) or isinstance(

@@ -18,6 +18,8 @@ Backends:
 
 from __future__ import annotations
 
+import collections
+import time
 from typing import Optional
 
 import jax
@@ -52,6 +54,21 @@ class _ObsHooks:
             self.obs.tracer.event(name, step=self.step_idx, **fields)
 
 
+def _sum_meta_counters(m) -> dict:
+    """Shared ``counters()`` body of both runtimes (round-8 satellite):
+    the Meta tree is fetched ONCE by the caller; this just sums the
+    already-host-resident columns."""
+    return dict(
+        n_read=m.n_read.sum(),
+        n_write=m.n_write.sum(),
+        n_rmw=m.n_rmw.sum(),
+        n_abort=m.n_abort.sum(),
+        lat_sum=m.lat_sum.sum(),
+        lat_cnt=m.lat_cnt.sum(),
+        lat_hist=m.lat_hist.sum(axis=0),
+    )
+
+
 class Runtime(_ObsHooks):
     def __init__(
         self,
@@ -75,6 +92,10 @@ class Runtime(_ObsHooks):
         self.epoch = np.zeros((r,), np.int32)
         self.live = np.full((r,), cfg.full_mask, np.int32)
         self.frozen = np.zeros((r,), bool)
+        # cached device copies of the membership rows (round-8 satellite):
+        # re-uploaded only when freeze/thaw/set_live/remove/join dirty them
+        self._ctl_dev = None
+        self._ctl_dirty = True
 
         self.recorder = HistoryRecorder(cfg) if record else None
         self.membership = None  # optional MembershipService (attach_membership)
@@ -99,21 +120,33 @@ class Runtime(_ObsHooks):
     # -- control -----------------------------------------------------------
 
     def _ctl(self) -> step_lib.StepCtl:
-        return step_lib.StepCtl(
-            step=jnp.int32(self.step_idx),
-            epoch=jnp.asarray(self.epoch),
-            live_mask=jnp.asarray(self.live),
-            frozen=jnp.asarray(self.frozen),
-        )
+        """Per-round control.  The membership rows (epoch/live/frozen) are
+        uploaded once and cached on device until a membership/fault hook
+        dirties them (the ``ctl_upload`` trace event counts the uploads);
+        only the step scalar rides along per round (the phases engine keeps
+        it host-derived — FastRuntime holds it device-resident)."""
+        if self._ctl_dirty:
+            self._ctl_dev = step_lib.StepCtl(
+                step=jnp.int32(0),
+                epoch=jnp.asarray(self.epoch),
+                live_mask=jnp.asarray(self.live),
+                frozen=jnp.asarray(self.frozen),
+            )
+            self._ctl_dirty = False
+            self._trace("ctl_upload", epoch=int(self.epoch[0]),
+                        live_mask=int(self.live[0]))
+        return self._ctl_dev._replace(step=jnp.int32(self.step_idx))
 
     def freeze(self, replica: int) -> None:
         """Failure injection: replica stops processing and emitting
         (config 4, BASELINE.json:10)."""
         self.frozen[replica] = True
+        self._ctl_dirty = True
         self._trace("freeze", replica=replica)
 
     def thaw(self, replica: int) -> None:
         self.frozen[replica] = False
+        self._ctl_dirty = True
         self._trace("thaw", replica=replica)
 
     def set_live(self, mask: int) -> None:
@@ -121,6 +154,7 @@ class Runtime(_ObsHooks):
         epoch messages are dropped on receipt)."""
         self.live[:] = mask
         self.epoch += 1
+        self._ctl_dirty = True
 
     def remove(self, replica: int) -> None:
         """Remove from membership AND fence: a removed replica must stop
@@ -224,18 +258,18 @@ class Runtime(_ObsHooks):
         return self._drain(max_steps)
 
     def _drain(self, max_steps: int) -> bool:
+        # one device-side reduction per poll (round-8 satellite) instead of
+        # fetching the whole (R, S) status array: sessions not yet DONE on
+        # live, unfrozen replicas — the membership rows ride the cached ctl
+        from hermes_tpu.core import faststep as fst
+
         for _ in range(max_steps):
-            status = np.asarray(jax.device_get(self.rs.sess.status))
-            live0 = int(self.live[0])
-            done = np.array(
-                [
-                    (status[r] == t.S_DONE).all() or not (live0 >> r) & 1 or self.frozen[r]
-                    for r in range(self.cfg.n_replicas)
-                ]
-            ).all()
-            pending = getattr(self, "transport", None)
-            net_empty = pending.pending() == 0 if pending is not None else True
-            if done and net_empty:
+            ctl = self._ctl()
+            undone = int(jax.device_get(fst.pending_sessions(
+                self.rs.sess.status, ctl.live_mask, ctl.frozen)))
+            net = getattr(self, "transport", None)
+            net_empty = net.pending() == 0 if net is not None else True
+            if undone == 0 and net_empty:
                 return True
             self.step_once()
         return False
@@ -243,16 +277,7 @@ class Runtime(_ObsHooks):
     # -- observability -----------------------------------------------------
 
     def counters(self) -> dict:
-        m = jax.device_get(self.rs.meta)
-        return dict(
-            n_read=np.asarray(m.n_read).sum(),
-            n_write=np.asarray(m.n_write).sum(),
-            n_rmw=np.asarray(m.n_rmw).sum(),
-            n_abort=np.asarray(m.n_abort).sum(),
-            lat_sum=np.asarray(m.lat_sum).sum(),
-            lat_cnt=np.asarray(m.lat_cnt).sum(),
-            lat_hist=np.asarray(m.lat_hist).sum(axis=0),
-        )
+        return _sum_meta_counters(jax.device_get(self.rs.meta))
 
     def history_ops(self):
         assert self.recorder is not None, "construct Runtime(record=True)"
@@ -300,10 +325,31 @@ class FastRuntime(_ObsHooks):
             raw = stream if stream is not None else ycsb.make_streams(cfg)
         self.stream = fst.prep_stream(raw)
 
+        # device-resident round counter (round-8): FastCtl.step is bumped
+        # ON DEVICE between rounds (faststep.bump_step), so the steady
+        # state uploads no control scalars at all; the host mirror
+        # (step_idx) exists for tracing/recording only.  Assigning
+        # step_idx (snapshot restore) re-seeds the device scalar.
+        self._step_dev = jnp.int32(0)
         self.step_idx = 0
         self.epoch = np.zeros((r,), np.int32)
         self.live = np.full((r,), cfg.full_mask, np.int32)
         self.frozen = np.zeros((r,), bool)
+        # cached device-side FastCtl rows (round-8): rebuilt+re-uploaded
+        # only when a membership/fault/quiesce hook dirties them — zero
+        # steady-state per-round H2D control transfers
+        self._ctl_dev = None
+        self._ctl_dirty = True
+        # async completion-harvest ring (round-8): device-side Completions
+        # handles of dispatched-but-unharvested rounds, drained FIFO so
+        # completions surface strictly in round order.  Depth 1 (default)
+        # is the synchronous pre-round-8 behavior.
+        self._ring: collections.deque = collections.deque()
+        self._devwait_s = 0.0
+        # a client layer that defers its own completion handling (kvs.KVS)
+        # installs a flush hook here so rebase/drain boundaries can force
+        # every in-flight completion out before re-anchoring versions
+        self.comp_flush = None
         # version-rebase state (round-4, rebase_versions): host quiesce
         # flag (traced into FastCtl — flipping it never recompiles),
         # cumulative per-key version deltas for recorder continuity, and
@@ -336,43 +382,97 @@ class FastRuntime(_ObsHooks):
             self.recorder = HistoryRecorder(cfg) if record else None
         self.membership = None
 
+        # donated state (round-8): XLA aliases the state tree in place
+        # instead of copying ~tens of MB per dispatch.  A superseded
+        # reference to self.fs raises loudly on use (the red test in
+        # tests/test_pipeline.py); cfg.donate_state=False restores the
+        # copying program (the bench A/B baseline).
         if backend == "batched":
-            self._step = fst.build_fast_batched(cfg)
+            self._step = fst.build_fast_batched(cfg, donate=cfg.donate_state)
         elif backend == "sharded":
             if mesh is None:
                 raise ValueError("sharded backend needs a mesh")
-            self._step = fst.build_fast_sharded(cfg, mesh, rounds=1, donate=False)
+            self._step = fst.build_fast_sharded(cfg, mesh, rounds=1,
+                                                donate=cfg.donate_state)
             self.fs, self.stream = fst.place_fast_sharded(cfg, mesh, self.fs, self.stream)
             self.mesh = mesh
         else:
             raise ValueError(f"unknown backend {backend!r}")
         self._fst = fst
 
+    # -- device-resident control (round-8) ---------------------------------
+
+    @property
+    def step_idx(self) -> int:
+        return self._step_idx
+
+    @step_idx.setter
+    def step_idx(self, v: int) -> None:
+        # external assignment (snapshot restore) — re-seed the device
+        # counter; the hot-path increment bypasses this (dispatch_round)
+        self._step_idx = int(v)
+        self._step_dev = jnp.int32(self._step_idx)
+
+    @property
+    def quiesce(self) -> bool:
+        return self._quiesce
+
+    @quiesce.setter
+    def quiesce(self, v: bool) -> None:
+        v = bool(v)
+        if v != getattr(self, "_quiesce", None):
+            self._ctl_dirty = True
+        self._quiesce = v
+
     def _ctl(self):
-        fst = self._fst
-        r = self.cfg.n_replicas
-        return fst.FastCtl(
-            step=jnp.int32(self.step_idx),
-            my_cid=jnp.arange(r, dtype=jnp.int32),
-            epoch=jnp.asarray(self.epoch),
-            live_mask=jnp.asarray(self.live),
-            frozen=jnp.asarray(self.frozen),
-            quiesce=jnp.bool_(self.quiesce),
-        )
+        """Per-round FastCtl: every row lives ON DEVICE and is re-uploaded
+        only when membership/fault/quiesce hooks dirty it (the
+        ``ctl_upload`` trace event counts uploads — the steady-state round
+        has none); the step scalar rides the device-side increment."""
+        if self._ctl_dirty:
+            fst = self._fst
+            r = self.cfg.n_replicas
+            ctl = fst.FastCtl(
+                step=jnp.int32(0),  # per-round step rides _step_dev
+                my_cid=jnp.arange(r, dtype=jnp.int32),
+                epoch=jnp.asarray(self.epoch),
+                live_mask=jnp.asarray(self.live),
+                frozen=jnp.asarray(self.frozen),
+                quiesce=jnp.bool_(self.quiesce),
+            )
+            if self.backend == "sharded" and jax.process_count() == 1:
+                # pre-place the per-replica rows in their mesh sharding so
+                # the dispatch doesn't re-spread them every round
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sh = NamedSharding(self.mesh, P("replica"))
+                ctl = ctl._replace(
+                    epoch=jax.device_put(ctl.epoch, sh),
+                    live_mask=jax.device_put(ctl.live_mask, sh),
+                    frozen=jax.device_put(ctl.frozen, sh),
+                )
+            self._ctl_dev = ctl
+            self._ctl_dirty = False
+            self._trace("ctl_upload", epoch=int(self.epoch[0]),
+                        live_mask=int(self.live[0]))
+        return self._ctl_dev._replace(step=self._step_dev)
 
     # -- membership / failure injection (same surface as Runtime) ----------
 
     def freeze(self, replica: int) -> None:
         self.frozen[replica] = True
+        self._ctl_dirty = True
         self._trace("freeze", replica=replica)
 
     def thaw(self, replica: int) -> None:
         self.frozen[replica] = False
+        self._ctl_dirty = True
         self._trace("thaw", replica=replica)
 
     def set_live(self, mask: int) -> None:
         self.live[:] = mask
         self.epoch += 1
+        self._ctl_dirty = True
 
     def remove(self, replica: int) -> None:
         self.frozen[replica] = True
@@ -426,31 +526,48 @@ class FastRuntime(_ObsHooks):
 
     # -- stepping ----------------------------------------------------------
 
-    def step_once(self):
-        """One protocol round; returns the host-side Completions (also fed to
-        the recorder when recording).  Multi-host runs (jax.distributed,
-        hermes_tpu/launch.py) skip the completion fetch — the global arrays
-        span non-addressable devices; use counters() (which allgathers) for
-        observability there."""
+    def dispatch_round(self):
+        """Dispatch one protocol round WITHOUT syncing; returns the
+        device-side Completions handles (None on multi-host runs — the
+        global completion arrays span non-addressable devices).  The
+        pipelined layers build on this: step_once's harvest ring and the
+        KVS client layer both keep the handles in flight while the device
+        runs the next round."""
         obs = self.obs
         trace = obs is not None and obs.trace_steps
         if trace:
             td = obs.tracer.span_begin("step_dispatch", step=self.step_idx)
         self.fs, comp = self._step(self.fs, self.stream, self._ctl())
+        self._step_dev = self._fst.bump_step(self._step_dev)
         if trace:
             obs.tracer.span_end("step_dispatch", td)
+        self._step_idx += 1
         if jax.process_count() > 1:
             assert self.recorder is None, "history recording is single-host only"
-            self.step_idx += 1
             return None
-        if not self.fetch_completions and self.recorder is None:
-            self.step_idx += 1
-            if self.membership is not None:
-                self.membership.poll(self)
-            return None
+        if self.membership is not None:
+            # NB: the lease poll reads device clocks, so an attached
+            # membership service makes every dispatch synchronous — raise
+            # its poll_interval to keep the pipeline overlapped
+            self.membership.poll(self)
+        return comp
+
+    def harvest_comp(self, comp, round_idx: Optional[int] = None):
+        """Fetch one dispatched round's completions, re-anchor rebased
+        versions, and feed the recorder.  Callers must harvest in round
+        order (the ring and kvs.KVS both drain FIFO) — the recorder's
+        history is ordered by record time."""
+        obs = self.obs
+        trace = obs is not None and obs.trace_steps
         if trace:
-            tr = obs.tracer.span_begin("readback", step=self.step_idx)
+            tr = obs.tracer.span_begin("readback", step=self.step_idx,
+                                       round=round_idx)
+        t0 = time.perf_counter() if obs is not None else 0.0
         comp_np = jax.device_get(comp)
+        if obs is not None:
+            dt = time.perf_counter() - t0
+            self._devwait_s += dt
+            obs.registry.counter("device_wait_s").inc(dt)
         if trace:
             obs.tracer.span_end("readback", tr)
         if self._ver_base is not None:
@@ -469,10 +586,53 @@ class FastRuntime(_ObsHooks):
             subs = comp_np if multi else (comp_np,)
             for c in subs:
                 self.recorder.record_step(c)
-        self.step_idx += 1
-        if self.membership is not None:
-            self.membership.poll(self)
         return comp_np
+
+    def _harvest_one(self):
+        idx, comp = self._ring.popleft()
+        return self.harvest_comp(comp, round_idx=idx)
+
+    def flush_pipeline(self) -> int:
+        """Harvest every in-flight completion in round order (the ring plus
+        any client layer's deferred round via ``comp_flush``); returns the
+        number of ring rounds drained.  Rebase/drain/check boundaries call
+        this so no completion is re-anchored with the wrong version era or
+        missing from the recorded history."""
+        n = len(self._ring)
+        while self._ring:
+            self._harvest_one()
+        if self.comp_flush is not None:
+            self.comp_flush()
+        return n
+
+    def step_once(self):
+        """One protocol round.  At ``cfg.pipeline_depth == 1`` (default)
+        this is synchronous: the round's host-side Completions are fetched
+        and returned (also fed to the recorder when recording).  At depth
+        >= 2 the round is dispatched and the OLDEST in-flight round is
+        harvested instead once the ring is full (returns None while it
+        fills) — the completion readback overlaps with the device
+        executing newer rounds, and completions still surface strictly in
+        round order.  ``fetch_completions=False`` (telemetry-only) runs
+        never sync at all.  Multi-host runs (jax.distributed,
+        hermes_tpu/launch.py) skip the completion fetch — use counters()
+        (which allgathers) for observability there."""
+        obs = self.obs
+        t0 = time.perf_counter() if obs is not None else 0.0
+        self._devwait_s = 0.0
+        comp = self.dispatch_round()
+        out = None
+        if comp is not None and (self.fetch_completions
+                                 or self.recorder is not None):
+            self._ring.append((self.step_idx - 1, comp))
+            if len(self._ring) >= self.cfg.pipeline_depth:
+                out = self._harvest_one()
+        if obs is not None:
+            reg = obs.registry
+            reg.counter("host_work_s").inc(
+                time.perf_counter() - t0 - self._devwait_s)
+            reg.gauge("pipeline_depth").set(len(self._ring))
+        return out
 
     def run(self, n_steps: int) -> None:
         for _ in range(n_steps):
@@ -521,6 +681,10 @@ class FastRuntime(_ObsHooks):
                     step()
             finally:
                 self.quiesce = prev
+        # every in-flight completion must land BEFORE the delta accumulates:
+        # ring/client-deferred rounds were dispatched in the pre-rebase
+        # version era and must be re-anchored with the pre-rebase _ver_base
+        self.flush_pipeline()
         if self._rebase_fn is None:
             self._rebase_fn = fst.build_rebase(
                 self.cfg, backend=self.backend,
@@ -546,17 +710,22 @@ class FastRuntime(_ObsHooks):
         return self._drain(max_steps)
 
     def _drain(self, max_steps: int) -> bool:
+        # one device-side scalar per poll (round-8 satellite; was a full
+        # (R, S) status fetch per iteration), with the membership rows
+        # riding the cached device ctl
+        fst = self._fst
+        ok = False
         for _ in range(max_steps):
-            status = np.asarray(jax.device_get(self.fs.sess.status))
-            live0 = int(self.live[0])
-            done = all(
-                (status[r] == t.S_DONE).all() or not (live0 >> r) & 1 or self.frozen[r]
-                for r in range(self.cfg.n_replicas)
-            )
-            if done:
-                return True
+            ctl = self._ctl()
+            undone = int(jax.device_get(fst.pending_sessions(
+                self.fs.sess.status, ctl.live_mask, ctl.frozen)))
+            if undone == 0:
+                ok = True
+                break
             self.step_once()
-        return False
+        # in-flight ring rounds carry completions the recorder still needs
+        self.flush_pipeline()
+        return ok
 
     # -- observability -----------------------------------------------------
 
@@ -571,16 +740,9 @@ class FastRuntime(_ObsHooks):
         else:
             m = jax.device_get(self.fs.meta)
         max_ver = self._check_version_headroom(m)
-        return dict(
-            n_read=np.asarray(m.n_read).sum(),
-            n_write=np.asarray(m.n_write).sum(),
-            n_rmw=np.asarray(m.n_rmw).sum(),
-            n_abort=np.asarray(m.n_abort).sum(),
-            lat_sum=np.asarray(m.lat_sum).sum(),
-            lat_cnt=np.asarray(m.lat_cnt).sum(),
-            lat_hist=np.asarray(m.lat_hist).sum(axis=0),
-            max_ver=max_ver,
-        )
+        out = _sum_meta_counters(m)
+        out["max_ver"] = max_ver
+        return out
 
     def _check_version_headroom(self, m) -> int:
         """Packed-ts overflow guard (HermesConfig.max_key_versions): the
@@ -642,11 +804,13 @@ class FastRuntime(_ObsHooks):
 
     def history_ops(self):
         assert self.recorder is not None, "construct FastRuntime(record=True)"
+        self.flush_pipeline()
         rec = self.recorder.finalize(self._sess_view())
         return rec.to_ops() if isinstance(rec, ArrayRecorder) else rec
 
     def check(self, max_keys: Optional[int] = None) -> lin.Verdict:
         assert self.recorder is not None, "construct FastRuntime(record=True)"
+        self.flush_pipeline()
         if isinstance(self.recorder, ArrayRecorder):
             self.recorder.finalize(self._sess_view())
             v = check_arrays(self.recorder, max_keys=max_keys)

@@ -41,12 +41,17 @@ def save(path: str, rt) -> None:
     kvs = None
     if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
         kvs, rt = rt, rt.rt
+        kvs.flush()  # pipelined mode: land the deferred round's futures
         if kvs._inflight or kvs._queued_slots or kvs._bat:
             raise ValueError(
                 "snapshot requires a quiescent KVS: resolve in-flight ops "
                 "and active batches (run step()/run_until/run_batch) "
                 "before saving"
             )
+    if hasattr(rt, "flush_pipeline"):
+        # harvest in-flight ring rounds: the recorder (if any) must not be
+        # missing completions the restored run would re-record
+        rt.flush_pipeline()
     state = rt.fs if hasattr(rt, "fs") else rt.rs
     arrays = _flatten(state, "state.")
     arrays["ctl.step_idx"] = np.int64(rt.step_idx)
@@ -116,10 +121,17 @@ def load(path: str, rt) -> None:
 
     ALL validation (config match, KVS-mode match both directions, target
     quiescence) happens before any mutation: a rejected load leaves the
-    target exactly as it was."""
+    target exactly as it was — except that the target's in-flight
+    pipeline (round-8 harvest ring / deferred KVS round) is drained
+    first, landing the OLD run's completions in the OLD run's version
+    era; without this they would be harvested after the restore and
+    re-anchored/recorded into the restored history."""
     kvs = None
     if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
         kvs, rt = rt, rt.rt
+        kvs.flush()
+    if hasattr(rt, "flush_pipeline"):
+        rt.flush_pipeline()
     z = np.load(path)
     # -- validate everything first -----------------------------------------
     saved_cfg = json.loads(bytes(z["meta.cfg"]).decode())
@@ -208,10 +220,13 @@ def load(path: str, rt) -> None:
         rt.fs = restored
     else:
         rt.rs = restored
-    rt.step_idx = int(z["ctl.step_idx"])
+    rt.step_idx = int(z["ctl.step_idx"])  # also re-seeds the device counter
     rt.epoch[:] = z["ctl.epoch"]
     rt.live[:] = z["ctl.live"]
     rt.frozen[:] = z["ctl.frozen"]
+    # the in-place row writes above bypass the membership hooks, so the
+    # cached device-side ctl (round-8) must be re-uploaded explicitly
+    rt._ctl_dirty = True
     if hasattr(rt, "_ver_base") and "ctl.ver_base" in z:
         # zero-length = the never-rebased sentinel (round-6 archives); a
         # full-length all-zeros array is the pre-round-6 encoding of the

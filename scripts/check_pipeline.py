@@ -1,0 +1,159 @@
+"""Round-8 serving-pipeline gate (CI): the async harvest ring and the
+donated state tree must be BEHAVIOR-INVISIBLE.
+
+Three assertions, CPU-smoke sized (joins scripts/check_op_census.py,
+check_obs_overhead.py and check_analysis.py in the verify flow):
+
+  1. sync <-> pipelined state identity: the same stream through
+     FastRuntime at pipeline_depth 1 vs >= 2 yields byte-identical state
+     trees and Meta counters on BOTH engines, and a checker-gated
+     pipelined KVS run (depth 2) passes linearizability;
+  2. donation is loud, and the DONATED round program passes the static
+     analyzer (hermes_tpu.analysis) with no findings beyond
+     ANALYSIS_BASELINE.json — which must stay EMPTY (the analyzer's
+     scatter pass includes the donation-aliasability check, so a state
+     output XLA cannot alias back onto its donated input surfaces here);
+  3. zero steady-state per-round control uploads: the ctl_upload trace
+     event fires once at first dispatch and then only on membership/fault
+     transitions.
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_pipeline.py
+
+Prints one JSON line; exit non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+
+def check_state_identity(report: dict) -> None:
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.runtime import FastRuntime
+
+    def run(depth, backend, mesh):
+        cfg = HermesConfig(
+            n_replicas=8 if backend == "sharded" else 3,
+            n_keys=64, n_sessions=4, replay_slots=2, ops_per_session=8,
+            pipeline_depth=depth,
+            workload=WorkloadConfig(read_frac=0.5, rmw_frac=0.3, seed=37),
+        )
+        rt = FastRuntime(cfg, backend=backend, mesh=mesh)
+        assert rt.drain(400), f"{backend} depth={depth} did not drain"
+        return rt
+
+    for backend in ("batched", "sharded"):
+        mesh = None
+        if backend == "sharded":
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+        a, b = run(1, backend, mesh), run(3, backend, mesh)
+        la = jax.tree.leaves(jax.device_get(a.fs))
+        lb = jax.tree.leaves(jax.device_get(b.fs))
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        report[f"{backend}_state_identical"] = True
+
+
+def check_kvs_pipelined(report: dict) -> None:
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.kvs import KVS
+
+    cfg = HermesConfig(n_replicas=3, n_keys=128, value_words=6, n_sessions=8,
+                       replay_slots=2, ops_per_session=1, pipeline_depth=2)
+    kvs = KVS(cfg, record=True)
+    futs = [kvs.put(i % 3, (i // 3) % 8, i % 11, [i, i + 1, 3, 4])
+            for i in range(24)]
+    futs += [kvs.rmw(i % 3, (i + 4) % 8, i % 11, [90 + i, 0, 0, 0])
+             for i in range(6)]
+    assert kvs.run_until(futs, 300), "pipelined KVS did not resolve"
+    v = kvs.rt.check()
+    assert v.ok, f"pipelined KVS checker FAIL: {v.failures[:2]}"
+    report["kvs_depth2_checked"] = True
+
+
+def check_donation_and_analysis(report: dict) -> None:
+    import jax
+    import numpy as np
+
+    from hermes_tpu import analysis as ana
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.runtime import FastRuntime
+
+    rt = FastRuntime(HermesConfig(n_replicas=3, n_keys=64, n_sessions=4,
+                                  replay_slots=2, ops_per_session=4))
+    old = rt.fs
+    rt.step_once()
+    try:
+        np.asarray(jax.device_get(old.table.vpts))
+        raise AssertionError("superseded donated state was readable")
+    except RuntimeError:
+        report["donation_red"] = True
+
+    with open(os.path.join(os.path.dirname(__file__), "..",
+                           "ANALYSIS_BASELINE.json")) as f:
+        base = json.load(f)
+    grandfathered = base.get("grandfathered", {})
+    assert not grandfathered, (
+        "ANALYSIS_BASELINE.json must stay empty (round-8 contract); found "
+        f"{len(grandfathered)} grandfathered finding(s)")
+    gating = []
+    for rep in ana.analyze_config(HermesConfig(), engines=("batched",),
+                                  variants="as-is"):
+        gating += [f for f in rep["findings"] if f.severity in ana.GATING]
+    assert not gating, f"analyzer findings on the donated program: {gating[:3]}"
+    report["analysis_clean"] = True
+
+
+def check_ctl_uploads(report: dict) -> None:
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.obs import Observability
+    from hermes_tpu.runtime import FastRuntime
+
+    rt = FastRuntime(HermesConfig(n_replicas=3, n_keys=64, n_sessions=4,
+                                  replay_slots=2, ops_per_session=16))
+    obs = rt.attach_obs(Observability())
+    rt.run(10)
+    rt.freeze(1)
+    rt.run(5)
+    ups = sum(1 for r in obs.records
+              if r.get("kind") == "event" and r.get("name") == "ctl_upload")
+    assert ups == 2, f"expected 2 ctl uploads (init + freeze), saw {ups}"
+    report["ctl_uploads_steady_state_zero"] = True
+
+
+def main() -> int:
+    report: dict = {"gate": "pipeline"}
+    try:
+        check_state_identity(report)
+        check_kvs_pipelined(report)
+        check_donation_and_analysis(report)
+        check_ctl_uploads(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report))
+        return 1
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
