@@ -416,6 +416,56 @@ def run_pipeline_compare(depth: int = 4, rounds: int = 40, warmup: int = 8,
     }
 
 
+def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
+                   warmup: int = 8) -> dict:
+    """Serving rate under chaos (round-9, CHAOS_BENCH.json): the bench-
+    shape YCSB-A config at pipeline depth ``depth`` with the failure
+    detector attached, driven clean vs under a seeded fault schedule
+    (freeze/thaw/join/crash-restart/heartbeat-skew; hermes_tpu.chaos) —
+    what the composed fault load costs the serving loop.  Correctness
+    truth lives in scripts/check_chaos.py and the checker-gated tests;
+    this cell measures rate and detection activity."""
+    from hermes_tpu import chaos as chaos_lib
+    from hermes_tpu.membership import MembershipService
+    from hermes_tpu.runtime import FastRuntime
+
+    cells = {}
+    for name in ("clean", "chaos"):
+        cfg = _cfg("a", dict(pipeline_depth=depth))
+        rt = FastRuntime(cfg)
+        rt.attach_membership(MembershipService(cfg, confirm_steps=4))
+        rt.run(warmup)
+        rt.counters()  # close the deferred-execution window before timing
+        sched = (chaos_lib.Schedule.random(cfg, seed, rounds)
+                 if name == "chaos" else chaos_lib.Schedule([]))
+        runner = chaos_lib.ChaosRunner(rt, sched)
+        c0 = rt.counters()
+        t0 = time.perf_counter()
+        runner.run(rounds, heal=False)
+        c1 = rt.counters()  # device sync closes the timing window
+        wall = time.perf_counter() - t0
+        cells[name] = dict(
+            rounds=rounds, wall_s=round(wall, 4),
+            round_us=round(1e6 * wall / rounds, 1),
+            writes=int(c1["n_write"] + c1["n_rmw"]
+                       - c0["n_write"] - c0["n_rmw"]),
+            events_applied=len(runner.log),
+            membership_events=len(rt.membership.events),
+            lost_ops=runner.lost_ops,
+        )
+        if name == "chaos":
+            cells[name]["event_log"] = runner.log
+    return {
+        "seed": seed, "pipeline_depth": depth, "cells": cells,
+        "slowdown": round(cells["chaos"]["round_us"]
+                          / max(1e-9, cells["clean"]["round_us"]), 3),
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+        "note": "rate cells only; linearizability under the same fault "
+                "classes is gated by scripts/check_chaos.py",
+    }
+
+
 # Shared with __graft_entry__.entry(): every driver entry path fails fast
 # on a wedged backend with the same bounded subprocess probe.
 from hermes_tpu.probe import probe_backend  # noqa: E402
@@ -446,6 +496,11 @@ def main() -> None:
                     help="harvest-ring depth for the pipelined cells")
     ap.add_argument("--pipeline-rounds", type=int, default=40,
                     help="measured serving rounds per --pipeline cell")
+    ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                    help="measure serving rate under a seeded chaos "
+                    "schedule vs clean (round-9, hermes_tpu.chaos; "
+                    "detector attached, --pipeline-depth/-rounds apply); "
+                    "writes CHAOS_BENCH.json")
     ap.add_argument("--probe-timeout", type=float, default=float(
         os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
     args = ap.parse_args()
@@ -480,6 +535,21 @@ def main() -> None:
                 "unit": "writes/s", "vs_baseline": 0.0, "error": info})
         out.write(rec)
         sys.exit(1)
+
+    if args.chaos is not None:
+        r = run_chaos_soak(args.chaos, rounds=args.pipeline_rounds,
+                           depth=max(2, args.pipeline_depth))
+        with open("CHAOS_BENCH.json", "w") as f:
+            json.dump(r, f, indent=1)
+        cell(r)
+        out.write({
+            "metric": "chaos_soak_round_us",
+            "clean": r["cells"]["clean"]["round_us"],
+            "chaos": r["cells"]["chaos"]["round_us"],
+            "slowdown": r["slowdown"],
+            "events": r["cells"]["chaos"]["events_applied"],
+        })
+        return
 
     if args.pipeline:
         r = run_pipeline_compare(depth=args.pipeline_depth,

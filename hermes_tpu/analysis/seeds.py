@@ -61,7 +61,8 @@ def seed_fast_state(cfg: HermesConfig):
     stp = step_seed(cfg)
     acks = iv(0, cfg.full_mask)
     meta = st.Meta(
-        last_seen=stp, n_read=COUNTER, n_write=COUNTER, n_rmw=COUNTER,
+        last_seen=stp, suspect_age=stp, n_read=COUNTER, n_write=COUNTER,
+        n_rmw=COUNTER,
         n_abort=COUNTER, lat_sum=COUNTER, lat_cnt=COUNTER, lat_hist=COUNTER,
         max_pts=pts, n_inv=COUNTER, n_rebcast=COUNTER, n_nack=COUNTER,
         n_retry=COUNTER, replay_peak=iv(0, cfg.replay_slots),

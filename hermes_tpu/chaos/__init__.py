@@ -1,0 +1,38 @@
+"""hermes_tpu.chaos — fault injection & recovery as a first-class
+subsystem (round-9; PAPER.md §5.3 / §4.4, Jepsen-style schedule-driven
+chaos per PAPERS.md).
+
+Three legs:
+
+  1. **Async failure detection** — the round program folds the heartbeat
+     staleness reduction into itself (``core/state.Meta.suspect_age``);
+     the runtime harvests it WITH completions through the round-8 ring,
+     and ``membership.MembershipService`` runs the suspect → confirm →
+     remove state machine off the harvested ages — an attached detector
+     costs the dispatch path zero synchronous ``device_get``s.
+  2. **Crash-consistent snapshots + recovery** — ``snapshot.save`` is
+     tmp+rename with a checksummed manifest; ``chaos.recovery.
+     restart_replica`` models a full host-crash (lost in-flight ops as
+     ``maybe_w`` history rows, fence/remove, snapshot-or-peer restore,
+     rejoin-with-state-transfer, coordinator re-validation).
+  3. **Declarative schedules** — ``chaos.schedule`` parses/draws seeded
+     fault programs (freeze/thaw/remove/join/crash-restart/heartbeat
+     clock-skew, plus net drop/delay/dup on the sim transport) and
+     ``ChaosRunner`` drives them against FastRuntime / KVS / sim Runtime,
+     every event on the obs timeline, gated end-to-end by the
+     linearizability checker (scripts/check_chaos.py is the CI gate).
+"""
+
+from hermes_tpu.chaos.recovery import restart_replica
+from hermes_tpu.chaos.schedule import (
+    ChaosEvent,
+    ChaosRunner,
+    ChaosSpec,
+    NetChaos,
+    Schedule,
+)
+
+__all__ = [
+    "ChaosEvent", "ChaosRunner", "ChaosSpec", "NetChaos", "Schedule",
+    "restart_replica",
+]

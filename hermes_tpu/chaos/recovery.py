@@ -1,0 +1,182 @@
+"""Crash-restart recovery: full host-crash of one replica, modeled end to
+end (PAPER.md §5.3 / §4.4 — any-replica failure is absorbed by replays,
+lease membership, and rejoin-with-state-transfer).
+
+``restart_replica`` is the one entry point.  It composes mechanisms the
+runtime already has (fence/remove, join-with-state-transfer, the replay
+scan, maybe_w history accounting) into the full crash story the ad-hoc
+fault drills never exercised:
+
+  1. **Crash.** The replica's volatile state dies: every in-flight client
+     op is lost.  In-flight UPDATES were already broadcast (a faststep
+     write invalidates its key in its own issue round), so the cluster may
+     still finish them via replay even though no client ever hears back —
+     they are folded into the recorded history as ``maybe_w`` (allowed,
+     not required, to linearize; checker/history.py) BEFORE the session
+     rows are wiped.  Wiped sessions skip past the lost op (``op_idx`` + 1)
+     so the restarted process never re-mints a dead op's unique write id.
+     On a KVS, the dead replica's client futures resolve loudly as
+     ``kind='lost'`` (kvs.C_LOST for batch slots) — the client layer's
+     answer to a crashed coordinator.
+  2. **Fence + remove.** A crashed replica must not serve reads; if the
+     failure detector has not already ejected it, ``remove()`` does
+     (epoch bump, quorum re-evaluation — unblocking writes it was holding
+     up).
+  3. **Restore.** With ``snapshot_path``, the manifest is verified first
+     (a torn or foreign snapshot is REJECTED on the timeline and recovery
+     falls back to peer transfer — never silently restoring garbage); the
+     snapshot contributes its still-current table rows, counted against
+     the donor as ``rows_current`` (the state-transfer volume a real
+     deployment saves).  The donor's copy stays authoritative either way:
+     a row whose packed ts matches the donor's is byte-identical by the
+     protocol's (key, ts) -> value uniqueness, so the join transfer below
+     is also the delta-restore.
+  4. **Rejoin + re-validate.** ``join(replica, donor)`` runs the existing
+     rejoin-with-state-transfer: the donor's in-flight coordination keys
+     enter the joiner INVALID and the live coordinator's VAL (or the
+     replay scan) re-validates them.
+
+Everything lands on the obs timeline as a ``crash_restart`` event
+(replica, donor, source, lost ops, rows_current).
+"""
+
+from __future__ import annotations
+
+import zipfile
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hermes_tpu import snapshot as snapshot_lib
+from hermes_tpu.core import types as t
+
+
+def _wipe_replica_volatile(rt, replica: int) -> int:
+    """Lose one replica's volatile per-session/replay state (the crash
+    itself).  Loaded ops (READ/ISSUE/INFL) vanish; their sessions step past
+    them so the restarted replica never re-mints a lost op's write uid.
+    Returns the number of client ops lost."""
+    cfg = rt.cfg
+    fs = rt.fs
+    sess, replay = fs.sess, fs.replay
+    row = sess.status[replica]
+    loaded = (row == t.S_READ) | (row == t.S_ISSUE) | (row == t.S_INFL)
+    op_idx = sess.op_idx[replica] + loaded.astype(jnp.int32)
+    if cfg.wrap_stream:
+        status = jnp.full_like(row, t.S_IDLE)
+    else:
+        status = jnp.where(op_idx >= cfg.ops_per_session,
+                           jnp.int32(t.S_DONE), jnp.int32(t.S_IDLE))
+    zero = jnp.zeros_like(sess.pts[replica])
+    new_sess = sess._replace(
+        status=sess.status.at[replica].set(status),
+        op_idx=sess.op_idx.at[replica].set(op_idx),
+        pts=sess.pts.at[replica].set(zero),
+        acks=sess.acks.at[replica].set(zero),
+        retries=sess.retries.at[replica].set(zero),
+        issue_step=sess.issue_step.at[replica].set(zero),
+    )
+    new_replay = replay._replace(
+        active=replay.active.at[replica].set(
+            jnp.zeros_like(replay.active[replica])))
+    rt.fs = fs._replace(sess=new_sess, replay=new_replay)
+    return int(jax.device_get(jnp.sum(loaded.astype(jnp.int32))))
+
+
+def _snapshot_rows_current(rt, replica: int, donor: int,
+                           snapshot_path: str) -> Optional[int]:
+    """FULLY verify the snapshot (manifest + every array checksum + config
+    fingerprint — snapshot.verify_archive; a torn archive must reject on
+    BOTH engines, not just the members one engine happens to read) and
+    count how many of its table rows for ``replica`` are still current
+    against the donor (same packed ts => byte-identical row, so these rows
+    need no transfer).  Returns None — with a ``snapshot_rejected``
+    timeline event — when the snapshot cannot be trusted."""
+    try:
+        snapshot_lib.verify_archive(snapshot_path, rt.cfg)
+        K = rt.cfg.n_keys
+        vpts = rt.fs.table.vpts
+        if vpts.shape[0] == K:
+            # batched: the authoritative table is SHARED and survives the
+            # crash — every (verified) row is current, nothing to transfer
+            return K
+        with np.load(snapshot_path) as z:
+            snap = np.asarray(
+                z["state.table.vpts"])[replica * K:(replica + 1) * K]
+        donor_rows = np.asarray(jax.device_get(
+            jax.lax.dynamic_slice_in_dim(vpts, donor * K, K)))
+        return int((snap == donor_rows).sum())
+    except (ValueError, OSError, KeyError, zipfile.BadZipFile) as e:
+        rt._trace("snapshot_rejected", replica=replica,
+                  path=str(snapshot_path), reason=str(e)[:160])
+        return None
+
+
+def restart_replica(target, replica: int, donor: Optional[int] = None,
+                    snapshot_path: Optional[str] = None) -> dict:
+    """Full host-crash + recovery of ``replica`` on a FastRuntime or a KVS
+    facade (see module docstring).  ``donor`` defaults to the lowest live,
+    unfrozen peer; ``snapshot_path`` opts into snapshot-seeded restore
+    (falls back to pure peer transfer when the snapshot is invalid).
+    Returns a summary dict (also emitted as the ``crash_restart`` obs
+    event)."""
+    kvs = None
+    if hasattr(target, "rt") and hasattr(target, "index"):  # the KVS facade
+        kvs, rt = target, target.rt
+    else:
+        rt = target
+    if not hasattr(rt, "fs"):
+        raise NotImplementedError(
+            "restart_replica models the fast engines (FastRuntime / KVS); "
+            "the phases Runtime keeps the scripted freeze/remove/join drills")
+    cfg = rt.cfg
+    if not (0 <= replica < cfg.n_replicas):
+        raise ValueError(f"replica {replica} out of range")
+
+    # land every in-flight round first: completions the device already
+    # produced are pre-crash facts the clients/recorder must see
+    rt.flush_pipeline()
+
+    # 1. crash — salvage the history first (broadcast in-flight updates may
+    # still commit via replay; the checker must be ALLOWED to linearize
+    # them), then lose the volatile state
+    if rt.recorder is not None:
+        rt.recorder.fold_pending(rt._sess_view(), replica)
+    lost_client = kvs._on_replica_crash(replica) if kvs is not None else 0
+    lost_ops = _wipe_replica_volatile(rt, replica)
+
+    # 2. fence + remove (unless the failure detector already ejected it)
+    if (int(rt.live[0]) >> replica) & 1:
+        rt.remove(replica)
+    else:
+        rt.frozen[replica] = True
+        rt._ctl_dirty = True
+
+    # donor: lowest live unfrozen peer
+    if donor is None:
+        live = int(rt.live[0])
+        cands = [d for d in range(cfg.n_replicas)
+                 if d != replica and (live >> d) & 1 and not rt.frozen[d]]
+        if not cands:
+            raise RuntimeError(
+                "restart_replica needs a live unfrozen donor; none left")
+        donor = cands[0]
+
+    # 3. restore source: verified snapshot (delta vs the donor) or transfer
+    rows_current = None
+    if snapshot_path is not None:
+        rows_current = _snapshot_rows_current(rt, replica, donor,
+                                              snapshot_path)
+    source = "snapshot" if rows_current is not None else "transfer"
+
+    # 4. rejoin with state transfer; the live coordinator / replay scan
+    # re-validates the donor's in-flight keys (runtime.join semantics)
+    rt.join(replica, donor)
+
+    summary = dict(replica=replica, donor=donor, source=source,
+                   lost_ops=lost_ops, lost_client_futures=lost_client,
+                   rows_current=rows_current)
+    rt._trace("crash_restart", **summary)
+    return summary

@@ -1,0 +1,493 @@
+"""Declarative, seeded fault schedules + the runner that drives them.
+
+Jepsen's lesson (PAPERS.md): fault handling you don't continuously
+exercise under adversarial SCHEDULES — composed, randomized, replayable —
+is fault handling you don't have.  This module turns the ad-hoc loop of
+tests/test_fault_soak.py into a subsystem the tests, CLI, bench and CI all
+drive:
+
+  * ``ChaosEvent`` / ``Schedule`` — a parsed event program.  Text form,
+    one event per line (``#`` comments allowed)::
+
+        @12 freeze 2
+        @18 thaw 2
+        @30 crash_restart 2 donor=0
+        @40 hb_skew 1 skew=9 until=55
+        @15 net_drop 0 dst=3 until=40
+
+    ``Schedule.parse`` / ``Schedule.format`` round-trip it;
+    ``Schedule.random(cfg, seed, steps, spec)`` draws a seeded program
+    (event kinds by ``ChaosSpec`` rates, targets left to pre-drawn
+    uniforms the runner resolves against eligibility at run time — so the
+    same seed + config replays the same executed schedule exactly).
+  * ``ChaosRunner`` — drives a FastRuntime, KVS facade, or sim-backed
+    Runtime through a schedule: applies each due event if legal (quorum
+    floor, target eligibility), steps the workload, heals the cluster at
+    the end, drains, and returns the run log.  Every applied event lands
+    on the obs timeline (freeze/thaw/remove/join via the runtime hooks,
+    crash_restart via chaos.recovery, hb_skew/net_* here), and the
+    EXECUTED log (``result["events"]``) is deterministic: same seed +
+    config => byte-identical log and final state.
+  * ``NetChaos`` — a window-driven adversarial schedule for
+    transport.sim.SimTransport (drop / delay / duplicate per directed
+    edge), so net faults compose with membership/crash events on the sim
+    engine.  The fast engines have no wire to corrupt; their "network"
+    fault class is heartbeat clock-skew (``hb_skew`` biases the failure
+    detector's observed ages via MembershipService.skew — false suspicion,
+    confirm-window hysteresis and spontaneous recovery, without a real
+    fault).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+EVENT_KINDS = ("freeze", "thaw", "remove", "join", "crash_restart",
+               "hb_skew", "net_drop", "net_delay", "net_dup")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One schedule entry.  ``replica`` is the target (net_*: the source
+    edge end; -1 = runner-resolved via ``u``).  Field use by kind:
+    join/crash_restart -> ``donor``; hb_skew -> ``skew`` + ``until``;
+    net_* -> ``dst`` (-1 = any) + ``until`` (+ ``skew`` as the delay)."""
+
+    step: int
+    kind: str
+    replica: int = -1
+    donor: int = -1
+    dst: int = -1
+    skew: int = 0
+    until: int = -1
+    u: float = 0.0  # pre-drawn uniform for run-time target resolution
+
+    def format(self) -> str:
+        parts = [f"@{self.step}", self.kind]
+        if self.replica >= 0:
+            parts.append(str(self.replica))
+        for f, dflt in (("donor", -1), ("dst", -1), ("skew", 0),
+                        ("until", -1)):
+            v = getattr(self, f)
+            if v != dflt:
+                parts.append(f"{f}={v}")
+        if self.u:
+            parts.append(f"u={self.u!r}")
+        return " ".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """Seeded-schedule mix: per-step event probabilities (disjoint draws
+    off one uniform) + shape knobs.  Defaults mirror the historical
+    test_fault_soak mix, extended with the round-9 fault classes."""
+
+    p_freeze: float = 0.06
+    p_thaw: float = 0.04
+    p_join: float = 0.06
+    p_crash: float = 0.02
+    p_skew: float = 0.02
+    p_net: float = 0.0  # sim engine only; ignored elsewhere
+    skew_amount: int = 6
+    skew_window: int = 12
+    net_window: int = 10
+    net_delay: int = 2
+    # legality floor: never freeze/crash below this many healthy replicas
+    min_healthy: int = 3
+    # detector-less fallback: a replica frozen longer than this is removed
+    # by the runner's lease rule (a MembershipService overrides this)
+    lease_remove_after: int = 6
+
+
+class Schedule:
+    """An ordered fault program (events sorted by step, stable)."""
+
+    def __init__(self, events: Sequence[ChaosEvent]):
+        for e in events:
+            if e.kind not in EVENT_KINDS:
+                raise ValueError(f"unknown chaos event kind {e.kind!r}")
+        self.events: List[ChaosEvent] = sorted(events, key=lambda e: e.step)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def format(self) -> str:
+        return "\n".join(e.format() for e in self.events) + "\n"
+
+    @classmethod
+    def parse(cls, text: str) -> "Schedule":
+        """Parse the declarative text form (see module docstring)."""
+        events = []
+        for ln, raw in enumerate(text.splitlines(), 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            toks = line.split()
+            if not toks[0].startswith("@"):
+                raise ValueError(f"line {ln}: want '@STEP KIND ...', got {raw!r}")
+            try:
+                step = int(toks[0][1:])
+            except ValueError:
+                raise ValueError(f"line {ln}: bad step in {toks[0]!r}")
+            if len(toks) < 2:
+                raise ValueError(f"line {ln}: missing event kind")
+            kind = toks[1]
+            if kind not in EVENT_KINDS:
+                raise ValueError(
+                    f"line {ln}: unknown chaos event kind {kind!r} "
+                    f"(want one of {', '.join(EVENT_KINDS)})")
+            kw: dict = dict(step=step, kind=kind)
+            pos = 2
+            if pos < len(toks) and "=" not in toks[pos]:
+                kw["replica"] = int(toks[pos])
+                pos += 1
+            for tok in toks[pos:]:
+                if "=" not in tok:
+                    raise ValueError(f"line {ln}: want key=value, got {tok!r}")
+                k, v = tok.split("=", 1)
+                if k not in ("donor", "dst", "skew", "until", "u"):
+                    raise ValueError(f"line {ln}: unknown field {k!r}")
+                kw[k] = float(v) if k == "u" else int(v)
+            try:
+                events.append(ChaosEvent(**kw))
+            except ValueError as e:
+                raise ValueError(f"line {ln}: {e}")
+        return cls(events)
+
+    @classmethod
+    def random(cls, cfg, seed: int, steps: int,
+               spec: Optional[ChaosSpec] = None) -> "Schedule":
+        """Seeded event program: one uniform per step selects the event
+        class by the spec's rates; a second pre-drawn uniform resolves the
+        target at RUN time (eligibility depends on cluster state, which is
+        deterministic given the same seed + config)."""
+        spec = spec or ChaosSpec()
+        rng = np.random.default_rng(seed)
+        events = []
+        for step in range(steps):
+            u = float(rng.random())
+            pick = float(rng.random())
+            lo = 0.0
+            for kind, p in (("freeze", spec.p_freeze),
+                            ("thaw", spec.p_thaw),
+                            ("join", spec.p_join),
+                            ("crash_restart", spec.p_crash),
+                            ("hb_skew", spec.p_skew),
+                            ("net_drop", spec.p_net / 3),
+                            ("net_delay", spec.p_net / 3),
+                            ("net_dup", spec.p_net / 3)):
+                if lo <= u < lo + p:
+                    kw: dict = dict(step=step, kind=kind, u=pick)
+                    if kind == "hb_skew":
+                        kw.update(skew=spec.skew_amount,
+                                  until=step + spec.skew_window)
+                    elif kind.startswith("net_"):
+                        kw.update(until=step + spec.net_window,
+                                  skew=spec.net_delay)
+                    events.append(ChaosEvent(**kw))
+                    break
+                lo += p
+        return cls(events)
+
+
+class NetChaos:
+    """Window-driven adversarial schedule for SimTransport: active windows
+    drop / delay / duplicate messages on matching directed edges.  Install
+    as ``SimTransport(r, schedule=net_chaos)``; the runner opens windows
+    from net_* events and ``clear()``s them when healing."""
+
+    def __init__(self):
+        # (kind, src, dst, from_step, until, delta); src/dst -1 = any
+        self.windows: List[Tuple[str, int, int, int, int, int]] = []
+
+    def add(self, kind: str, src: int, dst: int, from_step: int, until: int,
+            delta: int = 0) -> None:
+        self.windows.append((kind, src, dst, from_step, until, delta))
+
+    def clear(self) -> None:
+        self.windows.clear()
+
+    def _match(self, kind: str, src: int, dst: int, step: int):
+        for k, ws, wd, f, until, delta in self.windows:
+            if k != kind:
+                continue
+            if ws >= 0 and ws != src:
+                continue
+            if wd >= 0 and wd != dst:
+                continue
+            if f <= step < until:
+                return delta
+        return None
+
+    def __call__(self, kind: str, src: int, dst: int, step: int):
+        if src == dst:
+            return [step]  # loopback never traverses the faulty fabric
+        if self._match("drop", src, dst, step) is not None:
+            return []
+        whens = [step]
+        delta = self._match("delay", src, dst, step)
+        if delta is not None:
+            whens = [step + max(1, delta)]
+        if self._match("dup", src, dst, step) is not None:
+            whens = whens + [whens[0] + 1]
+        return whens
+
+
+class ChaosRunner:
+    """Drive a workload target through a fault schedule (module docstring).
+
+    ``target``: FastRuntime, KVS facade, or sim-backed Runtime.
+    ``net``: the NetChaos installed in the target's SimTransport (sim
+    engine only) — net_* events are logged as skipped without it.
+    ``snapshot_path``: opts crash_restart into snapshot-seeded restore;
+    with ``snapshot_every`` > 0 the runner refreshes the snapshot itself
+    at that cadence (fast engines, quiescent boundaries only — the KVS
+    save requires no in-flight client ops, so the runner snapshots the
+    RUNTIME under the facade)."""
+
+    def __init__(self, target, schedule: Schedule,
+                 spec: Optional[ChaosSpec] = None,
+                 net: Optional[NetChaos] = None,
+                 snapshot_path: Optional[str] = None,
+                 on_step: Optional[Callable[[int], None]] = None):
+        self.kvs = target if (hasattr(target, "rt")
+                              and hasattr(target, "index")) else None
+        self.rt = target.rt if self.kvs is not None else target
+        self.target = target
+        self.schedule = schedule
+        self.spec = spec or ChaosSpec()
+        self.net = net
+        self.snapshot_path = snapshot_path
+        self.on_step = on_step
+        self.log: List[dict] = []
+        self.lost_ops = 0
+        self.lost_client = 0
+        self._frozen_since: Dict[int, int] = {}
+        self._removed: set = set()
+        self._skew_until: Dict[int, int] = {}
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _healthy(self) -> List[int]:
+        live = int(self.rt.live[0])
+        return [r for r in range(self.rt.cfg.n_replicas)
+                if (live >> r) & 1 and not self.rt.frozen[r]]
+
+    def _note(self, step: int, kind: str, **fields) -> None:
+        self.log.append(dict(step=step, kind=kind, **fields))
+
+    def _pick(self, cands: Sequence[int], u: float) -> int:
+        return int(sorted(cands)[int(u * len(cands)) % len(cands)])
+
+    # -- event application ---------------------------------------------------
+
+    def _apply(self, step: int, e: ChaosEvent) -> None:
+        rt = self.rt
+        healthy = self._healthy()
+        if e.kind == "freeze":
+            cands = ([e.replica] if e.replica >= 0 else
+                     [r for r in healthy if r not in self._frozen_since])
+            if len(healthy) <= self.spec.min_healthy or not cands:
+                return
+            r = self._pick(cands, e.u)
+            rt.freeze(r)
+            self._frozen_since[r] = step
+            self._note(step, "freeze", replica=r)
+        elif e.kind == "thaw":
+            cands = ([e.replica] if e.replica >= 0
+                     else list(self._frozen_since))
+            cands = [r for r in cands if r in self._frozen_since]
+            if not cands:
+                return
+            r = self._pick(cands, e.u)
+            rt.thaw(r)
+            del self._frozen_since[r]
+            self._note(step, "thaw", replica=r)
+        elif e.kind == "remove":
+            r = e.replica
+            if r < 0 or not (int(rt.live[0]) >> r) & 1:
+                return
+            # the legality floor applies to removes of HEALTHY replicas
+            # too (removing a frozen one is the normal lease outcome): an
+            # over-aggressive declarative schedule degrades to what the
+            # cluster can absorb instead of emptying it
+            if r in healthy and len(healthy) <= self.spec.min_healthy:
+                self._note(step, "skipped", event=e.kind, replica=r,
+                           reason="healthy floor")
+                return
+            rt.remove(r)
+            self._removed.add(r)
+            self._frozen_since.pop(r, None)
+            self._note(step, "remove", replica=r)
+        elif e.kind == "join":
+            cands = ([e.replica] if e.replica >= 0 else list(self._removed))
+            cands = [r for r in cands if r in self._removed]
+            if not cands or not healthy:
+                return
+            r = self._pick(cands, e.u)
+            donor = e.donor if e.donor >= 0 else healthy[0]
+            rt.join(r, from_replica=donor)
+            self._removed.discard(r)
+            self._note(step, "join", replica=r, donor=donor)
+        elif e.kind == "crash_restart":
+            from hermes_tpu.chaos import recovery
+
+            if not hasattr(rt, "fs"):
+                self._note(step, "skipped", event=e.kind,
+                           reason="phases runtime")
+                return
+            cands = ([e.replica] if e.replica >= 0 else
+                     [r for r in healthy if r not in self._frozen_since])
+            if len(healthy) <= self.spec.min_healthy or not cands:
+                return
+            r = self._pick(cands, e.u)
+            donor = e.donor if e.donor >= 0 else None
+            s = recovery.restart_replica(self.target, r, donor=donor,
+                                         snapshot_path=self.snapshot_path)
+            self.lost_ops += s["lost_ops"]
+            self.lost_client += s["lost_client_futures"]
+            self._frozen_since.pop(r, None)
+            self._removed.discard(r)
+            self._note(step, "crash_restart", replica=r, donor=s["donor"],
+                       source=s["source"], lost_ops=s["lost_ops"])
+        elif e.kind == "hb_skew":
+            svc = rt.membership
+            if svc is None:
+                self._note(step, "skipped", event=e.kind,
+                           reason="no membership service")
+                return
+            cands = [e.replica] if e.replica >= 0 else healthy
+            if not cands:
+                return
+            r = self._pick(cands, e.u)
+            svc.skew[r] = e.skew
+            self._skew_until[r] = e.until if e.until >= 0 else step + 8
+            rt._trace("hb_skew", replica=r, skew=e.skew,
+                      until=self._skew_until[r])
+            self._note(step, "hb_skew", replica=r, skew=e.skew,
+                       until=self._skew_until[r])
+        elif e.kind.startswith("net_"):
+            if self.net is None:
+                self._note(step, "skipped", event=e.kind,
+                           reason="no sim transport")
+                return
+            R = rt.cfg.n_replicas
+            src = e.replica if e.replica >= 0 else self._pick(range(R), e.u)
+            until = e.until if e.until >= 0 else step + self.spec.net_window
+            op = e.kind[len("net_"):]
+            self.net.add(op, src, e.dst, step, until, delta=e.skew)
+            rt._trace(e.kind, src=src, dst=e.dst, until=until)
+            self._note(step, e.kind, src=src, dst=e.dst, until=until)
+
+    def _expire_skews(self, step: int) -> None:
+        svc = self.rt.membership
+        for r, until in list(self._skew_until.items()):
+            if step >= until:
+                if svc is not None:
+                    svc.skew[r] = 0
+                del self._skew_until[r]
+
+    def _lease_rule(self, step: int) -> None:
+        """Detector-less removal: a replica frozen past the lease window is
+        ejected (the historical soak's stand-in for the membership
+        service).  A real MembershipService owns this when attached."""
+        if self.rt.membership is not None:
+            return
+        for r, since in list(self._frozen_since.items()):
+            if step - since > self.spec.lease_remove_after:
+                self.rt.remove(r)
+                self._removed.add(r)
+                del self._frozen_since[r]
+                self._note(step, "remove", replica=r, by="lease")
+
+    def _step_target(self) -> None:
+        if self.kvs is not None:
+            self.kvs.step()
+        else:
+            self.rt.step_once()
+
+    # -- the drive -----------------------------------------------------------
+
+    def run(self, steps: int, heal: bool = True, drain_steps: int = 4000,
+            check: bool = False) -> dict:
+        """Run ``steps`` rounds with the schedule applied, then (``heal``)
+        thaw/rejoin everything, clear skews and net windows, drain, and
+        optionally run the linearizability gate.  Returns the result dict:
+        executed event log, loss accounting, drained/verdict flags."""
+        ev = iter(self.schedule)
+        nxt = next(ev, None)
+        for step in range(steps):
+            self._expire_skews(step)
+            self._lease_rule(step)
+            while nxt is not None and nxt.step <= step:
+                self._apply(step, nxt)
+                nxt = next(ev, None)
+            self._step_target()
+            if self.on_step is not None:
+                self.on_step(step)
+        result: dict = dict(steps=steps, lost_ops=self.lost_ops,
+                            lost_client_futures=self.lost_client)
+        if heal:
+            rt = self.rt
+            if self.net is not None:
+                self.net.clear()
+            for r in list(self._skew_until):
+                if rt.membership is not None:
+                    rt.membership.skew[r] = 0
+            self._skew_until.clear()
+            for r in list(self._frozen_since):
+                rt.thaw(r)
+                self._note(steps, "thaw", replica=r, by="heal")
+            self._frozen_since.clear()
+            # the detector may have removed replicas on its own — rejoin
+            # every non-live replica, not just the runner's bookkeeping
+            # (skip loudly if no live donor exists rather than crash: an
+            # adversarial schedule can legally empty the healthy set)
+            for r in range(rt.cfg.n_replicas):
+                if not (int(rt.live[0]) >> r) & 1:
+                    donors = self._healthy()
+                    if not donors:
+                        self._note(steps, "skipped", event="join", replica=r,
+                                   reason="no live donor")
+                        continue
+                    rt.join(r, from_replica=donors[0])
+                    self._note(steps, "join", replica=r, donor=donors[0],
+                               by="heal")
+            self._removed.clear()
+            if self.kvs is not None:
+                # pipelined KVS: _pending (the deferred round) refills on
+                # every step, so quiescence is judged on client work only
+                # and the final flush lands the last deferred round
+                drained = True
+                for _ in range(drain_steps):
+                    if not (self.kvs._inflight or self.kvs._queued_slots
+                            or self.kvs._bat):
+                        break
+                    self.kvs.step()
+                else:
+                    drained = False
+                self.kvs.flush()
+                rt.flush_pipeline()
+            else:
+                drained = rt.drain(drain_steps)
+            result["drained"] = bool(drained)
+        if check:
+            v = self.rt.check()
+            result["checked_ok"] = bool(v.ok)
+            result["check_failures"] = [
+                getattr(f, "reason", str(f))[:200]
+                for f in (v.failures + v.undecided)[:3]]
+        result["events"] = self.log
+        return result
+
+    def log_json(self) -> str:
+        """Canonical executed-event log (the determinism witness: same
+        seed + config => byte-identical)."""
+        return json.dumps(self.log, sort_keys=True, separators=(",", ":"))

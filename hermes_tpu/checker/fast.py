@@ -127,26 +127,38 @@ class ArrayRecorder:
             )
         self._chunks.append(chunk)
 
+    def fold_pending(self, sess, replica: int = None) -> int:
+        """Fold in-flight updates (optionally one replica's row) in as
+        maybe_w rows (they may or may not have taken effect; the checker
+        lets them linearize optionally).  Called by ``finalize`` at end of
+        run and by ``chaos.recovery.restart_replica`` at crash time."""
+        status = np.asarray(sess.status)
+        op = np.asarray(sess.op)
+        sel = (status == t.S_INFL) & ((op == t.OP_WRITE) | (op == t.OP_RMW))
+        if replica is not None:
+            keep = np.zeros_like(sel)
+            keep[replica] = True
+            sel = sel & keep
+        if sel.any():
+            val = np.asarray(sess.val)[sel]
+            self._chunks.append(dict(
+                code=np.full(sel.sum(), -1, np.int32),  # -1 = maybe_w
+                key=np.asarray(sess.key)[sel].astype(np.int32),
+                wlo=val[:, 0].astype(np.int32), whi=val[:, 1].astype(np.int32),
+                rlo=np.zeros(sel.sum(), np.int32), rhi=np.zeros(sel.sum(), np.int32),
+                ver=np.asarray(sess.ver)[sel].astype(np.int64),
+                fc=np.asarray(sess.fc)[sel].astype(np.int64),
+                inv=np.asarray(sess.invoke_step)[sel].astype(np.int64),
+                cmt=np.full(sel.sum(), -1, np.int64),
+            ))
+        return int(sel.sum())
+
     def finalize(self, sess=None) -> "ArrayRecorder":
-        """Fold still-in-flight updates in as maybe_w rows (they may or may
-        not have taken effect; the checker lets them linearize optionally)."""
+        """Fold still-in-flight updates in as maybe_w rows (fold_pending);
+        idempotent — the end-of-run fold happens once."""
         if sess is not None and not self._finalized:
             self._finalized = True
-            status = np.asarray(sess.status)
-            op = np.asarray(sess.op)
-            sel = (status == t.S_INFL) & ((op == t.OP_WRITE) | (op == t.OP_RMW))
-            if sel.any():
-                val = np.asarray(sess.val)[sel]
-                self._chunks.append(dict(
-                    code=np.full(sel.sum(), -1, np.int32),  # -1 = maybe_w
-                    key=np.asarray(sess.key)[sel].astype(np.int32),
-                    wlo=val[:, 0].astype(np.int32), whi=val[:, 1].astype(np.int32),
-                    rlo=np.zeros(sel.sum(), np.int32), rhi=np.zeros(sel.sum(), np.int32),
-                    ver=np.asarray(sess.ver)[sel].astype(np.int64),
-                    fc=np.asarray(sess.fc)[sel].astype(np.int64),
-                    inv=np.asarray(sess.invoke_step)[sel].astype(np.int64),
-                    cmt=np.full(sel.sum(), -1, np.int64),
-                ))
+            self.fold_pending(sess)
         return self
 
     # -- packed views --------------------------------------------------------

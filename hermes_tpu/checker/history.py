@@ -96,29 +96,43 @@ class HistoryRecorder:
                 self.aborted_uids.add((int(wval[r, s, 0]), int(wval[r, s, 1])))
             # C_NOP: no effect on the register history
 
+    def fold_pending(self, sess, replica: int = None) -> int:
+        """Fold in-flight updates of ``sess`` (optionally one replica's row)
+        as ``maybe_w`` ops: an update still gathering acks may have been
+        applied at some replica and must be allowed — but not required — to
+        linearize.  ``finalize`` calls this once at end of run for the whole
+        cluster; ``chaos.recovery.restart_replica`` calls it at CRASH time
+        for the dying replica, whose in-flight broadcasts may still commit
+        via replay even though the client never hears back.  Returns the
+        number of ops folded."""
+        status = np.asarray(sess.status)
+        op = np.asarray(sess.op)
+        key = np.asarray(sess.key)
+        val = np.asarray(sess.val)
+        ver = np.asarray(sess.ver)
+        fc = np.asarray(sess.fc)
+        inv = np.asarray(sess.invoke_step)
+        rr, ss = np.nonzero(status == t.S_INFL)
+        n = 0
+        for r, s in zip(rr.tolist(), ss.tolist()):
+            if replica is not None and r != replica:
+                continue
+            if op[r, s] in (t.OP_WRITE, t.OP_RMW):
+                self.ops.append(
+                    Op("maybe_w", int(key[r, s]), 2.0 * inv[r, s], INF,
+                       wuid=(int(val[r, s, 0]), int(val[r, s, 1])),
+                       ts=(int(ver[r, s]), int(fc[r, s])),
+                       replica=r, session=s)
+                )
+                n += 1
+        return n
+
     def finalize(self, sess=None) -> List[Op]:
-        """Fold in incomplete updates from the final session state: an update
-        still in flight (or issued-but-unacked) may have been applied at some
-        replica and must be allowed — but not required — to linearize.
-        Idempotent: the pending-op fold-in happens once."""
+        """Fold in incomplete updates from the final session state
+        (``fold_pending``).  Idempotent: the fold-in happens once."""
         if sess is not None and not self._finalized:
             self._finalized = True
-            status = np.asarray(sess.status)
-            op = np.asarray(sess.op)
-            key = np.asarray(sess.key)
-            val = np.asarray(sess.val)
-            ver = np.asarray(sess.ver)
-            fc = np.asarray(sess.fc)
-            inv = np.asarray(sess.invoke_step)
-            rr, ss = np.nonzero(status == t.S_INFL)
-            for r, s in zip(rr.tolist(), ss.tolist()):
-                if op[r, s] in (t.OP_WRITE, t.OP_RMW):
-                    self.ops.append(
-                        Op("maybe_w", int(key[r, s]), 2.0 * inv[r, s], INF,
-                           wuid=(int(val[r, s, 0]), int(val[r, s, 1])),
-                           ts=(int(ver[r, s]), int(fc[r, s])),
-                           replica=r, session=s)
-                    )
+            self.fold_pending(sess)
         return self.ops
 
     def by_key(self) -> Dict[int, List[Op]]:

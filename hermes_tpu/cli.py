@@ -98,6 +98,23 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="R:FROM:TO",
                     help="failure injection: freeze replica R at step FROM, "
                     "thaw at step TO (repeatable; emits obs fault events)")
+    ap.add_argument("--detect", type=int, default=None, metavar="CONFIRM",
+                    help="attach the lease failure detector "
+                    "(membership.MembershipService) with the given confirm "
+                    "window in rounds (0 = remove at first suspicion); on "
+                    "the fast backends detection rides the completion "
+                    "harvest — zero dispatch-path device_gets")
+    ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                    help="drive a seeded chaos schedule (hermes_tpu.chaos: "
+                    "freeze/thaw/join/crash-restart/hb-skew) against the "
+                    "run; needs --steps and a fast backend; heals + drains "
+                    "at the end; events ride the obs timeline")
+    ap.add_argument("--chaos-schedule", type=str, default=None,
+                    metavar="FILE",
+                    help="declarative chaos schedule file ('@STEP KIND "
+                    "[replica] [k=v...]' lines, chaos.Schedule.parse) "
+                    "instead of a seeded one; needs --steps and a fast "
+                    "backend")
     ap.add_argument("--profile-out", type=str, default=None,
                     metavar="PROFILE_JSONL",
                     help="write the run config's round op census + cost-model"
@@ -150,6 +167,19 @@ def main(argv=None) -> int:
     if args.analyze and args.acceptance:
         ap.error("--analyze does not apply to acceptance runs (they build "
                  "their own configs); analyze a run config instead")
+    if args.chaos is not None and args.chaos_schedule:
+        ap.error("--chaos and --chaos-schedule are mutually exclusive")
+    chaos_on = args.chaos is not None or args.chaos_schedule
+    if chaos_on:
+        if args.backend not in ("fast", "fast-sharded"):
+            ap.error("--chaos/--chaos-schedule drive the fast runtimes "
+                     "(hermes_tpu.chaos); use --backend fast or "
+                     "fast-sharded")
+        if args.steps <= 0:
+            ap.error("--chaos needs a bounded run (--steps > 0)")
+        if args.freeze:
+            ap.error("--chaos and --freeze are mutually exclusive (put "
+                     "freeze windows in the schedule instead)")
 
     from hermes_tpu import stats as stats_lib
     from hermes_tpu.config import HermesConfig, WorkloadConfig
@@ -266,10 +296,40 @@ def main(argv=None) -> int:
         obs = rt.attach_obs(Observability(path=args.metrics_out,
                                           trace_steps=args.trace_steps))
 
+    if args.detect is not None:
+        from hermes_tpu.membership import MembershipService
+
+        rt.attach_membership(MembershipService(cfg, confirm_steps=args.detect))
+
     meta_of = lambda: rt.fs.meta if hasattr(rt, "fs") else rt.rs.meta
     t0 = time.perf_counter()
+    chaos_result = None
     try:
-        if args.steps > 0:
+        if chaos_on:
+            from hermes_tpu import chaos as chaos_lib
+
+            if args.chaos_schedule:
+                with open(args.chaos_schedule) as f:
+                    sched = chaos_lib.Schedule.parse(f.read())
+            else:
+                sched = chaos_lib.Schedule.random(cfg, args.chaos, args.steps)
+
+            def on_step(s):
+                if args.report_every and (s + 1) % args.report_every == 0:
+                    rec = stats_lib.summarize(
+                        meta_of(), time.perf_counter() - t0, s + 1)
+                    print(rec, file=sys.stderr)
+                    if logger:
+                        logger.log(rec)
+                    if obs:
+                        obs.interval(rec)
+
+            runner = chaos_lib.ChaosRunner(rt, sched, on_step=on_step)
+            chaos_result = runner.run(args.steps)
+            print(f"chaos: {len(runner.log)} event(s) applied, "
+                  f"lost_ops={chaos_result['lost_ops']}, "
+                  f"drained={chaos_result['drained']}", file=sys.stderr)
+        elif args.steps > 0:
             for s in range(args.steps):
                 while faults and faults[0][0] <= s:
                     _, r, action = faults.pop(0)

@@ -194,6 +194,15 @@ class HermesConfig:
     # round — see kvs.KVS.step.
     pipeline_depth: int = 1
 
+    # KVS stuck-op watchdog (round-9 chaos & recovery): a client op still
+    # pending after this many protocol rounds surfaces a ``stuck_op`` obs
+    # event and a per-session diagnostic (kvs.KVS.stuck_ops: coordinator,
+    # session, protocol phase, gathered-ack bitmap, age) instead of hanging
+    # silently — under faults an op CAN legitimately stall (its quorum is
+    # frozen), and a pipelined server must say so.  0 disables.  The
+    # opt-in strict mode (KVS(strict_timeouts=True)) raises StuckOpError.
+    op_timeout_rounds: int = 0
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or
@@ -229,6 +238,8 @@ class HermesConfig:
             )
         if not (0 <= self.rmw_retries <= (1 << 20)):
             raise ValueError("rmw_retries must be in [0, 2^20]")
+        if self.op_timeout_rounds < 0:
+            raise ValueError("op_timeout_rounds must be >= 0 (0 disables)")
         if not (1 <= self.pipeline_depth <= 64):
             raise ValueError(
                 "pipeline_depth must be in [1, 64] (each in-flight round "

@@ -411,6 +411,7 @@ def init_fast_state(cfg: HermesConfig, n_local: int | None = None) -> FastState:
     z = lambda *sh: jnp.zeros(sh, jnp.int32)
     meta = st.Meta(
         last_seen=z(r, cfg.n_replicas),
+        suspect_age=z(r, cfg.n_replicas),
         n_read=z(r),
         n_write=z(r),
         n_rmw=z(r),
@@ -657,6 +658,15 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     # sub-step (the value formula depends only on (cid, session, op_idx),
     # which still addresses the loaded update).
     k_vpts = _bank_to_i32(krow8[..., 4 * BANK_PTS: 4 * BANK_PTS + 4])[..., 0]
+    # Pre-committed detection (round-9; the fast-engine twin of
+    # phases.apply_inv's pre_committed): a pending update whose key row is
+    # VALID at its OWN packed ts was finished by a replayer while this
+    # coordinator was frozen/ack-starved — VALID at ts proves a full live
+    # quorum acked it, so _collect_acks completes it as COMMITTED and
+    # exempts it from the RMW nack (committed-then-superseded is a normal
+    # history, not an abort).  Reads the row gather the round already pays.
+    pre_comm = ((sess.status == t.S_INFL) & k_valid
+                & (k_vpts == sess.pts) & ~frozen)
     w_loaded = (sess.status == t.S_ISSUE) & (sess.invoke_step == step)
     new_wval = _i32_to_bank(_write_value(cfg, ctl.my_cid, sess.op_idx))
     if stream.uval is not None:
@@ -993,7 +1003,8 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     )
 
     fs = fs._replace(table=table, sess=sess, replay=replay, meta=meta)
-    return (fs, lanes, slot_lane, taken_lane, read_done, read_extra, sub_comps)
+    return (fs, lanes, slot_lane, taken_lane, read_done, read_extra, sub_comps,
+            pre_comm)
 
 
 def _compact_out_inv(ctl: FastCtl, lanes: "LaneBlock", slot_lane, taken_lane):
@@ -1270,7 +1281,7 @@ def _slot_to_lane_acks(cfg: HermesConfig, gained_slot, nacked_slot, slot_lane):
 
 def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
                   gained, nacked, taken_lane, read_done,
-                  read_extra, post_lane=None, replay_post=None):
+                  read_extra, pre_comm, post_lane=None, replay_post=None):
     """Coordinator-side ``poll_acks()`` + commit + VAL build
     (BASELINE.json:5).  ``gained``/``nacked`` are per-LANE (R, L): derived
     directly there in batched mode (_derived_acks), routed back from the
@@ -1296,7 +1307,12 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # nacked ts is globally dead, so nothing leaks between attempts); only
     # the final failure aborts.  Plain writes ignore nacks and commit by ts
     # order, as always.
-    nack_rmw = infl & nacked[:, :S] & (sess.op == t.OP_RMW) & ~frozen
+    # pre_comm (from _coordinate's row gather): this update was already
+    # finished by a replayer — complete it as committed below and keep the
+    # nack path away from it (a late nack after the key moved on must not
+    # turn an observed commit into an abort).
+    nack_rmw = (infl & nacked[:, :S] & (sess.op == t.OP_RMW) & ~frozen
+                & ~pre_comm)
     if cfg.rmw_retries > 0:
         retry = nack_rmw & (sess.retries < cfg.rmw_retries)
         abort = nack_rmw & ~retry
@@ -1308,8 +1324,10 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     # lane whose quorum is completed by a membership change (live_mask
     # shrink) while it is in rebroadcast backoff simply commits at its next
     # broadcast round instead — acks persist in the bitmap, so nothing is
-    # lost, and the VAL is never silently dropped.
-    commit = infl & covered & taken_lane[:, :S] & ~frozen & ~nack_rmw
+    # lost, and the VAL is never silently dropped.  (pre_comm lanes need no
+    # broadcast: their VAL already happened — the replayer's.)
+    commit = ((infl & covered & taken_lane[:, :S] & ~frozen & ~nack_rmw)
+              | pre_comm)
 
     # Replay-slot release: a slot whose key's shared arbiter moved past the
     # slot's ts was taken over by a newer write — that writer's VAL will
@@ -1324,9 +1342,23 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
 
     racks = jnp.where(replay.active, replay.acks | gained[:, S:], replay.acks)
     rcovered = ((racks | ~live) & full) == full
-    rcommit = replay.active & rcovered & taken_lane[:, S:] & ~frozen
+    # A NACKED replay must never commit (round-9; surfaced by the chaos
+    # net-drop schedules): the nack proves a strictly-higher ts exists at
+    # some live replica, so the replayed value — possibly an ABORTED RMW's,
+    # stranded as this shard's stale table max after it missed the winner's
+    # INV — is obsolete.  Releasing the slot is safe for liveness: the
+    # higher ts cannot have committed without THIS replica's ack (the
+    # quorum covers every live replica), so its coordinator/replayer keeps
+    # re-broadcasting until it lands here and re-validates the key; if the
+    # key sticks, the replay scan re-detects it and the next replay carries
+    # the by-then-current row.  (Batched lockstep shares one table, so
+    # rnack ⊆ rsuper there — this changes only diverged-table cases.)
+    rnack = replay.active & nacked[:, S:] & ~frozen
+    rcommit = (replay.active & rcovered & taken_lane[:, S:] & ~frozen
+               & ~nacked[:, S:])
     rsuper = replay.active & ~rowns & ~frozen
-    replay = replay._replace(acks=racks, active=replay.active & ~rcommit & ~rsuper)
+    replay = replay._replace(
+        acks=racks, active=replay.active & ~rcommit & ~rsuper & ~rnack)
 
     # --- outbound VALs ride the round's INV slots -------------------------
     # Lockstep invariant: a lane can only commit in a round it broadcast in
@@ -1366,6 +1398,16 @@ def _collect_acks(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
         # dense per-round max that the host checks at counter polls —
         # detection instead of silent compare corruption past the limit
         max_pts=jnp.maximum(meta.max_pts, jnp.max(sess.pts, axis=1)),
+        # async failure detection (round-9): fold the staleness reduction
+        # into the round — per-peer heartbeat age off this round's own
+        # last_seen, clipped non-negative (a replica's row may carry
+        # last_seen == step for peers heard this round).  Dense
+        # elementwise over an (R_local, R) tile: XLA fuses it into the
+        # round, no new sparse ops or collectives.  The host detector
+        # harvests it WITH completions (FastRuntime.dispatch_round keeps
+        # the device handle in the ring), so an attached MembershipService
+        # never issues a synchronous device_get on the dispatch path.
+        suspect_age=jnp.maximum(step - meta.last_seen, 0),
     )
     if cfg.phase_metrics:
         # ACK quorum-wait (issue -> commit, in rounds) + nack/retry
@@ -1423,14 +1465,15 @@ def fast_round_batched(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     (_apply_commit_lanes) happens once with the final state — the separate
     VAL phase does not exist here."""
     (fs, lanes, slot_lane, taken_lane, read_done,
-     read_extra, sub_comps) = _coordinate(cfg, ctl, fs, stream)
+     read_extra, sub_comps, pre_comm) = _coordinate(cfg, ctl, fs, stream)
     fs = _apply_inv_lanes(cfg, ctl, fs, lanes, taken_lane)
     gained, nacked, win_lane, post_lane = _derived_acks(
         ctl, fs.table, taken_lane, lanes.key, lanes.pts
     )
     fs, commit_lane, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
                                           taken_lane, read_done,
-                                          read_extra, post_lane=post_lane)
+                                          read_extra, pre_comm,
+                                          post_lane=post_lane)
     fs = _apply_commit_lanes(cfg, ctl, fs, lanes, win_lane, commit_lane)
     if sub_comps:
         comp = tuple(sub_comps) + (comp,)
@@ -1442,7 +1485,7 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     INV and VAL blocks ride ``all_gather`` and the ACK verdicts ride
     ``all_to_all`` over the 'replica' ICI axis."""
     (fs, lanes, slot_lane, taken_lane, read_done,
-     read_extra, sub_comps) = _coordinate(cfg, ctl, fs, stream)
+     read_extra, sub_comps, pre_comm) = _coordinate(cfg, ctl, fs, stream)
     out_inv = _compact_out_inv(ctl, lanes, slot_lane, taken_lane)
     inv_src = jax.tree.map(_ici_gather_src, out_inv)
     fs, ack_flags, win0, replay_post = _apply_inv(cfg, ctl, fs, inv_src,
@@ -1453,7 +1496,8 @@ def fast_round_sharded(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     gained, nacked = _slot_to_lane_acks(cfg, gained_slot, nacked_slot, slot_lane)
     fs, commit_lane, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
                                           taken_lane, read_done,
-                                          read_extra, replay_post=replay_post)
+                                          read_extra, pre_comm,
+                                          replay_post=replay_post)
     # VAL phase: a bare per-slot commit-bit tensor over THIS round's INV
     # slots — receivers reconstruct (key, ts) from the INV block they hold,
     # and the epoch check rides the INV meta word gathered above (one

@@ -257,6 +257,28 @@ def apply_inv(
     K, S = cfg.n_keys, cfg.n_sessions
     R, L = in_inv.valid.shape
 
+    # PRE-apply commit detection (round-9; surfaced by the chaos net-drop
+    # schedules): a pending update whose key is ALREADY VALID at its own ts
+    # was finished by a replayer (VALID at ts => a full live quorum acked
+    # it — any replica can complete a write whose coordinator looks dead,
+    # SURVEY.md §3.4) while this coordinator's acks were lost.  It must
+    # complete as COMMITTED — and must NOT be aborted below when a newer
+    # INV in this very block supersedes the key (committed-then-superseded
+    # is a normal history; superseded-before-commit is the abort case).
+    # Evaluated against the PRE-apply table: the VAL that validated the key
+    # landed at the end of an earlier step, strictly before any superseding
+    # INV processed here.  Residual limit, as in the real protocol: if that
+    # VAL itself was lost, a late nack is indistinguishable from a genuine
+    # pre-commit conflict — the membership remove/rejoin (crash semantics)
+    # owns that case.
+    pre_infl = sess.status == t.S_INFL
+    pre_committed = (
+        pre_infl
+        & (table.state[sess.key] == t.VALID)
+        & ts_eq(sess.ver, sess.fc, table.ver[sess.key], table.fc[sess.key])
+        & ~ctl.frozen
+    )
+
     ok = in_inv.valid & (in_inv.epoch == ctl.epoch) & ~ctl.frozen
     key = in_inv.key.reshape(-1)
     ver = in_inv.ver.reshape(-1)
@@ -286,16 +308,33 @@ def apply_inv(
     # --- supersession of local pending updates ----------------------------
     infl = sess.status == t.S_INFL
     moved = infl & ~ts_eq(sess.ver, sess.fc, table.ver[sess.key], table.fc[sess.key]) & ~ctl.frozen
-    abort = moved & (sess.op == t.OP_RMW)
+    abort = moved & (sess.op == t.OP_RMW) & ~pre_committed
+    is_rmw = sess.op == t.OP_RMW
+    done = abort | pre_committed
     sess = sess._replace(
-        superseded=sess.superseded | (moved & (sess.op == t.OP_WRITE)),
-        status=jnp.where(abort, t.S_IDLE, sess.status),
-        op_idx=jnp.where(abort, sess.op_idx + 1, sess.op_idx),
+        superseded=sess.superseded | (moved & (sess.op == t.OP_WRITE) & ~pre_committed),
+        status=jnp.where(done, t.S_IDLE, sess.status),
+        op_idx=jnp.where(done, sess.op_idx + 1, sess.op_idx),
     )
-    meta = meta._replace(n_abort=meta.n_abort + jnp.sum(abort, dtype=jnp.int32))
+    lat = jnp.where(pre_committed, ctl.step - sess.invoke_step, 0)
+    nbin = st.LAT_BINS
+    meta = meta._replace(
+        n_abort=meta.n_abort + jnp.sum(abort, dtype=jnp.int32),
+        n_write=meta.n_write + jnp.sum(pre_committed & ~is_rmw, dtype=jnp.int32),
+        n_rmw=meta.n_rmw + jnp.sum(pre_committed & is_rmw, dtype=jnp.int32),
+        lat_sum=meta.lat_sum + jnp.sum(lat, dtype=jnp.int32),
+        lat_cnt=meta.lat_cnt + jnp.sum(pre_committed, dtype=jnp.int32),
+        lat_hist=meta.lat_hist.at[
+            jnp.where(pre_committed, jnp.clip(lat, 0, nbin - 1), nbin)
+        ].add(1, mode="drop"),
+    )
 
     comp = st.Completions(
-        code=jnp.where(abort, t.C_RMW_ABORT, t.C_NONE).astype(jnp.int32),
+        code=jnp.where(
+            abort, t.C_RMW_ABORT,
+            jnp.where(pre_committed,
+                      jnp.where(is_rmw, t.C_RMW, t.C_WRITE), t.C_NONE),
+        ).astype(jnp.int32),
         key=sess.key,
         wval=sess.val,
         rval=sess.rd_val,
@@ -390,6 +429,10 @@ def collect_acks(
     # Conflict-nack: any matching ack with ok=False means some replica holds
     # a higher ts for this key — a pending RMW aborts (before it could
     # commit; nacks and full coverage in the same step resolve to abort).
+    # (A replay-committed update never reaches this test: apply_inv
+    # completes it as committed the step after its VAL lands — the
+    # pre_committed path — so a late nack cannot turn an observed commit
+    # into an abort.)
     nacked = jnp.any(sess_ack & ~in_ack.ok[:, :S], axis=0)
     abort = infl & nacked & (sess.op == t.OP_RMW) & ~ctl.frozen
     commit = infl & covered & ~ctl.frozen & ~abort
@@ -408,15 +451,27 @@ def collect_acks(
     )
     rcovered = ((racks | ~ctl.live_mask) & full) == full
     rowns = ts_eq(replay.ver, replay.fc, table.ver[replay.key], table.fc[replay.key])
-    rcommit = replay.active & rcovered & ~ctl.frozen
+    # A NACKED replay must never commit (round-9; surfaced by the chaos
+    # net-drop schedules): ok=False on a matching replay ack proves a
+    # strictly-higher ts exists at a live replica, so the replayed value —
+    # possibly an ABORTED RMW's, stranded as this replica's stale table max
+    # behind a sustained one-way drop — is obsolete.  Releasing without
+    # committing is live: the higher ts cannot have committed without THIS
+    # replica's ack, so its coordinator keeps re-broadcasting until it
+    # lands here and re-validates the key (and a still-stuck key is
+    # re-detected by the next replay scan with the by-then-current row).
+    rnacked = jnp.any(rep_ack & ~in_ack.ok[:, S:], axis=0)
+    rcommit = replay.active & rcovered & ~ctl.frozen & ~rnacked
     rsuperseded = replay.active & ~rowns & ~ctl.frozen
+    rreleased = replay.active & rnacked & ~ctl.frozen
     table = table._replace(
         state=_set(
             table.state, replay.key, jnp.full((RS,), t.VALID, jnp.int32), rcommit & rowns
         )
     )
     replay = replay._replace(
-        acks=racks, active=replay.active & ~rcommit & ~rsuperseded
+        acks=racks,
+        active=replay.active & ~rcommit & ~rsuperseded & ~rreleased,
     )
 
     # --- outbound VALs -----------------------------------------------------

@@ -190,6 +190,13 @@ class Meta(NamedTuple):
     commit-latency histogram (steps, clipped to the last bin).
 
     ``last_seen`` (R,) last step a valid heartbeat arrived from each peer
+    ``suspect_age`` (R,) per-peer heartbeat staleness in rounds, derived ON
+        DEVICE from ``last_seen`` at the end of every round (round-9 async
+        failure detection): the host suspicion state machine
+        (membership.MembershipService) consumes it off the completion
+        harvest instead of issuing a synchronous ``last_seen`` fetch on the
+        dispatch path.  The phases engine leaves it 0 (its MembershipService
+        polls ``last_seen`` directly — the documented fallback).
     ``n_read`` / ``n_write`` / ``n_rmw`` / ``n_abort`` () completed-op counts
     ``lat_sum`` / ``lat_cnt`` () commit-latency accumulator (update ops)
     ``lat_hist`` (LAT_BINS,) latency histogram
@@ -218,6 +225,7 @@ class Meta(NamedTuple):
     """
 
     last_seen: jnp.ndarray
+    suspect_age: jnp.ndarray
     n_read: jnp.ndarray
     n_write: jnp.ndarray
     n_rmw: jnp.ndarray
@@ -301,6 +309,7 @@ def init_meta(cfg: config_lib.HermesConfig) -> Meta:
     z = jnp.zeros((), jnp.int32)
     return Meta(
         last_seen=jnp.zeros((cfg.n_replicas,), jnp.int32),
+        suspect_age=jnp.zeros((cfg.n_replicas,), jnp.int32),
         n_read=z,
         n_write=z,
         n_rmw=z,

@@ -46,11 +46,33 @@ from hermes_tpu.core import types as t
 from hermes_tpu.runtime import FastRuntime
 
 
+# client-level completion code for ops LOST to a replica crash
+# (chaos.recovery.restart_replica): the server died holding the op; the
+# client is told loudly instead of waiting forever.  Negative on purpose —
+# it can never collide with the device C_* codes (types.py, all >= 0).
+C_LOST = -2
+
+
+class StuckOpError(RuntimeError):
+    """Strict-mode stuck-op watchdog verdict (cfg.op_timeout_rounds): at
+    least one client op out-aged the timeout; ``diagnostics`` carries the
+    per-session evidence (coordinator, session, phase, age)."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = diagnostics
+        super().__init__(
+            f"{len(diagnostics)} client op(s) stuck past op_timeout_rounds: "
+            + "; ".join(
+                f"r{d['replica']}/s{d['session']} {d['kind']} key={d['key']} "
+                f"phase={d['phase']} age={d['age_rounds']}"
+                for d in diagnostics[:4]))
+
+
 @dataclasses.dataclass
 class Completion:
     """Result of one client op."""
 
-    kind: str  # 'get' | 'put' | 'rmw' | 'rmw_abort'
+    kind: str  # 'get' | 'put' | 'rmw' | 'rmw_abort' | 'lost' (replica crash)
     key: int
     value: Optional[List[int]] = None  # payload read (get / rmw read-part)
     uid: Optional[Tuple[int, int]] = None  # unique id of the written value
@@ -118,6 +140,9 @@ class BatchFutures:
     def completion(self, i: int) -> Completion:
         assert self.code[i] != 0, "op not complete; run KVS.run_batch()"
         c = int(self.code[i])
+        if c == C_LOST:
+            return Completion(kind="lost", key=int(self.key[i]),
+                              step=int(self.step[i]), found=False)
         kind = ("rmw_abort" if c == t.C_RMW_ABORT
                 else self._KINDSTR[int(self.kind[i])])
         done = Completion(kind=kind, key=int(self.key[i]),
@@ -144,7 +169,8 @@ class KVS:
     """
 
     def __init__(self, cfg: HermesConfig, backend: str = "batched", mesh=None,
-                 record: bool = False, sparse_keys: bool = False):
+                 record: bool = False, sparse_keys: bool = False,
+                 strict_timeouts: bool = False):
         if cfg.value_words < 3:
             raise ValueError("KVS needs value_words >= 3 (2 uid words + payload)")
         if cfg.read_unroll != 1:
@@ -205,6 +231,14 @@ class KVS:
         self._next_bid = 0
         self._slot_bid = np.full((r, s), -1, np.int32)
         self._slot_bix = np.zeros((r, s), np.int32)
+        # stuck-op watchdog (round-9, cfg.op_timeout_rounds): the round
+        # each slot's current op was injected (-1 = idle), the per-session
+        # diagnostics surfaced so far, and a once-per-op flag set so a
+        # stuck op reports exactly once instead of every round
+        self._slot_inject = np.full((r, s), -1, np.int64)
+        self._stuck_flagged: set = set()
+        self.stuck_ops: List[dict] = []
+        self.strict_timeouts = strict_timeouts
         # sparse-key mode (SURVEY.md §1 L2, MICA-index parity): arbitrary
         # 64-bit client keys map to dense device slots through an exact
         # open-addressing index (hermes_tpu/keyindex.py); completions
@@ -375,6 +409,7 @@ class KVS:
             self._kindarr[rr, cc] = b["opc"][sl]
             self._slot_bid[rr, cc] = bid
             self._slot_bix[rr, cc] = b["gix"][sl]
+            self._slot_inject[rr, cc] = self.rt.step_idx
             b["cursor"] = cur + take
             p += take
             self._dirty = True
@@ -408,6 +443,7 @@ class KVS:
                 self._uval[r, s, 0] = value
             self._inflight[rs_key] = (kind, fut, client_key)
             self._kindarr[r, s] = self._OPC[kind]
+            self._slot_inject[r, s] = self.rt.step_idx
             self._dirty = True
         self._ready.clear()
         self._ready |= waiting
@@ -446,6 +482,7 @@ class KVS:
         if rows.size:
             self._op[rows, cols, 0] = t.OP_NOP
             self._kindarr[rows, cols] = t.OP_NOP
+            self._slot_inject[rows, cols] = -1
             self._dirty = True
 
     def _resolve(self, done_mask, code, rval, wval, round_idx: int) -> int:
@@ -497,6 +534,68 @@ class KVS:
             ndone += 1
         return ndone
 
+    # -- stuck-op watchdog (round-9, cfg.op_timeout_rounds) ------------------
+
+    _PHASE = {t.S_IDLE: "idle", t.S_READ: "read-stall", t.S_ISSUE: "issue",
+              t.S_INFL: "ack-wait", t.S_DONE: "done"}
+
+    def _watchdog(self) -> None:
+        """Surface client ops pending past ``cfg.op_timeout_rounds``: one
+        ``stuck_op`` obs event + one ``self.stuck_ops`` diagnostic per op
+        (coordinator replica, session, protocol phase, gathered-ack bitmap,
+        age in rounds) the first time it out-ages the budget — instead of
+        hanging silently when its quorum is frozen/partitioned away.  The
+        per-session device inspection runs only when a NEW stuck op exists
+        (the steady-state cost is one numpy compare).  Strict mode
+        (``strict_timeouts``) raises StuckOpError after reporting."""
+        tmo = self.cfg.op_timeout_rounds
+        if not tmo:
+            return
+        active = self._slot_inject >= 0
+        if not active.any():
+            return
+        age = self.rt.step_idx - self._slot_inject
+        stuck = active & (age > tmo)
+        fresh = []
+        for r, s in zip(*np.nonzero(stuck)):
+            tag = (int(r), int(s), int(self._slot_inject[r, s]))
+            if tag not in self._stuck_flagged:
+                self._stuck_flagged.add(tag)
+                fresh.append((int(r), int(s)))
+        if not fresh:
+            return
+        sess = self.rt.fs.sess
+        status = np.asarray(jax.device_get(sess.status))
+        acks = np.asarray(jax.device_get(sess.acks))
+        new_diags = []
+        for r, s in fresh:
+            # report the CLIENT's key: in sparse-key mode the staged
+            # stream holds the dense device slot, which the client never
+            # saw — the per-op inflight entry / batch columns carry the
+            # submitted key
+            if (r, s) in self._inflight:
+                ckey = self._inflight[(r, s)][2]
+            elif self._slot_bid[r, s] >= 0:
+                b = self._bat.get(int(self._slot_bid[r, s]))
+                ckey = (int(b["bf"].key[int(self._slot_bix[r, s])])
+                        if b is not None else int(self._key[r, s, 0]))
+            else:
+                ckey = int(self._key[r, s, 0])
+            diag = dict(
+                replica=r, session=s,
+                key=int(ckey),
+                kind=BatchFutures._KINDSTR.get(int(self._kindarr[r, s]), "?"),
+                phase=self._PHASE.get(int(status[r, s]), "?"),
+                acks=int(acks[r, s]),
+                age_rounds=int(age[r, s]),
+                at_step=self.rt.step_idx,
+            )
+            new_diags.append(diag)
+            self.stuck_ops.append(diag)
+            self.rt._trace("stuck_op", **diag)
+        if self.strict_timeouts and new_diags:
+            raise StuckOpError(new_diags)
+
     def step(self) -> int:
         """Inject queued ops, run one protocol round, resolve completions.
         Returns the number of ops completed (with ``cfg.pipeline_depth >=
@@ -506,14 +605,18 @@ class KVS:
         if self._bat:
             self._inject_batches()
         if self._depth > 1:
-            return self._step_pipelined()
+            n = self._step_pipelined()
+            self._watchdog()
+            return n
         self._sync_stream()
         comp = self.rt.step_once()
         code = np.asarray(comp.code)
         done_mask = self._done_mask(code, np.asarray(comp.key))
         self._retire(done_mask)
-        return self._resolve(done_mask, code, np.asarray(comp.rval),
-                             np.asarray(comp.wval), self.rt.step_idx - 1)
+        n = self._resolve(done_mask, code, np.asarray(comp.rval),
+                          np.asarray(comp.wval), self.rt.step_idx - 1)
+        self._watchdog()
+        return n
 
     def _step_pipelined(self) -> int:
         """Round-8 overlapped serving: dispatch round k from the staged
@@ -568,6 +671,42 @@ class KVS:
             self.step()
         self.flush()  # pipelined: the last round's resolution may be deferred
         return all(f.done() for f in futures)
+
+    # -- crash support (chaos.recovery.restart_replica) ----------------------
+
+    def _on_replica_crash(self, replica: int) -> int:
+        """Client-side fallout of a full host-crash of ``replica``: its
+        in-flight futures resolve loudly as kind='lost' (batch slots get
+        C_LOST) — the server died holding them; whether the write took
+        effect is decided by replay, and the history records it as a
+        maybe_w.  Queued-but-uninjected traffic survives (it lives in the
+        client library) and re-injects after the rejoin.  Returns the
+        number of client ops lost."""
+        lost = 0
+        for rs_key in [k for k in self._inflight if k[0] == replica]:
+            _kind, fut, client_key = self._inflight.pop(rs_key)
+            fut._result = Completion(kind="lost", key=client_key, found=False)
+            lost += 1
+        for s in np.nonzero(self._slot_bid[replica] >= 0)[0]:
+            bid = int(self._slot_bid[replica, s])
+            b = self._bat.get(bid)
+            if b is not None:
+                bf: BatchFutures = b["bf"]
+                gi = int(self._slot_bix[replica, s])
+                bf.code[gi] = C_LOST
+                bf.found[gi] = False
+                if b["cursor"] >= b["opc"].shape[0] and bf.all_done():
+                    del self._bat[bid]
+            lost += 1
+        self._slot_bid[replica] = -1
+        self._op[replica] = t.OP_NOP
+        self._kindarr[replica] = t.OP_NOP
+        self._slot_inject[replica] = -1
+        self._dirty = True
+        for rs_key in self._queued_slots:
+            if rs_key[0] == replica:
+                self._ready.add(rs_key)
+        return lost
 
     # -- membership / failure passthrough ------------------------------------
 

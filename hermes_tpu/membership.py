@@ -10,18 +10,40 @@ makes no transitions at all, core/state.Ctl).
 The rebuild keeps the service on the host, exactly where the reference
 keeps it (outside the data plane).  Detection input is in-band: every INV
 block carries an ``alive`` heartbeat bit; each replica records
-``meta.last_seen[peer]`` (core/phases.apply_inv) and the service reads
-those clocks off the device every ``poll_interval`` steps.
+``meta.last_seen[peer]`` (core/phases.apply_inv / faststep._apply_inv) and
+the fast round additionally folds the staleness reduction into the round
+itself (``Meta.suspect_age`` — per-peer heartbeat age, round-9).
 
-Suspicion rule: replica r is suspected when NO live peer has heard from it
-for more than ``lease_steps`` steps.  Using the max over live observers
-keeps one partitioned observer from ejecting a healthy replica.
+Suspicion is a STATE MACHINE with hysteresis (round-9, Chandra–Toueg-style
+unreliable detector): replica r enters ``suspect`` when NO live unfrozen
+peer has heard from it for more than ``lease_steps`` rounds (max over live
+observers, so one partitioned observer cannot eject a healthy replica);
+it must STAY stale for ``confirm_steps`` further rounds before the
+``remove`` fires; a fresh heartbeat inside the confirm window cancels the
+suspicion (``suspect_clear`` on the obs timeline — spontaneous recovery).
+``confirm_steps=0`` (default) removes at first suspicion, the pre-round-9
+behavior.  ``skew[r]`` biases the observed age of replica r (heartbeat
+clock-skew injection — chaos.schedule drives it to exercise the
+hysteresis without real faults).
+
+Detector input transport — the pipelining caveat: ``poll`` consumes the
+runtime's HARVESTED age columns (``rt.harvested_ages``, fed by
+``FastRuntime.harvest_comp`` off the completion readback that is already
+overlapped with device execution) whenever they are fresh, so on the fast
+runtimes an attached service costs the dispatch path NOTHING — zero
+synchronous ``device_get`` (the ``membership_fetch`` trace event counts
+the fallback fetches; a pipelined run must show none).  Ages observed this
+way are up to ``pipeline_depth - 1`` rounds stale — detection latency
+grows by at most the ring depth, never the dispatch.  On the phases
+``Runtime`` (sim/tcp engines) there is no harvest ring: every poll is a
+synchronous ``(R, R)`` ``last_seen`` fetch, so raise ``poll_interval``
+there if the fetch shows up in profiles.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
@@ -32,30 +54,77 @@ from hermes_tpu.config import HermesConfig
 @dataclasses.dataclass
 class MembershipEvent:
     step: int
-    kind: str  # 'remove' | 'join'
+    kind: str  # 'remove' | 'join' (suspect/suspect_clear are timeline-only)
     replica: int
     live_mask: int
 
 
 class MembershipService:
-    """Polls heartbeat clocks and drives remove (and scripted join) through
-    a Runtime.  Attach with ``Runtime.attach_membership`` or call ``poll``
-    manually between steps."""
+    """Polls heartbeat ages and drives the suspect → confirm → remove
+    machine (and scripted join bookkeeping) through a Runtime.  Attach with
+    ``Runtime.attach_membership`` or call ``poll`` manually between steps."""
 
-    def __init__(self, cfg: HermesConfig, poll_interval: int = 1):
+    def __init__(self, cfg: HermesConfig, poll_interval: int = 1,
+                 confirm_steps: int = 0):
+        if confirm_steps < 0:
+            raise ValueError("confirm_steps must be >= 0")
         self.cfg = cfg
         self.poll_interval = poll_interval
+        self.confirm_steps = confirm_steps
         self.events: List[MembershipEvent] = []
+        # replica -> step the current suspicion began (cleared on recovery)
+        self.suspects: Dict[int, int] = {}
+        # replica -> step it (re)joined: ages observed at-or-shortly-after
+        # a join were computed from pre-join rounds (the harvest lags the
+        # dispatch by the ring depth) where the replica was legitimately
+        # dead — a full lease window of POST-join observation must elapse
+        # before those ages can ground a new suspicion, or every rejoin
+        # would be instantly re-ejected (with confirm_steps=0) or burn a
+        # spurious suspect/clear pair (with a window)
+        self._joined_at: Dict[int, int] = {}
+        # injected heartbeat clock-skew, added to every observed age of the
+        # replica (chaos.schedule's hb_skew events)
+        self.skew = np.zeros(cfg.n_replicas, np.int64)
+
+    # -- detector input ------------------------------------------------------
+
+    def _ages(self, rt):
+        """(at_step, (R_obs, R_src) age matrix).  Prefers the runtime's
+        harvested device-side ``suspect_age`` columns (no fetch); falls back
+        to a synchronous ``last_seen`` fetch — counted on the obs timeline
+        as ``membership_fetch`` so pipelined runs can regression-test that
+        the dispatch path stays fetch-free."""
+        cached = getattr(rt, "harvested_ages", None)
+        if cached is not None:
+            at_step, ages = cached
+            # fresh = observed within one poll interval + the ring depth of
+            # the current step (older than that means harvesting stopped —
+            # e.g. fetch_completions was flipped off — so fetch)
+            depth = getattr(rt.cfg, "pipeline_depth", 1)
+            if rt.step_idx - at_step <= self.poll_interval + depth:
+                return at_step, ages
+        state = getattr(rt, "fs", None) or rt.rs  # FastRuntime | Runtime
+        trace = getattr(rt, "_trace", None)
+        if trace is not None:
+            trace("membership_fetch")
+        last_seen = np.asarray(jax.device_get(state.meta.last_seen))
+        return rt.step_idx, np.maximum(rt.step_idx - last_seen, 0)
+
+    # -- the suspicion state machine ----------------------------------------
 
     def poll(self, rt) -> Optional[MembershipEvent]:
         if rt.step_idx % self.poll_interval != 0:
             return None
+        at_step, ages = self._ages(rt)
+        return self._drive(rt, at_step, ages)
+
+    def _drive(self, rt, step: int, ages) -> Optional[MembershipEvent]:
         live = int(rt.live[0])
-        state = getattr(rt, "fs", None) or rt.rs  # FastRuntime | Runtime
-        last_seen = np.asarray(jax.device_get(state.meta.last_seen))  # (R_obs, R_src)
+        trace = getattr(rt, "_trace", None)
         evt = None
         for r in range(self.cfg.n_replicas):
             if not (live >> r) & 1:
+                self.suspects.pop(r, None)
                 continue
             observers = [
                 i
@@ -64,15 +133,34 @@ class MembershipService:
             ]
             if not observers:
                 continue
-            freshest = max(int(last_seen[i, r]) for i in observers)
-            if rt.step_idx - freshest > self.cfg.lease_steps:
+            ja = self._joined_at.get(r)
+            if ja is not None and step - ja <= self.cfg.lease_steps:
+                # join grace: these ages predate (or barely postdate) the
+                # rejoin — no post-join lease window has been observed yet
+                continue
+            # freshest observation of r = max last_seen over observers
+            # = MIN age over observers
+            age = int(min(int(ages[i, r]) for i in observers))
+            age += int(self.skew[r])
+            if age <= self.cfg.lease_steps:
+                if self.suspects.pop(r, None) is not None:
+                    # spontaneous recovery inside the confirm window: the
+                    # suspicion cancels instead of ejecting a healthy
+                    # replica.  Timeline-only (self.events stays the
+                    # remove/join membership log callers consume).
+                    if trace is not None:
+                        trace("suspect_clear", replica=r, stale_steps=age)
+                continue
+            since = self.suspects.get(r)
+            if since is None:
+                self.suspects[r] = since = step
                 # suspect precedes remove on the obs timeline: the remove
                 # event records the membership outcome, this one records the
                 # detector's evidence (how stale the freshest observation was)
-                trace = getattr(rt, "_trace", None)
                 if trace is not None:
-                    trace("suspect", replica=r,
-                          stale_steps=rt.step_idx - freshest)
+                    trace("suspect", replica=r, stale_steps=age)
+            if step - since >= self.confirm_steps:
+                del self.suspects[r]
                 rt.remove(r)
                 live = int(rt.live[0])
                 evt = MembershipEvent(rt.step_idx, "remove", r, live)
@@ -80,6 +168,8 @@ class MembershipService:
         return evt
 
     def note_join(self, rt, replica: int) -> None:
+        self.suspects.pop(replica, None)
+        self._joined_at[replica] = rt.step_idx
         self.events.append(
             MembershipEvent(rt.step_idx, "join", replica, int(rt.live[0]))
         )

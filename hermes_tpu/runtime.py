@@ -350,6 +350,18 @@ class FastRuntime(_ObsHooks):
         # installs a flush hook here so rebase/drain boundaries can force
         # every in-flight completion out before re-anchoring versions
         self.comp_flush = None
+        # async failure detection (round-9): per-round device-side COPIES of
+        # Meta.suspect_age ride this FIFO next to the completion ring, and
+        # the last harvested (round, ages) feeds the membership service —
+        # detection input rides the completion harvest, never a
+        # dispatch-path device_get.  Copies, not views: the donated state
+        # tree a round's ages live in dies at the NEXT dispatch, and a
+        # fetch must only ever touch a round the harvest already proved
+        # complete (fetching the freshest in-flight round's handle would
+        # stall the host on the executing round and re-serialize the
+        # pipeline — the regression this subsystem exists to avoid).
+        self._age_ring: collections.deque = collections.deque()
+        self.harvested_ages = None
         # version-rebase state (round-4, rebase_versions): host quiesce
         # flag (traced into FastCtl — flipping it never recompiles),
         # cumulative per-key version deltas for recorder continuity, and
@@ -546,10 +558,22 @@ class FastRuntime(_ObsHooks):
             assert self.recorder is None, "history recording is single-host only"
             return None
         if self.membership is not None:
-            # NB: the lease poll reads device clocks, so an attached
-            # membership service makes every dispatch synchronous — raise
-            # its poll_interval to keep the pipeline overlapped
-            self.membership.poll(self)
+            if self.fetch_completions or self.recorder is not None:
+                # async detection (round-9): enqueue a device-side COPY of
+                # this round's suspect_age columns (a few KB; the copy op
+                # dispatches async and survives the donation of the state
+                # tree at the next dispatch).  harvest_comp fetches the
+                # copy belonging to the round it harvests — a round the
+                # completion fetch already proved complete, so the age
+                # readback never blocks on an executing round.
+                self._age_ring.append(
+                    (self.step_idx - 1, jnp.copy(self.fs.meta.suspect_age)))
+            else:
+                # telemetry-only runs (fetch_completions=False, no
+                # recorder) never harvest, so the detector falls back to
+                # the synchronous poll — the one remaining configuration
+                # where an attached service syncs the dispatch
+                self.membership.poll(self)
         return comp
 
     def harvest_comp(self, comp, round_idx: Optional[int] = None):
@@ -570,6 +594,20 @@ class FastRuntime(_ObsHooks):
             obs.registry.counter("device_wait_s").inc(dt)
         if trace:
             obs.tracer.span_end("readback", tr)
+        if self._age_ring and (round_idx is None
+                               or self._age_ring[0][0] <= round_idx):
+            # detector input (round-9): fetch the freshest suspect-age
+            # copy belonging to a round at or before the one just
+            # harvested — its device work completed with that round, so
+            # this readback adds no stall — and run the suspicion machine
+            age_round, age_h = self._age_ring.popleft()
+            while self._age_ring and (round_idx is None
+                                      or self._age_ring[0][0] <= round_idx):
+                age_round, age_h = self._age_ring.popleft()
+            self.harvested_ages = (age_round,
+                                   np.asarray(jax.device_get(age_h)))
+            if self.membership is not None:
+                self.membership.poll(self)
         if self._ver_base is not None:
             # re-anchor post-rebase versions into the global (monotone)
             # version space the recorder/checker needs (see rebase_versions)

@@ -10,15 +10,99 @@ control state (step index, epoch, live mask, frozen flags).
 Restore semantics: a snapshot taken mid-protocol freezes in-flight writes
 exactly as they were; resuming with the same config continues the run
 deterministically (the op streams are derived from the config seed).
+
+Crash consistency (round-9, chaos & recovery): ``save`` writes the archive
+to a temp file and ``os.replace``s it into place — a crash mid-save leaves
+the previous snapshot intact, never a torn one — and embeds a checksummed
+MANIFEST (format version, config fingerprint, step, flushed ring depth,
+per-array sha256).  ``load`` verifies the manifest before any mutation: a
+bit-rotted or hand-edited array rejects loudly ("torn"), a missing array
+flows to the targeted incompleteness errors below, and a config
+fingerprint mismatch is reported before the full config diff.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import os
 
 import jax
 import numpy as np
+
+MANIFEST_KEY = "meta.manifest"
+MANIFEST_VERSION = 1
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable sha256 of the run config (the manifest's identity check)."""
+    return hashlib.sha256(
+        json.dumps(dataclasses.asdict(cfg), sort_keys=True).encode()
+    ).hexdigest()
+
+
+def _array_sha256(a) -> str:
+    a = np.ascontiguousarray(a)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def read_manifest(path: str) -> dict:
+    """The snapshot's manifest dict (no state arrays materialized beyond
+    it); raises ValueError on archives without one."""
+    with np.load(path) as z:
+        if MANIFEST_KEY not in z:
+            raise ValueError(
+                "snapshot has no manifest (pre-round-9 or truncated "
+                "archive); refusing to trust unverifiable state")
+        return json.loads(bytes(z[MANIFEST_KEY]).decode())
+
+
+def _verify_npz(z) -> dict:
+    """Manifest + per-array checksum verification over an OPEN npz: a
+    bit-rotted / hand-edited / undeclared member rejects loudly; a MISSING
+    member is left to the caller's targeted checks.  Returns the manifest."""
+    if MANIFEST_KEY not in z:
+        raise ValueError(
+            "snapshot has no manifest (pre-round-9 or truncated archive); "
+            "refusing to restore unverifiable state")
+    manifest = json.loads(bytes(z[MANIFEST_KEY]).decode())
+    if manifest.get("version") != MANIFEST_VERSION:
+        raise ValueError(
+            f"snapshot manifest version {manifest.get('version')} != "
+            f"{MANIFEST_VERSION}; archive written by an incompatible build")
+    declared = manifest.get("arrays", {})
+    for k in z.files:
+        if k == MANIFEST_KEY:
+            continue
+        if k not in declared:
+            raise ValueError(
+                f"snapshot archive carries undeclared array {k!r} "
+                "(corrupt or hand-edited?)")
+        if _array_sha256(z[k]) != declared[k]:
+            raise ValueError(
+                f"snapshot checksum mismatch on {k!r} (torn or corrupt "
+                "archive); refusing to restore")
+    return manifest
+
+
+def verify_archive(path: str, cfg=None) -> dict:
+    """Full crash-consistency verification WITHOUT mutation: manifest +
+    every array checksum (+ config fingerprint when ``cfg`` is given) —
+    the ``load`` gate as a standalone check.  chaos.recovery runs it
+    before trusting a snapshot for crash restore.  Returns the manifest."""
+    with np.load(path) as z:
+        manifest = _verify_npz(z)
+    if cfg is not None and manifest.get("config_sha256") != config_fingerprint(cfg):
+        raise ValueError(
+            "snapshot config fingerprint mismatch (manifest "
+            f"{manifest.get('config_sha256', '?')[:12]}.. vs config "
+            f"{config_fingerprint(cfg)[:12]}..)")
+    return manifest
 
 
 def _flatten(tree, prefix=""):
@@ -43,15 +127,24 @@ def save(path: str, rt) -> None:
         kvs, rt = rt, rt.rt
         kvs.flush()  # pipelined mode: land the deferred round's futures
         if kvs._inflight or kvs._queued_slots or kvs._bat:
+            # the quiescence trap, made loud WITH the evidence (round-9):
+            # futures are host objects — serializing around them would
+            # strand every pending client op in the restored run
+            n_inflight = len(kvs._inflight)
+            n_queued = sum(len(kvs._queues[k]) for k in kvs._queued_slots)
+            n_batch = sum(len(b["bf"]) - b["bf"].done_count()
+                          for b in kvs._bat.values())
             raise ValueError(
-                "snapshot requires a quiescent KVS: resolve in-flight ops "
-                "and active batches (run step()/run_until/run_batch) "
-                "before saving"
+                f"snapshot requires a quiescent KVS: {n_inflight} op(s) in "
+                f"flight, {n_queued} queued, {n_batch} unresolved batch "
+                f"op(s) across {len(kvs._bat)} active batch(es); resolve "
+                "them (run step()/run_until/run_batch) before saving"
             )
+    ring_flushed = 0
     if hasattr(rt, "flush_pipeline"):
         # harvest in-flight ring rounds: the recorder (if any) must not be
         # missing completions the restored run would re-record
-        rt.flush_pipeline()
+        ring_flushed = rt.flush_pipeline()
     state = rt.fs if hasattr(rt, "fs") else rt.rs
     arrays = _flatten(state, "state.")
     arrays["ctl.step_idx"] = np.int64(rt.step_idx)
@@ -88,7 +181,29 @@ def save(path: str, rt) -> None:
             arrays["kvs.index.bucket_slot"] = idx._bucket_slot
             arrays["kvs.index.rev"] = idx._rev
             arrays["kvs.index.n_used"] = np.int64(idx.n_used)
-    np.savez_compressed(path, **arrays)
+    # -- checksummed manifest + tmp/rename (crash consistency, round-9) ----
+    manifest = dict(
+        version=MANIFEST_VERSION,
+        config_sha256=config_fingerprint(rt.cfg),
+        step=int(rt.step_idx),
+        pipeline_depth=int(rt.cfg.pipeline_depth),
+        ring_flushed=int(ring_flushed),
+        arrays={k: _array_sha256(v) for k, v in arrays.items()},
+    )
+    arrays[MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest, sort_keys=True).encode(), dtype=np.uint8)
+    if not path.endswith(".npz"):
+        path += ".npz"  # np.savez's suffix rule, applied before the rename
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic: a crash mid-save never tears PATH
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def _leaf_keys(template, prefix=""):
@@ -134,6 +249,19 @@ def load(path: str, rt) -> None:
         rt.flush_pipeline()
     z = np.load(path)
     # -- validate everything first -----------------------------------------
+    # manifest gate (round-9): config fingerprint + per-array checksums.  A
+    # bit-rotted / hand-edited / torn array rejects HERE, loudly, before
+    # anything is overwritten; a MISSING array is left to the targeted
+    # incompleteness checks below (they name what is missing and why it
+    # matters).  Archives without a manifest predate round-9 and cannot be
+    # verified — refuse them outright.
+    manifest = _verify_npz(z)
+    if manifest.get("config_sha256") != config_fingerprint(rt.cfg):
+        raise ValueError(
+            "snapshot config fingerprint mismatch (manifest "
+            f"{manifest.get('config_sha256', '?')[:12]}.. vs runtime "
+            f"{config_fingerprint(rt.cfg)[:12]}..); rebuild the runtime "
+            "with the saved config")
     saved_cfg = json.loads(bytes(z["meta.cfg"]).decode())
     cur_cfg = dataclasses.asdict(rt.cfg)
     if saved_cfg != cur_cfg:
@@ -227,6 +355,11 @@ def load(path: str, rt) -> None:
     # the in-place row writes above bypass the membership hooks, so the
     # cached device-side ctl (round-8) must be re-uploaded explicitly
     rt._ctl_dirty = True
+    if hasattr(rt, "_age_ring"):
+        # pre-restore suspect-age copies belong to the OLD run's round
+        # numbering; a restored run must not feed them to the detector
+        rt._age_ring.clear()
+        rt.harvested_ages = None
     if hasattr(rt, "_ver_base") and "ctl.ver_base" in z:
         # zero-length = the never-rebased sentinel (round-6 archives); a
         # full-length all-zeros array is the pre-round-6 encoding of the

@@ -1,0 +1,237 @@
+"""Round-9 chaos & recovery gate (CI): the fault-injection subsystem must
+hold its three contracts on every change.
+
+Four assertions, CPU-smoke sized (joins scripts/check_op_census.py,
+check_obs_overhead.py, check_analysis.py and check_pipeline.py in the
+verify flow):
+
+  1. composed chaos soak — a seeded schedule of freeze / thaw / join /
+     crash-restart / heartbeat clock-skew, with the failure detector
+     attached (confirm window > 0), against FastRuntime at
+     ``pipeline_depth=2`` on BOTH engines: the linearizability checker
+     passes with zero violations, op totals conserve against the crash
+     losses, and the obs trace shows ZERO ``membership_fetch`` events —
+     the detector rides the completion harvest, never the dispatch path
+     (the ``ctl_upload`` regression pattern, applied to detection);
+  2. schedule determinism — the same seed + config replays to a
+     byte-identical executed-event log and final state tree;
+  3. torn-snapshot red test — a bit-flipped archive is rejected loudly by
+     the manifest checksum, a truncated one by the targeted
+     incompleteness checks, and save leaves no temp files behind;
+  4. sim-engine net chaos — drop / delay / duplicate windows
+     (chaos.NetChaos) composed with freezes on the host-mediated engine,
+     checker-gated.
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_chaos.py
+
+Prints one JSON line (also written to CHAOS_SOAK.json); exit non-zero on
+any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+SEED = 23
+STEPS = 220
+
+
+def _soak_cfg(pipeline_depth=2):
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    return HermesConfig(
+        n_replicas=5, n_keys=96, n_sessions=6, replay_slots=6,
+        ops_per_session=24, replay_age=6, replay_scan_every=4,
+        rebroadcast_every=2, lease_steps=6, pipeline_depth=pipeline_depth,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=SEED),
+    )
+
+
+def _run_soak(backend, mesh=None):
+    from hermes_tpu import chaos
+    from hermes_tpu.membership import MembershipService
+    from hermes_tpu.obs import Observability
+    from hermes_tpu.runtime import FastRuntime
+
+    cfg = _soak_cfg()
+    rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=True)
+    obs = rt.attach_obs(Observability())
+    rt.attach_membership(MembershipService(cfg, confirm_steps=3))
+    sched = chaos.Schedule.random(cfg, seed=SEED, steps=STEPS,
+                                  spec=chaos.ChaosSpec(p_crash=0.03))
+    runner = chaos.ChaosRunner(rt, sched)
+    res = runner.run(STEPS, check=True)
+    ev = [r.get("name") for r in obs.records if r.get("kind") == "event"]
+    return rt, runner, res, ev
+
+
+def check_soak(report: dict) -> None:
+    import jax
+    import numpy as np
+
+    for backend in ("batched", "sharded"):
+        mesh = None
+        if backend == "sharded":
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()[:5]), ("replica",))
+        rt, runner, res, ev = _run_soak(backend, mesh)
+        assert res["drained"], f"{backend}: cluster did not drain"
+        assert res["checked_ok"], (
+            f"{backend}: checker FAIL {res['check_failures']}")
+        assert ev.count("membership_fetch") == 0, (
+            f"{backend}: detector issued {ev.count('membership_fetch')} "
+            "synchronous last_seen fetch(es) on the dispatch path")
+        assert "suspect" in ev and "remove" in ev, (
+            f"{backend}: detector never fired under the schedule ({ev})")
+        applied = {e["kind"] for e in runner.log}
+        assert "crash_restart" in applied, (
+            f"{backend}: schedule applied no crash_restart ({applied})")
+        c = rt.counters()
+        total = c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"]
+        expect = rt.cfg.n_replicas * rt.cfg.n_sessions * rt.cfg.ops_per_session
+        assert total == expect - res["lost_ops"], (
+            f"{backend}: totals {total} != {expect} - lost {res['lost_ops']}")
+        report[f"{backend}_soak"] = dict(
+            events=len(runner.log), lost_ops=res["lost_ops"],
+            suspects=ev.count("suspect"), removes=ev.count("remove"),
+            checked_ok=True, membership_fetches=0)
+
+
+def check_determinism(report: dict) -> None:
+    import jax
+    import numpy as np
+
+    logs, states = [], []
+    for _ in range(2):
+        rt, runner, res, _ev = _run_soak("batched")
+        assert res["checked_ok"]
+        logs.append(runner.log_json())
+        states.append(jax.tree.leaves(jax.device_get(rt.fs)))
+    assert logs[0] == logs[1], "executed-event logs differ across replays"
+    for x, y in zip(states[0], states[1]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    report["deterministic_replay"] = True
+
+
+def check_torn_snapshot(report: dict) -> None:
+    import zipfile
+
+    import numpy as np
+
+    from hermes_tpu import snapshot
+    from hermes_tpu.runtime import FastRuntime
+
+    cfg = _soak_cfg(pipeline_depth=1)
+    rt = FastRuntime(cfg)
+    rt.run(6)
+    d = tempfile.mkdtemp()
+    p = os.path.join(d, "snap.npz")
+    snapshot.save(p, rt)
+    assert not [f for f in os.listdir(d) if ".tmp" in f], "temp file left"
+
+    # bit-flip one payload byte inside a state member -> checksum reject
+    torn = os.path.join(d, "torn.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(torn, "w") as zout:
+        for name in zin.namelist():
+            data = bytearray(zin.read(name))
+            if name.startswith("state.table.bank"):
+                data[len(data) // 2] ^= 0xFF
+            zout.writestr(name, bytes(data))
+    tgt = FastRuntime(cfg)
+    try:
+        snapshot.load(torn, tgt)
+        raise AssertionError("torn snapshot must be rejected")
+    except ValueError as e:
+        assert "checksum" in str(e) or "torn" in str(e), str(e)
+    report["torn_snapshot_rejected"] = True
+
+    # truncated (missing member) -> targeted incompleteness reject
+    trunc = os.path.join(d, "trunc.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(trunc, "w") as zout:
+        victims = [n for n in zin.namelist() if n.startswith("state.sess")]
+        for name in zin.namelist():
+            if name != victims[0]:
+                zout.writestr(name, zin.read(name))
+    try:
+        snapshot.load(trunc, FastRuntime(cfg))
+        raise AssertionError("truncated snapshot must be rejected")
+    except ValueError as e:
+        assert "incomplete" in str(e), str(e)
+    report["truncated_snapshot_rejected"] = True
+
+    # and the happy path restores bit-exact
+    tgt = FastRuntime(cfg)
+    snapshot.load(p, tgt)
+    import jax
+
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(rt.fs.table.vpts)),
+        np.asarray(jax.device_get(tgt.fs.table.vpts)))
+    report["snapshot_roundtrip"] = True
+
+
+def check_net_chaos_sim(report: dict) -> None:
+    from hermes_tpu import chaos
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.runtime import Runtime
+    from hermes_tpu.transport.sim import SimTransport
+
+    cfg = HermesConfig(
+        n_replicas=4, n_keys=64, n_sessions=4, replay_slots=8,
+        ops_per_session=20, replay_age=5, lease_steps=6,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.2, seed=SEED),
+    )
+    net = chaos.NetChaos()
+    rt = Runtime(cfg, backend="sim", record=True,
+                 transport=SimTransport(cfg.n_replicas, net))
+    sched = chaos.Schedule.parse("""
+        @5  net_drop 0 dst=2 until=25
+        @10 net_delay 1 skew=3 until=40
+        @15 net_dup 2 until=35
+        @20 freeze 3
+        @30 thaw 3
+    """)
+    runner = chaos.ChaosRunner(rt, sched, net=net)
+    res = runner.run(60, check=True)
+    assert res["drained"], "sim net-chaos run did not drain"
+    assert res["checked_ok"], f"sim net-chaos checker FAIL: {res}"
+    applied = {e["kind"] for e in runner.log}
+    assert {"net_drop", "net_delay", "net_dup"} <= applied, applied
+    report["sim_net_chaos"] = dict(events=len(runner.log), checked_ok=True)
+
+
+def main() -> int:
+    report: dict = {"gate": "chaos"}
+    try:
+        check_soak(report)
+        check_determinism(report)
+        check_torn_snapshot(report)
+        check_net_chaos_sim(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report))
+        return 1
+    report["ok"] = True
+    out = os.path.join(os.path.dirname(__file__), "..", "CHAOS_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,512 @@
+"""Chaos & recovery subsystem (hermes_tpu/chaos, round-9): async pipelined
+failure detection, crash-consistent snapshots, crash-restart recovery,
+declarative fault schedules — each leg gated by the linearizability
+checker and the obs timeline."""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from hermes_tpu import chaos, snapshot
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import types as t
+from hermes_tpu.kvs import KVS, C_LOST, StuckOpError
+from hermes_tpu.membership import MembershipService
+from hermes_tpu.obs import Observability
+from hermes_tpu.runtime import FastRuntime
+
+from helpers import get
+
+
+def _cfg(**kw):
+    base = dict(
+        n_replicas=5, n_keys=96, n_sessions=6, replay_slots=6,
+        ops_per_session=24, replay_age=6, replay_scan_every=4,
+        rebroadcast_every=2, lease_steps=6,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=23),
+    )
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def _events(obs):
+    return [r["name"] for r in obs.records if r.get("kind") == "event"]
+
+
+# -- leg 1: async pipelined failure detection --------------------------------
+
+
+def test_async_detector_zero_dispatch_fetch_pipelined():
+    """The acceptance regression (ctl_upload pattern applied to detection):
+    with the detector attached to a pipelined FastRuntime, the dispatch
+    path issues ZERO synchronous last_seen fetches — suspicion/removal are
+    driven entirely off the harvested Meta.suspect_age columns — and the
+    frozen replica is suspected, confirmed, removed, and the healed run
+    passes the checker."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=2)
+    rt = FastRuntime(cfg, record=True)
+    obs = rt.attach_obs(Observability())
+    rt.attach_membership(MembershipService(cfg, confirm_steps=3))
+    rt.run(4)
+    rt.freeze(3)
+    rt.run(25)
+    ev = _events(obs)
+    assert "membership_fetch" not in ev, "dispatch-path device_get leaked"
+    assert ev.index("suspect") < ev.index("remove")
+    assert rt.membership.events and rt.membership.events[0].kind == "remove"
+    assert rt.membership.events[0].replica == 3
+    assert rt.drain(1500)
+    assert rt.check().ok
+
+
+def test_confirm_window_spontaneous_recovery_cancels():
+    """A replica that recovers inside the confirm window is NEVER removed:
+    the suspicion cancels (suspect_clear on the timeline) instead of
+    ejecting a healthy replica — the detector hysteresis."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=2)
+    rt = FastRuntime(cfg, record=True)
+    obs = rt.attach_obs(Observability())
+    rt.attach_membership(MembershipService(cfg, confirm_steps=30))
+    rt.run(3)
+    rt.freeze(2)
+    rt.run(cfg.lease_steps + 4)  # past the lease: suspected, not confirmed
+    assert "suspect" in _events(obs)
+    rt.thaw(2)  # spontaneous recovery before the confirm window elapses
+    rt.run(10)
+    ev = _events(obs)
+    assert "suspect_clear" in ev
+    assert not rt.membership.events, "healthy replica was removed"
+    assert int(rt.live[0]) == cfg.full_mask
+    assert rt.drain(1500) and rt.check().ok
+
+
+def test_hb_skew_exercises_hysteresis_without_faults():
+    """Heartbeat clock-skew (the fast engines' network-fault class): a
+    skewed detector view pushes a HEALTHY replica into suspicion; when the
+    skew window expires before the confirm window, the suspicion clears
+    and nobody is ejected."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=2)
+    rt = FastRuntime(cfg, record=True)
+    obs = rt.attach_obs(Observability())
+    rt.attach_membership(MembershipService(cfg, confirm_steps=20))
+    sched = chaos.Schedule.parse("@5 hb_skew 1 skew=9 until=15\n")
+    runner = chaos.ChaosRunner(rt, sched)
+    res = runner.run(40, check=True)
+    ev = _events(obs)
+    assert "hb_skew" in ev and "suspect" in ev and "suspect_clear" in ev
+    assert "remove" not in ev
+    assert res["drained"] and res["checked_ok"]
+
+
+def test_harvested_ages_ride_the_ring_per_round():
+    """The detector input must never block on an EXECUTING round: each
+    harvest consumes the suspect-age copy of a round the completion fetch
+    already proved complete, so at depth d the observed age round lags the
+    dispatch by d-1 — it must never equal the freshest in-flight round."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=3)
+    rt = FastRuntime(cfg, record=True)
+    rt.attach_membership(MembershipService(cfg))
+    for _ in range(10):
+        rt.step_once()
+        if rt.harvested_ages is not None and len(rt._ring) >= 2:
+            age_round = rt.harvested_ages[0]
+            newest_inflight = rt.step_idx - 1
+            assert age_round < newest_inflight, (
+                "age fetch touched the executing round — pipeline "
+                "re-serialized")
+    assert rt.harvested_ages is not None
+    assert rt.drain(1500) and rt.check().ok
+
+
+def test_runner_remove_floor_and_heal_without_donor():
+    """An all-remove declarative schedule must degrade at the healthy
+    floor (skipped events in the log), never crash the runner or empty
+    the cluster."""
+    cfg = _cfg(n_replicas=5, pipeline_depth=1)
+    rt = FastRuntime(cfg, record=True)
+    sched = chaos.Schedule.parse(
+        "\n".join(f"@0 remove {r}" for r in range(5)) + "\n")
+    runner = chaos.ChaosRunner(rt, sched,
+                               spec=chaos.ChaosSpec(min_healthy=3))
+    res = runner.run(20, check=True)
+    removed = [e for e in res["events"] if e["kind"] == "remove"]
+    skipped = [e for e in res["events"] if e["kind"] == "skipped"]
+    assert len(removed) == 2 and len(skipped) == 3  # floor held at 3
+    assert res["drained"] and res["checked_ok"]
+    assert int(rt.live[0]) == cfg.full_mask  # heal rejoined everyone
+
+
+def test_detector_fallback_fetch_without_harvest():
+    """fetch_completions=False runs never harvest, so the detector falls
+    back to the synchronous poll — counted loudly as membership_fetch."""
+    cfg = _cfg(n_replicas=4)
+    rt = FastRuntime(cfg)  # no recorder
+    rt.fetch_completions = False
+    obs = rt.attach_obs(Observability())
+    rt.attach_membership(MembershipService(cfg))
+    rt.run(3)
+    rt.freeze(3)
+    rt.run(cfg.lease_steps + 3)
+    ev = _events(obs)
+    assert "membership_fetch" in ev
+    assert any(e.kind == "remove" and e.replica == 3
+               for e in rt.membership.events)
+
+
+# -- leg 2: crash-consistent snapshots + crash-restart recovery --------------
+
+
+def test_crash_restart_loses_inflight_ops_checked():
+    """Full host-crash of a coordinator holding quorum-blocked in-flight
+    writes: the clients' futures resolve as kind='lost', the history
+    carries the lost updates as maybe_w (the cluster may still finish them
+    via replay), and after heal the run drains and linearizes."""
+    cfg = _cfg(n_keys=64, n_sessions=4, value_words=6, replay_slots=4,
+               pipeline_depth=2)
+    kvs = KVS(cfg, record=True)
+    obs = kvs.rt.attach_obs(Observability())
+    # block the quorum so replica 0's writes pin in flight
+    kvs.freeze(3)
+    kvs.freeze(4)
+    futs = [kvs.put(0, s, 7 + s, [s, 1]) for s in range(4)]
+    for _ in range(6):
+        kvs.step()
+    assert not any(f.done() for f in futs), "quorum was not blocked"
+    n_ops = len(kvs.rt.recorder.ops)
+    s = chaos.restart_replica(kvs, 0)
+    assert s["lost_ops"] == 4 and s["lost_client_futures"] == 4
+    assert all(f.done() and f.result().kind == "lost" for f in futs)
+    # the lost in-flight updates were salvaged as maybe_w rows
+    folded = [o for o in kvs.rt.recorder.ops if o.kind == "maybe_w"]
+    assert len(folded) == 4 and len(kvs.rt.recorder.ops) == n_ops + 4
+    assert "crash_restart" in _events(obs)
+    kvs.rt.thaw(3)
+    kvs.rt.thaw(4)
+    g = kvs.get(1, 0, 7)
+    assert kvs.run_until([g], 400)
+    assert kvs.rt.check().ok
+
+
+@pytest.mark.parametrize("backend", ["batched", "sharded"])
+def test_crash_restart_soak_checked(backend):
+    """Crash-restart composed with a running workload on both engines:
+    totals conserve against the lost ops, every key is readable again,
+    and the history linearizes."""
+    mesh = None
+    if backend == "sharded":
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:5]), ("replica",))
+    cfg = _cfg(pipeline_depth=2)
+    rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=True)
+    rt.run(25)
+    s1 = chaos.restart_replica(rt, 2)
+    rt.run(20)
+    s2 = chaos.restart_replica(rt, 4, donor=0)
+    assert rt.drain(3000)
+    assert rt.check().ok
+    c = rt.counters()
+    total = c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"]
+    lost = s1["lost_ops"] + s2["lost_ops"]
+    assert total == 5 * 6 * 24 - lost
+    assert ((get(rt.fs.table.sst) & 7) == t.VALID).all()
+
+
+def test_restart_from_snapshot_and_torn_fallback(tmp_path):
+    """Snapshot-seeded restore on the sharded layout: a valid snapshot
+    reports its still-current rows (the transfer volume it saves); a torn
+    snapshot is REJECTED on the timeline and recovery falls back to pure
+    peer transfer — never silently restoring garbage."""
+    import jax
+    from jax.sharding import Mesh
+
+    cfg = _cfg(n_replicas=5, pipeline_depth=2)
+    mesh = Mesh(np.array(jax.devices()[:5]), ("replica",))
+    rt = FastRuntime(cfg, backend="sharded", mesh=mesh, record=True)
+    obs = rt.attach_obs(Observability())
+    rt.run(10)
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, rt)
+    rt.run(10)
+    s = chaos.restart_replica(rt, 1, snapshot_path=p)
+    assert s["source"] == "snapshot"
+    assert 0 <= s["rows_current"] <= cfg.n_keys
+
+    torn = str(tmp_path / "torn.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(torn, "w") as zout:
+        for name in zin.namelist():
+            data = bytearray(zin.read(name))
+            if name.startswith("state.table.vpts"):
+                data[len(data) // 2] ^= 0xFF
+            zout.writestr(name, bytes(data))
+    s = chaos.restart_replica(rt, 2, snapshot_path=torn)
+    assert s["source"] == "transfer"
+    assert "snapshot_rejected" in _events(obs)
+    assert rt.drain(3000) and rt.check().ok
+
+
+def test_restart_torn_snapshot_rejected_batched_any_member(tmp_path):
+    """Torn-archive rejection holds on the BATCHED engine too, and for a
+    corrupt member the batched restore path never even reads (the full
+    verify_archive pass guards both engines)."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=1)
+    rt = FastRuntime(cfg, record=True)
+    obs = rt.attach_obs(Observability())
+    rt.run(8)
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, rt)
+    torn = str(tmp_path / "torn.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(torn, "w") as zout:
+        for name in zin.namelist():
+            data = bytearray(zin.read(name))
+            if name.startswith("state.table.bank"):
+                data[len(data) // 2] ^= 0xFF
+            zout.writestr(name, bytes(data))
+    s = chaos.restart_replica(rt, 1, snapshot_path=torn)
+    assert s["source"] == "transfer" and s["rows_current"] is None
+    assert "snapshot_rejected" in _events(obs)
+    # and the intact archive is accepted with every row current (shared
+    # batched table survives the crash)
+    s = chaos.restart_replica(rt, 2, snapshot_path=p)
+    assert s["source"] == "snapshot" and s["rows_current"] == cfg.n_keys
+    assert rt.drain(2000) and rt.check().ok
+
+
+def test_rejoin_grace_confirm_zero_not_instantly_reejected():
+    """Detector regression: with confirm_steps=0 at depth 2, a crashed and
+    rejoined replica must NOT be re-removed off pre-join harvested ages —
+    the join grace window (one lease) absorbs them."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=2)
+    rt = FastRuntime(cfg, record=True)
+    rt.attach_membership(MembershipService(cfg, confirm_steps=0))
+    rt.run(6)
+    rt.freeze(2)
+    rt.run(cfg.lease_steps + 6)  # detector removes replica 2
+    assert not (int(rt.live[0]) >> 2) & 1
+    rt.thaw(2)
+    chaos.restart_replica(rt, 2, donor=0)  # rejoin via crash-restart
+    rt.run(cfg.lease_steps + 8)  # past the grace: healthy heartbeats rule
+    removes = [e for e in rt.membership.events
+               if e.kind == "remove" and e.replica == 2]
+    assert len(removes) == 1, "rejoined replica was re-ejected on stale ages"
+    assert (int(rt.live[0]) >> 2) & 1
+    assert rt.drain(2000) and rt.check().ok
+
+
+def test_snapshot_manifest_torn_and_fingerprint(tmp_path):
+    """Crash-consistent save/load: tmp+rename leaves no temp files, a
+    bit-flipped archive rejects on the manifest checksum, and a config
+    fingerprint mismatch is loud."""
+    cfg = _cfg(n_replicas=3, pipeline_depth=1)
+    rt = FastRuntime(cfg)
+    rt.run(5)
+    p = str(tmp_path / "snap.npz")
+    snapshot.save(p, rt)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    man = snapshot.read_manifest(p)
+    assert man["step"] == 5
+    assert man["config_sha256"] == snapshot.config_fingerprint(cfg)
+    assert man["pipeline_depth"] == 1
+
+    torn = str(tmp_path / "torn.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(torn, "w") as zout:
+        for name in zin.namelist():
+            data = bytearray(zin.read(name))
+            if name.startswith("state.sess.status"):
+                data[-1] ^= 0x01
+            zout.writestr(name, bytes(data))
+    with pytest.raises(ValueError, match="checksum"):
+        snapshot.load(torn, FastRuntime(cfg))
+
+    other = FastRuntime(_cfg(n_replicas=3, n_keys=128, pipeline_depth=1))
+    with pytest.raises(ValueError, match="fingerprint"):
+        snapshot.load(p, other)
+
+
+def test_kvs_snapshot_quiescence_trap_counts():
+    """save() on a non-quiescent KVS raises with the in-flight evidence."""
+    cfg = _cfg(n_replicas=3, n_keys=64, n_sessions=4, value_words=6)
+    kvs = KVS(cfg)
+    kvs.freeze(1)
+    kvs.freeze(2)
+    futs = [kvs.put(0, s, s, [1]) for s in range(3)]
+    for _ in range(3):
+        kvs.step()
+    with pytest.raises(ValueError) as ei:
+        snapshot.save("/tmp/never_written.npz", kvs)
+    msg = str(ei.value)
+    assert "quiescent" in msg and "3 op(s) in flight" in msg
+    kvs.rt.thaw(1)
+    kvs.rt.thaw(2)
+    assert kvs.run_until(futs, 300)
+
+
+# -- leg 3: declarative schedules -------------------------------------------
+
+
+def test_schedule_parse_format_roundtrip():
+    text = (
+        "@12 freeze 2\n"
+        "@18 thaw 2\n"
+        "@30 crash_restart 2 donor=0\n"
+        "@40 hb_skew 1 skew=9 until=55\n"
+        "@15 net_drop 0 dst=3 until=40\n"
+    )
+    sched = chaos.Schedule.parse(text)
+    assert len(sched) == 5
+    assert sched.events[0].step == 12  # sorted by step
+    again = chaos.Schedule.parse(sched.format())
+    assert again.events == sched.events
+    # a typo'd kind names its line, like every other parse diagnostic
+    with pytest.raises(ValueError, match="line 2.*unknown chaos event kind"):
+        chaos.Schedule.parse("@1 freeze 0\n@3 meteor 1\n")
+    with pytest.raises(ValueError, match="line 1"):
+        chaos.Schedule.parse("12 freeze 2\n")
+
+
+@pytest.mark.parametrize("backend", ["batched", "sharded"])
+def test_schedule_determinism(backend):
+    """Satellite contract: same seed + config => byte-identical executed
+    event log AND final state across two runs, on both engines — with the
+    detector attached and crash-restart in the mix."""
+    import jax
+
+    mesh = None
+    if backend == "sharded":
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:5]), ("replica",))
+    cfg = _cfg(pipeline_depth=2)
+
+    def run():
+        rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=True)
+        rt.attach_membership(MembershipService(cfg, confirm_steps=3))
+        sched = chaos.Schedule.random(cfg, seed=23, steps=120,
+                                      spec=chaos.ChaosSpec(p_crash=0.03))
+        runner = chaos.ChaosRunner(rt, sched)
+        res = runner.run(120, check=True)
+        assert res["drained"] and res["checked_ok"]
+        return (runner.log_json(),
+                jax.tree.leaves(jax.device_get(rt.fs)),
+                json.dumps([dataclasses_row(e) for e in
+                            rt.membership.events]))
+
+    def dataclasses_row(e):
+        return [e.step, e.kind, e.replica, e.live_mask]
+
+    log_a, state_a, mem_a = run()
+    log_b, state_b, mem_b = run()
+    assert log_a == log_b, "executed-event logs differ"
+    assert mem_a == mem_b, "membership event logs differ"
+    for x, y in zip(state_a, state_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_net_chaos_sim_engine_checked():
+    """net drop/delay/dup windows compose with freezes on the sim engine
+    (the host-mediated wire) and the history still linearizes."""
+    from hermes_tpu.runtime import Runtime
+    from hermes_tpu.transport.sim import SimTransport
+
+    cfg = HermesConfig(
+        n_replicas=4, n_keys=64, n_sessions=4, replay_slots=8,
+        ops_per_session=16, replay_age=5, lease_steps=6,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.2, seed=29),
+    )
+    net = chaos.NetChaos()
+    rt = Runtime(cfg, backend="sim", record=True,
+                 transport=SimTransport(cfg.n_replicas, net))
+    sched = chaos.Schedule.parse(
+        "@4 net_drop 0 dst=2 until=20\n"
+        "@8 net_delay 1 skew=3 until=30\n"
+        "@12 net_dup 2 until=28\n"
+        "@16 freeze 3\n"
+        "@24 thaw 3\n")
+    runner = chaos.ChaosRunner(rt, sched, net=net)
+    res = runner.run(50, check=True)
+    assert res["drained"] and res["checked_ok"], res
+    assert {"net_drop", "net_delay", "net_dup"} <= {e["kind"]
+                                                    for e in runner.log}
+
+
+def test_runner_quorum_floor_skips_illegal_events():
+    """Legality resolution: the runner never freezes/crashes below the
+    healthy floor — an over-aggressive schedule degrades to what the
+    cluster can absorb, deterministically."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=1)
+    rt = FastRuntime(cfg, record=True)
+    sched = chaos.Schedule.parse("\n".join(
+        f"@{s} freeze" for s in range(1, 20)) + "\n")
+    runner = chaos.ChaosRunner(rt, sched,
+                               spec=chaos.ChaosSpec(min_healthy=3))
+    res = runner.run(30, check=True)
+    frozen_events = [e for e in res["events"] if e["kind"] == "freeze"]
+    assert len(frozen_events) == 1  # 4 healthy -> exactly one freeze legal
+    assert res["drained"] and res["checked_ok"]
+
+
+# -- satellite: KVS stuck-op watchdog ---------------------------------------
+
+
+def test_kvs_stuck_op_watchdog_diagnostic():
+    """A quorum-blocked op past cfg.op_timeout_rounds surfaces ONE
+    stuck_op event + per-session diagnostic (coordinator, phase, age)
+    instead of hanging silently, and completes once the quorum heals."""
+    cfg = _cfg(n_replicas=3, n_keys=64, n_sessions=4, value_words=6,
+               op_timeout_rounds=5)
+    kvs = KVS(cfg)
+    obs = kvs.rt.attach_obs(Observability())
+    kvs.freeze(1)
+    kvs.freeze(2)
+    fut = kvs.put(0, 0, 9, [42])
+    for _ in range(10):
+        kvs.step()
+    stuck = [r for r in obs.records if r.get("name") == "stuck_op"]
+    assert len(stuck) == 1, "stuck_op must fire exactly once per op"
+    d = kvs.stuck_ops[0]
+    assert d["replica"] == 0 and d["session"] == 0 and d["kind"] == "put"
+    assert d["phase"] == "ack-wait" and d["age_rounds"] > 5
+    kvs.rt.thaw(1)
+    kvs.rt.thaw(2)
+    assert kvs.run_until([fut], 200)
+    assert fut.result().kind == "put"
+
+
+def test_kvs_stuck_op_sparse_reports_client_key():
+    """Sparse-key mode: the diagnostic names the CLIENT's 64-bit key, not
+    the dense device slot it hashed to."""
+    cfg = _cfg(n_replicas=3, n_keys=64, n_sessions=4, value_words=6,
+               op_timeout_rounds=4)
+    kvs = KVS(cfg, sparse_keys=True)
+    kvs.freeze(1)
+    kvs.freeze(2)
+    big_key = 0xDEAD_BEEF_0000_0042
+    kvs.put(0, 0, big_key, [7])
+    for _ in range(8):
+        kvs.step()
+    assert kvs.stuck_ops and kvs.stuck_ops[0]["key"] == big_key
+
+
+def test_kvs_stuck_op_strict_raises():
+    cfg = _cfg(n_replicas=3, n_keys=64, n_sessions=4, value_words=6,
+               op_timeout_rounds=4)
+    kvs = KVS(cfg, strict_timeouts=True)
+    kvs.freeze(1)
+    kvs.freeze(2)
+    kvs.put(0, 0, 3, [1])
+    with pytest.raises(StuckOpError, match="stuck past op_timeout_rounds"):
+        for _ in range(12):
+            kvs.step()
+
+
+def test_watchdog_off_by_default_zero_cost_path():
+    cfg = _cfg(n_replicas=3, n_keys=64, n_sessions=4, value_words=6)
+    assert cfg.op_timeout_rounds == 0
+    kvs = KVS(cfg)
+    f = kvs.put(0, 0, 1, [7])
+    assert kvs.run_until([f], 100)
+    assert not kvs.stuck_ops
