@@ -422,13 +422,21 @@ def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
     shape YCSB-A config at pipeline depth ``depth`` with the failure
     detector attached, driven clean vs under a seeded fault schedule
     (freeze/thaw/join/crash-restart/heartbeat-skew; hermes_tpu.chaos) —
-    what the composed fault load costs the serving loop.  Correctness
-    truth lives in scripts/check_chaos.py and the checker-gated tests;
-    this cell measures rate and detection activity."""
+    what the composed fault load costs the serving loop.  Round-10: the
+    chaos cell additionally samples per-window commit rates
+    (hermes_tpu.elastic.RateSampler) and reports the WORST window against
+    the clean cell's rate as ``dip_pct`` — the bounded-degradation number
+    elastic drills gate on (a fault schedule that merely lowers the
+    average can still hide a window of zero service; the worst window
+    can't).  Correctness truth lives in scripts/check_chaos.py /
+    check_elastic.py and the checker-gated tests; this cell measures rate
+    and detection activity."""
     from hermes_tpu import chaos as chaos_lib
+    from hermes_tpu.elastic import RateSampler
     from hermes_tpu.membership import MembershipService
     from hermes_tpu.runtime import FastRuntime
 
+    window = max(4, rounds // 8)
     cells = {}
     for name in ("clean", "chaos"):
         cfg = _cfg("a", dict(pipeline_depth=depth))
@@ -438,7 +446,12 @@ def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
         rt.counters()  # close the deferred-execution window before timing
         sched = (chaos_lib.Schedule.random(cfg, seed, rounds)
                  if name == "chaos" else chaos_lib.Schedule([]))
-        runner = chaos_lib.ChaosRunner(rt, sched)
+        # BOTH cells carry the sampler: its per-window counters() sync is
+        # part of the measured wall, so the clean-vs-chaos comparison
+        # stays apples-to-apples (only the chaos cell's windows are
+        # reported)
+        sampler = RateSampler(rt, window)
+        runner = chaos_lib.ChaosRunner(rt, sched, on_step=sampler.note)
         c0 = rt.counters()
         t0 = time.perf_counter()
         runner.run(rounds, heal=False)
@@ -453,16 +466,23 @@ def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
             membership_events=len(rt.membership.events),
             lost_ops=runner.lost_ops,
         )
+        cells[name]["writes_per_sec"] = round(
+            cells[name]["writes"] / max(1e-9, wall), 1)
         if name == "chaos":
+            sampler.finish()
             cells[name]["event_log"] = runner.log
+            cells[name]["worst_window"] = sampler.report(
+                clean_rate=cells["clean"]["writes_per_sec"])
     return {
         "seed": seed, "pipeline_depth": depth, "cells": cells,
         "slowdown": round(cells["chaos"]["round_us"]
                           / max(1e-9, cells["clean"]["round_us"]), 3),
+        "dip_pct": cells["chaos"]["worst_window"]["dip_pct"],
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
-        "note": "rate cells only; linearizability under the same fault "
-                "classes is gated by scripts/check_chaos.py",
+        "note": "rate cells only (dip_pct = worst chaos window vs clean "
+                "rate); linearizability under the same fault classes is "
+                "gated by scripts/check_chaos.py / check_elastic.py",
     }
 
 
@@ -547,6 +567,7 @@ def main() -> None:
             "clean": r["cells"]["clean"]["round_us"],
             "chaos": r["cells"]["chaos"]["round_us"],
             "slowdown": r["slowdown"],
+            "dip_pct": r["dip_pct"],
             "events": r["cells"]["chaos"]["events_applied"],
         })
         return

@@ -22,7 +22,7 @@ from hermes_tpu.core import types
 __version__ = "0.2.0"
 
 __all__ = ["HermesConfig", "WorkloadConfig", "types", "KVS", "KeyIndex",
-           "FastRuntime", "Runtime", "__version__"]
+           "RangeRouter", "FastRuntime", "Runtime", "__version__"]
 
 
 def __getattr__(name):
@@ -34,6 +34,8 @@ def __getattr__(name):
         from hermes_tpu.kvs import KVS as obj
     elif name == "KeyIndex":
         from hermes_tpu.keyindex import KeyIndex as obj
+    elif name == "RangeRouter":
+        from hermes_tpu.keyindex import RangeRouter as obj
     elif name in ("FastRuntime", "Runtime"):
         from hermes_tpu import runtime
 

@@ -53,36 +53,61 @@ from hermes_tpu import snapshot as snapshot_lib
 from hermes_tpu.core import types as t
 
 
-def _wipe_replica_volatile(rt, replica: int) -> int:
-    """Lose one replica's volatile per-session/replay state (the crash
-    itself).  Loaded ops (READ/ISSUE/INFL) vanish; their sessions step past
-    them so the restarted replica never re-mints a lost op's write uid.
-    Returns the number of client ops lost."""
+def wipe_volatile(rt, sess_mask, replay_mask=None) -> int:
+    """Lose the volatile per-session (and optionally replay) state of the
+    masked slots — the crash/salvage primitive.  ``sess_mask`` is ``(R,
+    S)`` bool, ``replay_mask`` ``(R, replay_slots)`` bool.  Loaded ops
+    (READ/ISSUE/INFL) on masked slots vanish; their sessions step past
+    them so a restarted (or salvaged) slot never re-mints a lost op's
+    write uid.  Callers own the history fold (``recorder.fold_pending``)
+    — it must happen BEFORE this wipe, while the in-flight rows still
+    exist.  Returns the number of client ops lost.
+
+    Used whole-replica by ``restart_replica`` (full host-crash) and
+    slot-masked by the key-range migration's forced cutover
+    (hermes_tpu.elastic.migrate_range): ops caught mid-flip are salvaged
+    as ``maybe_w`` history rows + loudly-lost client futures, never
+    silently dropped."""
     cfg = rt.cfg
     fs = rt.fs
     sess, replay = fs.sess, fs.replay
-    row = sess.status[replica]
-    loaded = (row == t.S_READ) | (row == t.S_ISSUE) | (row == t.S_INFL)
-    op_idx = sess.op_idx[replica] + loaded.astype(jnp.int32)
+    m = jnp.asarray(np.asarray(sess_mask, bool))
+    loaded = m & ((sess.status == t.S_READ) | (sess.status == t.S_ISSUE)
+                  | (sess.status == t.S_INFL))
+    op_idx = sess.op_idx + loaded.astype(jnp.int32)
     if cfg.wrap_stream:
-        status = jnp.full_like(row, t.S_IDLE)
+        wiped_status = jnp.int32(t.S_IDLE)
     else:
-        status = jnp.where(op_idx >= cfg.ops_per_session,
-                           jnp.int32(t.S_DONE), jnp.int32(t.S_IDLE))
-    zero = jnp.zeros_like(sess.pts[replica])
+        wiped_status = jnp.where(op_idx >= cfg.ops_per_session,
+                                 jnp.int32(t.S_DONE), jnp.int32(t.S_IDLE))
+    z = lambda a: jnp.where(m, jnp.zeros_like(a), a)
     new_sess = sess._replace(
-        status=sess.status.at[replica].set(status),
-        op_idx=sess.op_idx.at[replica].set(op_idx),
-        pts=sess.pts.at[replica].set(zero),
-        acks=sess.acks.at[replica].set(zero),
-        retries=sess.retries.at[replica].set(zero),
-        issue_step=sess.issue_step.at[replica].set(zero),
+        status=jnp.where(m, wiped_status, sess.status),
+        op_idx=op_idx,
+        pts=z(sess.pts),
+        acks=z(sess.acks),
+        retries=z(sess.retries),
+        issue_step=z(sess.issue_step),
     )
-    new_replay = replay._replace(
-        active=replay.active.at[replica].set(
-            jnp.zeros_like(replay.active[replica])))
+    new_replay = replay
+    if replay_mask is not None:
+        rm = jnp.asarray(np.asarray(replay_mask, bool))
+        new_replay = replay._replace(
+            active=jnp.where(rm, False, replay.active))
     rt.fs = fs._replace(sess=new_sess, replay=new_replay)
     return int(jax.device_get(jnp.sum(loaded.astype(jnp.int32))))
+
+
+def _wipe_replica_volatile(rt, replica: int) -> int:
+    """Full host-crash of one replica: every session and replay slot of
+    ``replica`` loses its volatile state (wipe_volatile, whole-row masks).
+    Returns the number of client ops lost."""
+    cfg = rt.cfg
+    sess_mask = np.zeros((cfg.n_replicas, cfg.n_sessions), bool)
+    sess_mask[replica] = True
+    replay_mask = np.zeros((cfg.n_replicas, cfg.replay_slots), bool)
+    replay_mask[replica] = True
+    return wipe_volatile(rt, sess_mask, replay_mask)
 
 
 def _snapshot_rows_current(rt, replica: int, donor: int,

@@ -162,6 +162,22 @@ class Schedule:
         return cls(events)
 
     @classmethod
+    def rolling_restart(cls, cfg, start: int = 4,
+                        spacing: int = 12) -> "Schedule":
+        """The rolling-restart drill program (round-10 elastic operations,
+        hermes_tpu/elastic/drill.py): replica i crash-restarts at step
+        ``start + i * spacing`` — every replica in sequence, each given
+        ``spacing`` rounds to rejoin and re-validate before the next one
+        dies.  Deterministic (no draws): the same config replays the same
+        program, so drill runs are byte-identical on the same seed+config
+        like every other schedule."""
+        return cls([
+            ChaosEvent(step=start + i * spacing, kind="crash_restart",
+                       replica=i)
+            for i in range(cfg.n_replicas)
+        ])
+
+    @classmethod
     def random(cls, cfg, seed: int, steps: int,
                spec: Optional[ChaosSpec] = None) -> "Schedule":
         """Seeded event program: one uniform per step selects the event

@@ -127,11 +127,14 @@ class ArrayRecorder:
             )
         self._chunks.append(chunk)
 
-    def fold_pending(self, sess, replica: int = None) -> int:
-        """Fold in-flight updates (optionally one replica's row) in as
-        maybe_w rows (they may or may not have taken effect; the checker
-        lets them linearize optionally).  Called by ``finalize`` at end of
-        run and by ``chaos.recovery.restart_replica`` at crash time."""
+    def fold_pending(self, sess, replica: int = None, mask=None) -> int:
+        """Fold in-flight updates (optionally one replica's row, or an
+        arbitrary ``(R, S)`` slot ``mask``) in as maybe_w rows (they may or
+        may not have taken effect; the checker lets them linearize
+        optionally).  Called by ``finalize`` at end of run, by
+        ``chaos.recovery.restart_replica`` at crash time, and by a range
+        migration's forced cutover (hermes_tpu.elastic) for salvaged
+        slots."""
         status = np.asarray(sess.status)
         op = np.asarray(sess.op)
         sel = (status == t.S_INFL) & ((op == t.OP_WRITE) | (op == t.OP_RMW))
@@ -139,6 +142,8 @@ class ArrayRecorder:
             keep = np.zeros_like(sel)
             keep[replica] = True
             sel = sel & keep
+        if mask is not None:
+            sel = sel & np.asarray(mask, bool)
         if sel.any():
             val = np.asarray(sess.val)[sel]
             self._chunks.append(dict(
@@ -152,6 +157,28 @@ class ArrayRecorder:
                 cmt=np.full(sel.sum(), -1, np.int64),
             ))
         return int(sel.sum())
+
+    def record_migration(self, keys, uids, vers, fcs, step: int) -> int:
+        """Seed migrated-in keys as committed writes (round-10 elastic
+        migration; same semantics as HistoryRecorder.record_migration):
+        one columnar chunk, responding at ``2*(step-1)+1`` — strictly
+        before any post-flip completion."""
+        keys = np.asarray(keys, np.int32)
+        uids = np.asarray(uids, np.int32).reshape(-1, 2)
+        n = keys.shape[0]
+        if n == 0:
+            return 0
+        self._chunks.append(dict(
+            code=np.full(n, t.C_WRITE, np.int32),
+            key=keys,
+            wlo=uids[:, 0], whi=uids[:, 1],
+            rlo=np.zeros(n, np.int32), rhi=np.zeros(n, np.int32),
+            ver=np.asarray(vers, np.int64),
+            fc=np.asarray(fcs, np.int64),
+            inv=np.full(n, step - 1, np.int64),
+            cmt=np.full(n, step - 1, np.int64),
+        ))
+        return n
 
     def finalize(self, sess=None) -> "ArrayRecorder":
         """Fold still-in-flight updates in as maybe_w rows (fold_pending);

@@ -96,15 +96,17 @@ class HistoryRecorder:
                 self.aborted_uids.add((int(wval[r, s, 0]), int(wval[r, s, 1])))
             # C_NOP: no effect on the register history
 
-    def fold_pending(self, sess, replica: int = None) -> int:
-        """Fold in-flight updates of ``sess`` (optionally one replica's row)
-        as ``maybe_w`` ops: an update still gathering acks may have been
-        applied at some replica and must be allowed — but not required — to
-        linearize.  ``finalize`` calls this once at end of run for the whole
-        cluster; ``chaos.recovery.restart_replica`` calls it at CRASH time
-        for the dying replica, whose in-flight broadcasts may still commit
-        via replay even though the client never hears back.  Returns the
-        number of ops folded."""
+    def fold_pending(self, sess, replica: int = None, mask=None) -> int:
+        """Fold in-flight updates of ``sess`` (optionally one replica's
+        row, or an arbitrary ``(R, S)`` slot ``mask``) as ``maybe_w`` ops:
+        an update still gathering acks may have been applied at some
+        replica and must be allowed — but not required — to linearize.
+        ``finalize`` calls this once at end of run for the whole cluster;
+        ``chaos.recovery.restart_replica`` calls it at CRASH time for the
+        dying replica, whose in-flight broadcasts may still commit via
+        replay even though the client never hears back; a key-range
+        migration's forced cutover (hermes_tpu.elastic) calls it with the
+        mask of salvaged slots.  Returns the number of ops folded."""
         status = np.asarray(sess.status)
         op = np.asarray(sess.op)
         key = np.asarray(sess.key)
@@ -112,7 +114,10 @@ class HistoryRecorder:
         ver = np.asarray(sess.ver)
         fc = np.asarray(sess.fc)
         inv = np.asarray(sess.invoke_step)
-        rr, ss = np.nonzero(status == t.S_INFL)
+        infl = status == t.S_INFL
+        if mask is not None:
+            infl = infl & np.asarray(mask, bool)
+        rr, ss = np.nonzero(infl)
         n = 0
         for r, s in zip(rr.tolist(), ss.tolist()):
             if replica is not None and r != replica:
@@ -125,6 +130,25 @@ class HistoryRecorder:
                        replica=r, session=s)
                 )
                 n += 1
+        return n
+
+    def record_migration(self, keys, uids, vers, fcs, step: int) -> int:
+        """Seed migrated-in keys (round-10, hermes_tpu.elastic): each key's
+        current value enters this history as a committed write — the
+        migration IS a write of the transferred value, linearized strictly
+        before any post-flip op (``step`` is the destination round of the
+        flip; the synthetic op responds at ``2*(step-1)+1``, ahead of any
+        completion of round ``step``).  ``uids`` are the re-minted
+        (lo=slot, hi<-2) migration uids the restored rows now carry, so
+        later reads observe exactly this write.  Preconditions owned by
+        the migration driver: the keys are FRESH here (no prior committed
+        ops in this history)."""
+        n = 0
+        for k, (wlo, whi), ver, fc in zip(keys, uids, vers, fcs):
+            self.ops.append(
+                Op("w", int(k), 2.0 * (step - 1), 2.0 * (step - 1) + 1,
+                   wuid=(int(wlo), int(whi)), ts=(int(ver), int(fc))))
+            n += 1
         return n
 
     def finalize(self, sess=None) -> List[Op]:

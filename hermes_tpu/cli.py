@@ -115,6 +115,17 @@ def build_parser() -> argparse.ArgumentParser:
                     "[replica] [k=v...]' lines, chaos.Schedule.parse) "
                     "instead of a seeded one; needs --steps and a fast "
                     "backend")
+    ap.add_argument("--drill", default=None,
+                    choices=["rolling", "resize", "migrate"],
+                    help="run an elastic drill (round-10, hermes_tpu."
+                    "elastic): 'rolling' crash-restarts every replica in "
+                    "sequence under load, 'resize' shrinks+grows every "
+                    "replica live through the KVS, 'migrate' moves a key "
+                    "range between two groups under client traffic; "
+                    "--check gates each with the linearizability checker, "
+                    "and the measured worst-window throughput dip is "
+                    "reported (dip_pct).  Fast backends only; resize/"
+                    "migrate need --value-words >= 3")
     ap.add_argument("--profile-out", type=str, default=None,
                     metavar="PROFILE_JSONL",
                     help="write the run config's round op census + cost-model"
@@ -135,6 +146,65 @@ MIXES = {
     "c": dict(read_frac=1.0, rmw_frac=0.0),
     "f": dict(read_frac=0.5, rmw_frac=1.0),
 }
+
+
+def _run_drill(args, cfg, mesh) -> int:
+    """Elastic drills (round-10, hermes_tpu/elastic): rolling restart /
+    rolling resize / key-range migration, checker-gated with --check,
+    worst-window dip reported.  Prints one JSON summary line."""
+    import json
+
+    from hermes_tpu import elastic
+    from hermes_tpu.checker.fast import default_record
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.runtime import FastRuntime
+
+    backend = "batched" if args.backend == "fast" else "sharded"
+    rec = default_record(args.check)
+    summary: dict = {"drill": args.drill, "backend": backend}
+
+    if args.drill == "rolling":
+        rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=rec)
+        if args.detect is not None:
+            from hermes_tpu.membership import MembershipService
+
+            rt.attach_membership(
+                MembershipService(cfg, confirm_steps=args.detect))
+        res = elastic.run_rolling_restart(
+            rt, steps=args.steps or None, check=args.check)
+        ok = (res["restarts"] == cfg.n_replicas and res.get("drained", True)
+              and res.get("checked_ok", not args.check))
+        summary.update(restarts=res["restarts"], drained=res.get("drained"),
+                       lost_ops=res["lost_ops"], dip=res["dip"],
+                       checked_ok=res.get("checked_ok"))
+    elif args.drill == "resize":
+        kvs = KVS(cfg, backend=backend, mesh=mesh, record=rec)
+        # size the standing load to outlast the whole drill (~R cycles of
+        # 2*hold_steps rounds plus per-cycle drains, up to R*S completions
+        # per round) — a load that dries up mid-drill reads as a 100% dip
+        # (load exhaustion, not service degradation)
+        rounds_est = cfg.n_replicas * (2 * 8 + 6) + 24
+        n_ops = rounds_est * cfg.n_replicas * cfg.n_sessions
+        bf = elastic.submit_drill_mix(kvs, n_ops, seed=args.seed)
+        res = elastic.rolling_resize(kvs, check=args.check)
+        kvs.run_batch(bf)
+        ok = (res["resizes"] == cfg.n_replicas and bf.all_done()
+              and res.get("checked_ok", not args.check))
+        summary.update(resizes=res["resizes"], dip=res["dip"],
+                       rejected_ops=res["rejected_ops"],
+                       load_done=bf.done_count(),
+                       checked_ok=res.get("checked_ok"))
+    else:  # migrate
+        res = elastic.migration_drill(cfg, backend=backend, mesh=mesh,
+                                      record=rec, seed=args.seed,
+                                      check=args.check)
+        ok = (res.get("src_checked_ok", not args.check)
+              and res.get("dst_checked_ok", not args.check))
+        summary.update({k: v for k, v in res.items() if k != "dest_slots"})
+
+    summary["ok"] = bool(ok)
+    print(json.dumps(summary, default=str))
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -169,6 +239,18 @@ def main(argv=None) -> int:
                  "their own configs); analyze a run config instead")
     if args.chaos is not None and args.chaos_schedule:
         ap.error("--chaos and --chaos-schedule are mutually exclusive")
+    if args.drill:
+        if args.backend not in ("fast", "fast-sharded"):
+            ap.error("--drill drives the fast runtimes (hermes_tpu."
+                     "elastic); use --backend fast or fast-sharded")
+        if args.chaos is not None or args.chaos_schedule or args.freeze:
+            ap.error("--drill and --chaos/--freeze are mutually exclusive "
+                     "(drills build their own schedules)")
+        if args.acceptance:
+            ap.error("--drill and --acceptance are mutually exclusive")
+        if args.drill in ("resize", "migrate") and args.value_words < 3:
+            ap.error(f"--drill {args.drill} drives the client KVS: needs "
+                     "--value-words >= 3 (words 0-1 carry the write uid)")
     chaos_on = args.chaos is not None or args.chaos_schedule
     if chaos_on:
         if args.backend not in ("fast", "fast-sharded"):
@@ -273,6 +355,9 @@ def main(argv=None) -> int:
             print(f"need {cfg.n_replicas} devices, have {len(devs)}", file=sys.stderr)
             return 2
         mesh = Mesh(np.array(devs), ("replica",))
+
+    if args.drill:
+        return _run_drill(args, cfg, mesh)
 
     if args.backend in ("fast", "fast-sharded"):
         backend = "batched" if args.backend == "fast" else "sharded"

@@ -1,0 +1,40 @@
+"""hermes_tpu.elastic — elastic operations as a first-class subsystem
+(round-10; ROADMAP item 5, the integration layer pod-scale key-sharded
+groups will drive through).
+
+Three legs, each composed from machinery earlier rounds built and each
+gated by the linearizability checker under pipelined client load:
+
+  1. **Live group resize** — ``FastRuntime.grow/shrink`` (+ the KVS
+     facade's client-aware versions): fence + remove with the pipeline
+     flushed and queued client traffic rejected loudly, value sync via
+     the join state-transfer path, administrative removals distinguished
+     from detector ejections on the membership log
+     (``MembershipService.note_shrink``).
+  2. **Live key-range migration** — ``migrate_range``: fence → drain →
+     snapshot (scope-tagged range archive, snapshot.save_range) →
+     transfer (uid re-mint into the migration namespace, destination
+     history seeded via ``recorder.record_migration``) → atomic routing
+     flip (keyindex.RangeRouter) → release, with ``maybe_w`` salvage for
+     ops caught mid-flip so nothing is ever silently dropped.
+  3. **Drills** — ``run_rolling_restart`` (every replica crash-restarted
+     in sequence under load) and ``rolling_resize`` (every replica
+     shrunk/grown in sequence), with the worst-window throughput dip
+     measured (``RateSampler``) and recorded as ``dip_pct``
+     (ELASTIC_SOAK.json via scripts/check_elastic.py; CHAOS_BENCH.json
+     via ``bench.py --chaos``).
+"""
+
+from hermes_tpu.elastic.drill import (
+    RateSampler,
+    migration_drill,
+    rolling_resize,
+    run_rolling_restart,
+    submit_drill_mix,
+)
+from hermes_tpu.elastic.migrate import migrate_range
+
+__all__ = [
+    "RateSampler", "migrate_range", "migration_drill", "rolling_resize",
+    "run_rolling_restart", "submit_drill_mix",
+]

@@ -1,0 +1,263 @@
+"""Elastic drills (round-10): rolling restarts, rolling resizes, and the
+migration drill — scripted production exercises of the chaos/recovery and
+elastic machinery, with the linearizability checker gating every step and
+the throughput DIP measured, not guessed.
+
+The dip number: a drill is only "live" if traffic keeps flowing, so every
+drill samples cumulative committed writes at a fixed round cadence
+(``RateSampler``) and reports the WORST window's rate against a clean
+baseline — ``dip_pct`` is the bounded-degradation number CI gates on
+(scripts/check_elastic.py → ELASTIC_SOAK.json; ``bench.py --chaos`` →
+CHAOS_BENCH.json).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class RateSampler:
+    """Cumulative committed-write samples at a fixed round cadence.
+
+    Install as a ``ChaosRunner`` ``on_step`` (or call ``note(step)`` from
+    any drive loop); each boundary does ONE counters() poll — the standard
+    Meta fetch every serving loop already pays at its own cadence."""
+
+    def __init__(self, rt, window: int):
+        if window < 1:
+            raise ValueError("window must be >= 1 round")
+        self.rt = rt
+        self.window = window
+        # (round, wall_s, cumulative committed writes)
+        self.samples: List[Tuple[int, float, int]] = []
+        self._mark()
+
+    def _mark(self) -> None:
+        c = self.rt.counters()
+        self.samples.append((self.rt.step_idx, time.perf_counter(),
+                             int(c["n_write"] + c["n_rmw"])))
+
+    def note(self, step: int) -> None:
+        if (step + 1) % self.window == 0:
+            self._mark()
+
+    def finish(self) -> None:
+        if self.samples and self.rt.step_idx > self.samples[-1][0]:
+            self._mark()
+
+    def windows(self) -> List[dict]:
+        out = []
+        for (r0, t0, w0), (r1, t1, w1) in zip(self.samples, self.samples[1:]):
+            if r1 == r0:
+                continue
+            out.append(dict(
+                rounds=(r0, r1),
+                writes=w1 - w0,
+                wall_s=round(t1 - t0, 4),
+                writes_per_sec=round((w1 - w0) / max(1e-9, t1 - t0), 1),
+            ))
+        return out
+
+    def report(self, clean_rate: Optional[float] = None) -> dict:
+        """Worst-window rate + ``dip_pct`` against ``clean_rate`` (falls
+        back to the drill's own BEST window when no clean cell ran —
+        honest about it in the record)."""
+        wins = self.windows()
+        if not wins:
+            return dict(windows=0, dip_pct=None)
+        worst = min(wins, key=lambda w: w["writes_per_sec"])
+        baseline = clean_rate
+        src = "clean_cell"
+        if baseline is None:
+            baseline = max(w["writes_per_sec"] for w in wins)
+            src = "best_window"
+        dip = 100.0 * (1.0 - worst["writes_per_sec"] / max(1e-9, baseline))
+        return dict(
+            windows=len(wins),
+            window_rounds=self.window,
+            worst_window=worst,
+            clean_rate=round(float(baseline), 1),
+            clean_rate_source=src,
+            dip_pct=round(max(0.0, dip), 1),
+        )
+
+
+def _rt_of(target):
+    return target.rt if (hasattr(target, "rt")
+                         and hasattr(target, "index")) else target
+
+
+def run_rolling_restart(target, start: int = 4, spacing: int = 12,
+                        steps: Optional[int] = None,
+                        window: Optional[int] = None,
+                        check: bool = False, heal: bool = True,
+                        clean_rate: Optional[float] = None,
+                        min_healthy: int = 2, warmup: int = 2,
+                        snapshot_path: Optional[str] = None) -> dict:
+    """Crash-restart EVERY replica in sequence under load (the rolling-
+    restart drill): replica i is crash-restarted at round ``start + i *
+    spacing`` via the chaos subsystem (full host-crash semantics — lost
+    in-flight ops fold as maybe_w, fence/remove, snapshot-or-peer restore,
+    rejoin with state transfer), while the workload keeps issuing.
+    Returns the ChaosRunner result extended with ``restarts`` (must equal
+    n_replicas for a completed drill) and the measured ``dip`` report."""
+    from hermes_tpu import chaos
+
+    rt = _rt_of(target)
+    cfg = rt.cfg
+    sched = chaos.Schedule.rolling_restart(cfg, start=start, spacing=spacing)
+    if steps is None:
+        steps = start + spacing * cfg.n_replicas + spacing
+    # warm the compiled round before the first sampled window: the first
+    # dispatch's compile wall would otherwise masquerade as the drill dip
+    step = target.step if hasattr(target, "step") else rt.step_once
+    for _ in range(warmup):
+        step()
+    sampler = RateSampler(rt, window or spacing)
+    runner = chaos.ChaosRunner(
+        target, sched, spec=chaos.ChaosSpec(min_healthy=min_healthy),
+        snapshot_path=snapshot_path, on_step=sampler.note)
+    res = runner.run(steps, heal=heal, check=check)
+    sampler.finish()
+    res["restarts"] = sum(1 for e in runner.log
+                          if e["kind"] == "crash_restart")
+    res["dip"] = sampler.report(clean_rate)
+    return res
+
+
+def submit_drill_mix(kvs, n_ops: int, seed: int = 0,
+                     read_frac: float = 0.5, lo: int = 0,
+                     hi: Optional[int] = None):
+    """Enqueue a seeded get/put mix over dense keys ``[lo, hi)`` through
+    the batched client API — the standing load every drill runs under.
+    Returns the BatchFutures (drive it with ``kvs.step()``; drills step
+    the KVS themselves)."""
+    from hermes_tpu.kvs import KVS
+
+    cfg = kvs.cfg
+    hi = cfg.n_keys if hi is None else hi
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(lo, hi, size=n_ops).astype(np.int64)
+    kinds = np.where(rng.random(n_ops) < read_frac,
+                     KVS.GET, KVS.PUT).astype(np.int32)
+    u = cfg.value_words - 2
+    values = rng.integers(0, 1 << 20, size=(n_ops, u)).astype(np.int32)
+    return kvs.submit_batch(kinds, keys, values)
+
+
+def migration_drill(cfg, backend: str = "batched", mesh=None,
+                    record=True, lo: Optional[int] = None,
+                    hi: Optional[int] = None, load_ops: int = 256,
+                    seed: int = 0, drain_steps: int = 2000,
+                    check: bool = True) -> dict:
+    """The composed live-migration drill (shared by ``cli --drill
+    migrate`` and scripts/check_elastic.py): two KVS groups + a
+    RangeRouter, a standing client mix on the source, migrate the middle
+    range under that load, then verify — post-flip reads on the
+    destination observe the migrated values, mid-drain ops landed as
+    rejected (counted, never dropped), boundary routing is exact at
+    ``lo``/``hi-1``, and BOTH groups' histories pass the checker."""
+    from hermes_tpu.keyindex import RangeRouter
+    from hermes_tpu.kvs import KVS
+
+    from hermes_tpu.elastic.migrate import migrate_range
+
+    if lo is None:
+        lo = cfg.n_keys // 3
+    if hi is None:
+        hi = 2 * cfg.n_keys // 3
+    src = KVS(cfg, backend=backend, mesh=mesh, record=record)
+    dst = KVS(cfg, backend=backend, mesh=mesh, record=record)
+    router = RangeRouter(cfg.n_keys, default_group=0)
+
+    # seed the range with known values, then keep a mixed load running
+    seed_bf = submit_drill_mix(src, load_ops, seed=seed, read_frac=0.0)
+    if not src.run_batch(seed_bf):
+        raise RuntimeError("migration drill: seed load did not drain")
+    live_bf = submit_drill_mix(src, load_ops, seed=seed + 1)
+    for _ in range(4):
+        src.step()
+
+    res = migrate_range(src, dst, lo, hi, router=router, dst_group=1,
+                        drain_steps=drain_steps)
+    # the standing load keeps issuing around the moved range
+    src.run_batch(live_bf)
+    src.flush()
+
+    codes = np.asarray(live_bf.code)
+    from hermes_tpu import kvs as kvs_lib
+
+    res["live_rejected"] = int((codes == kvs_lib.C_REJECTED).sum())
+    res["live_lost"] = int((codes == kvs_lib.C_LOST).sum())
+    res["live_done"] = int(live_bf.done_count())
+    if not live_bf.all_done():
+        raise RuntimeError("migration drill: standing load stranded "
+                           f"{len(live_bf) - live_bf.done_count()} op(s)")
+
+    # boundary exactness + post-flip service
+    assert int(router.owner(lo)) == 1 and int(router.owner(hi - 1)) == 1
+    if lo > 0:
+        assert int(router.owner(lo - 1)) == 0
+    if hi < cfg.n_keys:
+        assert int(router.owner(hi)) == 0
+    probe = [lo, (lo + hi) // 2, hi - 1]
+    futs = [dst.get(0, i % cfg.n_sessions, k) for i, k in enumerate(probe)]
+    if not dst.run_until(futs):
+        raise RuntimeError("migration drill: destination reads stalled")
+    res["dst_reads"] = len(probe)
+    rej = src.get(0, 0, lo)
+    assert rej.done() and rej.result().kind == "rejected"
+
+    if check and record:
+        for name, g in (("src", src), ("dst", dst)):
+            v = g.rt.check()
+            res[f"{name}_checked_ok"] = bool(v.ok)
+            if not v.ok:
+                res[f"{name}_check_failures"] = [
+                    getattr(f, "reason", str(f))[:200]
+                    for f in (v.failures + v.undecided)[:3]]
+    return res
+
+
+def rolling_resize(kvs, hold_steps: int = 8, window: Optional[int] = None,
+                   check: bool = False,
+                   clean_rate: Optional[float] = None) -> dict:
+    """Live resize drill: every replica is shrunk out of the group (fence
+    + drain its client ops + remove from quorums) and grown back (value
+    sync via join state transfer) in sequence, while the other replicas'
+    sessions keep issuing.  Zero checker impact by construction — shrink
+    drains to normal completion; nothing is salvaged or lost."""
+    from hermes_tpu.kvs import KVS
+
+    if not isinstance(kvs, KVS):
+        raise TypeError("rolling_resize drives the client layer (kvs.KVS)")
+    rt = kvs.rt
+    for _ in range(2):  # compile outside the first sampled window
+        kvs.step()
+    sampler = RateSampler(rt, window or hold_steps)
+    cycles = []
+    for r in range(rt.cfg.n_replicas):
+        t0 = rt.step_idx
+        kvs.shrink(r)
+        for s in range(hold_steps):
+            kvs.step()
+            sampler.note(rt.step_idx - 1)
+        kvs.grow(r)
+        for s in range(hold_steps):
+            kvs.step()
+            sampler.note(rt.step_idx - 1)
+        cycles.append(dict(replica=r, rounds=rt.step_idx - t0))
+    sampler.finish()
+    res: dict = dict(cycles=cycles, resizes=len(cycles),
+                     rejected_ops=kvs.rejected_ops,
+                     dip=sampler.report(clean_rate))
+    if check:
+        v = rt.check()
+        res["checked_ok"] = bool(v.ok)
+        res["check_failures"] = [
+            getattr(f, "reason", str(f))[:200]
+            for f in (v.failures + v.undecided)[:3]]
+    return res

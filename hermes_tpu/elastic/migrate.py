@@ -1,0 +1,275 @@
+"""Live key-range migration (round-10): move a dense key-slot range
+between replica groups under traffic, with the checker green throughout.
+
+Hermes coordinates per key (PAPER.md), so a key range can change owner
+without stopping the world — the prerequisite for pod-scale key-sharded
+groups (ROADMAP item 2).  ``migrate_range`` composes machinery earlier
+rounds built into the drill:
+
+  fence    — the router marks the range draining and the source KVS
+             rejects new ops on it loudly (kind='rejected'; never entered
+             the store, so zero history impact);
+  drain    — the source steps until no client op on the range is in
+             flight (the round-8 pipeline flush semantics: every
+             already-produced completion lands first).  Ops that cannot
+             drain are SALVAGED, never dropped: recorder folds them as
+             ``maybe_w`` (their broadcast may yet commit via replay; the
+             checker may — but need not — linearize them), futures
+             resolve kind='lost', session/replay slots are wiped
+             (chaos.recovery.wipe_volatile);
+  snapshot — just the range's table rows, normalized to canonical
+             committed form, into a scope-tagged checksummed archive
+             (snapshot.save_range; ``load`` refuses to treat it as
+             crash-recovery state);
+  transfer — rows are re-minted with migration write uids
+             (lo=dest_slot, hi=-(2+dst_step)) so the destination's
+             checker sees the migration as ONE synthetic committed write
+             per key (recorder.record_migration), linearized strictly
+             before any post-flip op — uid spaces of the two groups never
+             alias;
+  restore  — rows land in the destination table (every replica copy),
+             the destination's version re-anchoring (``_ver_base``)
+             adopts the source's cumulative deltas so recorded versions
+             stay globally monotone across the move;
+  flip     — the router moves ownership and clears the drain in ONE host
+             update (no lookup can observe the half-flipped state); the
+             source's fence stays forever — the keys live elsewhere now;
+  release  — the destination serves the range (it was never fenced
+             there).
+
+Sparse-key mode re-maps through the key indexes: each migrated slot's
+client key allocates a fresh dense slot in the destination's KeyIndex, so
+the two groups' slot spaces stay independent.
+
+Failure discipline: everything refusable is refused BEFORE the fence
+(destination capacity/freshness, mode mismatch), so a rejected migration
+has zero side effects; an error after fencing but before the flip takes
+the ABORT path — the fence and router drain release and the source keeps
+serving the range (already-salvaged ops stay honestly lost).  Only a
+completed flip leaves the source fenced for good.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+import jax
+import numpy as np
+
+from hermes_tpu import snapshot as snapshot_lib
+from hermes_tpu.core import types as t
+
+
+def _kvs_of(target):
+    if hasattr(target, "rt") and hasattr(target, "index"):
+        return target, target.rt
+    raise TypeError(
+        "migrate_range drives the client layer (kvs.KVS): fencing and "
+        "salvage are client-visible contracts, not runtime internals")
+
+
+def _donor_base(rt) -> int:
+    """Flat-row offset of the donor replica's table copy (0 when the
+    authoritative table is shared — the batched engine)."""
+    K = rt.cfg.n_keys
+    if rt.fs.table.vpts.shape[0] == K:
+        return 0
+    live = int(rt.live[0])
+    cands = [r for r in range(rt.cfg.n_replicas)
+             if (live >> r) & 1 and not rt.frozen[r]]
+    if not cands:
+        raise RuntimeError("migration needs a live unfrozen source replica")
+    return cands[0] * K
+
+
+def _normalize_range(rt, lo: int, hi: int) -> None:
+    """Rewrite the range's rows to canonical committed form on every
+    replica copy: state VALID, row pts mirroring vpts, one uniform sst
+    step.  After a clean drain this is semantically a no-op (the rows are
+    already converged VALID); after a forced salvage it DECIDES the
+    salvaged ``maybe_w`` ops as applied-at-cutover — one of the outcomes
+    the checker allows them — and re-converges replica copies whose sst
+    bytes differ (coordinator WRITE vs peer INVALID)."""
+    from hermes_tpu.core import faststep as fst
+
+    n = hi - lo
+    base = _donor_base(rt)
+    vpts = np.asarray(jax.device_get(
+        jax.lax.dynamic_slice_in_dim(rt.fs.table.vpts, base + lo, n)))
+    bank = np.asarray(jax.device_get(
+        jax.lax.dynamic_slice_in_dim(rt.fs.table.bank, base + lo, n)))
+    rows32 = snapshot_lib._rows_to_i32(bank).copy()
+    rows32[:, fst.BANK_PTS] = vpts
+    rows32[:, fst.BANK_SST] = (rt.step_idx << fst.SST_STEP_SHIFT) | t.VALID
+    snapshot_lib.write_rows(rt, np.arange(lo, hi), vpts, rows32)
+
+
+def migrate_range(src, dst, lo: int, hi: int, router=None,
+                  dst_group: int = 1, path: Optional[str] = None,
+                  drain_steps: int = 2000, force: bool = False) -> dict:
+    """Move dense slots ``[lo, hi)`` from the ``src`` KVS group to ``dst``
+    (module docstring: fence → drain → snapshot → transfer → flip →
+    release).  ``router`` (keyindex.RangeRouter, optional) carries the
+    fleet-level routing flip; ``path`` keeps the transfer archive
+    (default: a temp file, removed after restore).  ``force`` salvages
+    ops that fail to drain within ``drain_steps`` instead of raising.
+    Returns a summary dict (also traced as ``migrate_out``/``migrate_in``
+    obs events on the two runtimes)."""
+    src_kvs, src_rt = _kvs_of(src)
+    dst_kvs, dst_rt = _kvs_of(dst)
+    if src_rt.cfg.value_words != dst_rt.cfg.value_words:
+        raise ValueError("source and destination value_words differ; rows "
+                         "are not portable across value widths")
+    if (src_kvs.index is None) != (dst_kvs.index is None):
+        raise ValueError("source and destination must agree on sparse-key "
+                         "mode (the client-key remap needs both indexes)")
+    if not (0 <= lo < hi <= src_rt.cfg.n_keys):
+        raise ValueError(f"range [{lo}, {hi}) outside "
+                         f"[0, {src_rt.cfg.n_keys})")
+
+    # -- validate the DESTINATION before any destructive step: a migration
+    # that can be refused must be refused BEFORE the fence rejects client
+    # ops and the salvage loses in-flight ones.  A slot with committed
+    # writes already has history the preload would contradict (a key must
+    # live in exactly one group); nothing steps either group between here
+    # and the restore, so the check cannot go stale.
+    from hermes_tpu.core import faststep as fst
+
+    dbase = _donor_base(dst_rt)
+    fresh_err = ("destination slots are not fresh (committed writes "
+                 "present); a key must live in exactly one group")
+    if src_kvs.index is None:
+        if hi > dst_rt.cfg.n_keys:
+            raise ValueError(
+                f"dense migration needs destination n_keys >= {hi}")
+        dst_vpts = np.asarray(jax.device_get(jax.lax.dynamic_slice_in_dim(
+            dst_rt.fs.table.vpts, dbase + lo, hi - lo)))
+        if (dst_vpts != 0).any():
+            raise ValueError(fresh_err)
+    else:
+        if hi > src_kvs.index.n_used:
+            raise ValueError(
+                f"range [{lo}, {hi}) reaches past the source's allocated "
+                f"slot frontier ({src_kvs.index.n_used}); migrate "
+                "allocated ranges only")
+        # client keys already present in the destination index must sit on
+        # never-written slots (keys newly allocated at transfer time are
+        # fresh by construction)
+        pre_keys = np.array(
+            [src_kvs.index.key_of(s) for s in range(lo, hi)], np.uint64)
+        got = dst_kvs.index.get_slots(pre_keys, insert=False)
+        n_new = int((got < 0).sum())
+        if dst_kvs.index.n_used + n_new > dst_rt.cfg.n_keys:
+            raise ValueError(
+                f"sparse migration needs {n_new} fresh destination slot(s) "
+                f"but the destination index holds {dst_kvs.index.n_used} of "
+                f"n_keys={dst_rt.cfg.n_keys}; size the destination to the "
+                "combined working set")
+        present = got[got >= 0].astype(np.int64)
+        if present.size:
+            dst_vpts = np.asarray(jax.device_get(dst_rt.fs.table.vpts))
+            if (dst_vpts[dbase + present] != 0).any():
+                raise ValueError(fresh_err)
+
+    summary: dict = dict(lo=lo, hi=hi, rows=hi - lo)
+    flipped = False
+    tmp_dir = None
+    try:
+        # -- fence: reject-new on the range ---------------------------------
+        src_kvs.drill_phase = "fence"
+        if router is not None:
+            router.begin_drain(lo, hi)
+        summary["rejected_at_fence"] = src_kvs.fence_slots(lo, hi)
+        src_rt._trace("migrate_fence", lo=lo, hi=hi)
+
+        # -- drain: flush in-flight range ops to normal completion ----------
+        src_kvs.drill_phase = "drain"
+        drained = False
+        for _ in range(drain_steps):
+            if src_kvs.range_inflight(lo, hi) == 0:
+                drained = True
+                break
+            src_kvs.step()
+        src_kvs.flush()
+        src_rt.flush_pipeline()
+        if not drained and src_kvs.range_inflight(lo, hi) and not force:
+            raise RuntimeError(
+                f"range [{lo}, {hi}) did not drain in {drain_steps} rounds "
+                f"({src_kvs.range_inflight(lo, hi)} op(s) still in flight); "
+                "pass force=True to salvage them as maybe_w/lost")
+        # forced cutover: whatever still holds the range is salvaged —
+        # maybe_w history rows + loudly-lost futures + volatile wipe.  In
+        # the clean path this also clears orphaned replay slots on the
+        # range (a post-flip replay commit would mutate rows the
+        # destination already copied).
+        summary["salvaged"] = src_kvs.salvage_slots(lo, hi)
+        summary["drained"] = drained
+
+        # -- snapshot: canonical rows, scope-tagged archive -----------------
+        _normalize_range(src_rt, lo, hi)
+        if path is None:
+            tmp_dir = tempfile.mkdtemp(prefix="hermes_migrate_")
+            path = os.path.join(tmp_dir, f"range_{lo}_{hi}.npz")
+        manifest = snapshot_lib.save_range(path, src_rt, lo, hi)
+        summary["archive_step"] = manifest["step"]
+
+        # -- transfer: verify + read back + re-map + re-mint uids -----------
+        _m, slots, vpts, rows32, ver_base = snapshot_lib.read_range(path)
+        if src_kvs.index is not None:
+            # sparse: each migrated client key allocates a fresh dense slot
+            # in the destination's index (slot spaces stay independent);
+            # pre_keys is the validation pass's key list for these exact
+            # slots — nothing stepped either group since
+            dest_slots = dst_kvs.index.get_slots(pre_keys).astype(np.int64)
+        else:
+            dest_slots = slots
+        rows32 = rows32.copy()
+        mig_hi = -(2 + dst_rt.step_idx)  # migration uid namespace: hi <= -2
+        rows32[:, fst.BANK_VAL] = dest_slots.astype(np.int32)
+        rows32[:, fst.BANK_VAL + 1] = np.int32(mig_hi)
+        uids = np.stack([dest_slots.astype(np.int32),
+                         np.full(dest_slots.size, mig_hi, np.int32)], axis=1)
+
+        # -- restore: rows + version re-anchoring + history preload ---------
+        snapshot_lib.write_rows(dst_rt, dest_slots, vpts, rows32)
+        snapshot_lib.anchor_ver_base(dst_rt, dest_slots, ver_base)
+        if dst_rt.recorder is not None:
+            vers = (vpts.astype(np.int64) >> fst.PTS_FC_BITS) + ver_base
+            fcs = vpts.astype(np.int64) & fst.FC_MASK
+            dst_rt.recorder.record_migration(
+                dest_slots, uids, vers, fcs, dst_rt.step_idx)
+
+        # -- flip: atomic routing cutover -----------------------------------
+        src_kvs.drill_phase = "flip"
+        if router is not None:
+            router.flip(lo, hi, dst_group)
+        flipped = True
+        src_rt._trace("migrate_out", lo=lo, hi=hi, rows=hi - lo,
+                      salvaged=summary["salvaged"])
+        dst_rt._trace("migrate_in", lo=lo, hi=hi, rows=hi - lo,
+                      step=dst_rt.step_idx)
+    except BaseException:
+        # abort path: the keys STAY with the source — un-fence the range
+        # and clear the router drain so it is not permanently unavailable.
+        # Ops already salvaged are honestly lost (their maybe_w rows
+        # stand); rows already restored into the destination are
+        # unreachable (routing never flipped) — a retry must target a
+        # fresh destination.
+        if not flipped:
+            src_kvs.release_slots(lo, hi)
+            if router is not None:
+                router.release(lo, hi)
+        raise
+    finally:
+        src_kvs.drill_phase = None
+        if tmp_dir is not None:
+            # the transfer archive is a byproduct, not an artifact: remove
+            # it on every exit path (a caller-supplied path is kept)
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+    summary["dest_lo"] = int(dest_slots.min())
+    summary["dest_hi"] = int(dest_slots.max()) + 1
+    summary["dest_slots"] = dest_slots
+    return summary

@@ -51,6 +51,13 @@ from hermes_tpu.runtime import FastRuntime
 # client is told loudly instead of waiting forever.  Negative on purpose —
 # it can never collide with the device C_* codes (types.py, all >= 0).
 C_LOST = -2
+# client-level completion code for ops REJECTED by elastic operations
+# (round-10, hermes_tpu/elastic): the op targeted a retired replica or a
+# key range that is draining/migrated away.  The op never entered the
+# store (no history impact) — the client retries against the range's new
+# owner (keyindex.RangeRouter names it).  Distinct from C_LOST: a
+# rejected op definitively did NOT happen; a lost op is a maybe.
+C_REJECTED = -3
 
 
 class StuckOpError(RuntimeError):
@@ -64,7 +71,9 @@ class StuckOpError(RuntimeError):
             f"{len(diagnostics)} client op(s) stuck past op_timeout_rounds: "
             + "; ".join(
                 f"r{d['replica']}/s{d['session']} {d['kind']} key={d['key']} "
-                f"phase={d['phase']} age={d['age_rounds']}"
+                f"phase={d['phase']}"
+                + (f" drill={d['drill']}" if "drill" in d else "")
+                + f" age={d['age_rounds']}"
                 for d in diagnostics[:4]))
 
 
@@ -72,7 +81,10 @@ class StuckOpError(RuntimeError):
 class Completion:
     """Result of one client op."""
 
-    kind: str  # 'get' | 'put' | 'rmw' | 'rmw_abort' | 'lost' (replica crash)
+    # 'get' | 'put' | 'rmw' | 'rmw_abort' | 'lost' (replica crash; op MAY
+    # have applied) | 'rejected' (elastic fence/retire; op definitively
+    # did NOT apply — retry against the range's new owner)
+    kind: str
     key: int
     value: Optional[List[int]] = None  # payload read (get / rmw read-part)
     uid: Optional[Tuple[int, int]] = None  # unique id of the written value
@@ -142,6 +154,9 @@ class BatchFutures:
         c = int(self.code[i])
         if c == C_LOST:
             return Completion(kind="lost", key=int(self.key[i]),
+                              step=int(self.step[i]), found=False)
+        if c == C_REJECTED:
+            return Completion(kind="rejected", key=int(self.key[i]),
                               step=int(self.step[i]), found=False)
         kind = ("rmw_abort" if c == t.C_RMW_ABORT
                 else self._KINDSTR[int(self.kind[i])])
@@ -239,6 +254,18 @@ class KVS:
         self._stuck_flagged: set = set()
         self.stuck_ops: List[dict] = []
         self.strict_timeouts = strict_timeouts
+        # elastic operations (round-10, hermes_tpu/elastic): replicas
+        # retired by a live shrink accept no new ops (their queued/future
+        # traffic is rejected loudly); fenced dense-slot ranges are
+        # draining or migrated away — ops on them reject with
+        # kind='rejected' instead of entering a store that no longer (or
+        # soon won't) own the key.  drill_phase tags the active drill
+        # stage (fence/drain/flip) into stuck-op diagnostics so a wedged
+        # op is attributable from the timeline alone.
+        self._retired: set = set()
+        self._fence_mask = np.zeros(cfg.n_keys, bool)
+        self.drill_phase: Optional[str] = None
+        self.rejected_ops = 0
         # sparse-key mode (SURVEY.md §1 L2, MICA-index parity): arbitrary
         # 64-bit client keys map to dense device slots through an exact
         # open-addressing index (hermes_tpu/keyindex.py); completions
@@ -280,11 +307,22 @@ class KVS:
             if not (0 <= key < cfg.n_keys):
                 raise ValueError(f"key {key} out of range [0, {cfg.n_keys})")
             client_key, slot = int(key), int(key)
+        if replica in self._retired or self._fence_mask[slot]:
+            # elastic rejection (round-10): retired replica or fenced /
+            # migrated-away range — the op never enters the store; the
+            # client is told NOW, not stranded
+            return self._rejected_future(client_key)
         fut = Future()
         self._queues[(replica, session)].append((kind, slot, client_key, value, fut))
         self._queued_slots.add((replica, session))
         if (replica, session) not in self._inflight:
             self._ready.add((replica, session))
+        return fut
+
+    def _rejected_future(self, client_key: int) -> Future:
+        self.rejected_ops += 1
+        fut = Future()
+        fut._result = Completion(kind="rejected", key=client_key, found=False)
         return fut
 
     def get(self, replica: int, session: int, key: int) -> Future:
@@ -361,6 +399,15 @@ class KVS:
                 raise ValueError(
                     f"keys out of range [0, {self.cfg.n_keys})")
             slots = keys_arr.astype(np.int32)
+        if self._fence_mask.any():
+            # elastic rejection (round-10): ops on fenced / migrated-away
+            # slots complete immediately as C_REJECTED — never injected,
+            # never silently dropped
+            fenced = (bf.code == 0) & self._fence_mask[slots]
+            if fenced.any():
+                bf.code[fenced] = C_REJECTED
+                bf.found[fenced] = False
+                self.rejected_ops += int(fenced.sum())
         pend = np.nonzero(bf.code == 0)[0].astype(np.int32)
         if pend.size:
             self._bat[self._next_bid] = dict(
@@ -380,6 +427,8 @@ class KVS:
 
     def _inject_batches(self) -> None:
         free = self._kindarr == t.OP_NOP
+        for r in self._retired:
+            free[r] = False  # retired replicas accept no new injections
         if self._depth > 1:
             # pipelined: a slot retired at the last sync point but whose
             # resolution is still deferred looks NOP here — it must keep
@@ -430,10 +479,32 @@ class KVS:
             q = self._queues.get(rs_key)
             if rs_key in self._inflight or not q:
                 continue
+            if rs_key[0] in self._retired:
+                # the replica retired after these ops were queued: reject
+                # them loudly (shrink() sweeps too; this covers races)
+                while q:
+                    _k, _sl, ck, _v, fut = q.popleft()
+                    fut._result = Completion(kind="rejected", key=ck,
+                                             found=False)
+                    self.rejected_ops += 1
+                self._queued_slots.discard(rs_key)
+                continue
             if self._slot_bid[rs_key] >= 0:
                 waiting.add(rs_key)
                 continue
             kind, slot, client_key, value, fut = q.popleft()
+            if self._fence_mask[slot]:
+                # the range fenced after this op was queued (fence_slots
+                # sweeps the queues, but an op enqueued mid-drain by a
+                # client callback lands here): reject, keep the slot ready
+                # for whatever sits behind it in the queue
+                if not q:
+                    self._queued_slots.discard(rs_key)
+                fut._result = Completion(kind="rejected", key=client_key,
+                                         found=False)
+                self.rejected_ops += 1
+                waiting.add(rs_key)
+                continue
             if not q:
                 self._queued_slots.discard(rs_key)
             r, s = rs_key
@@ -590,6 +661,10 @@ class KVS:
                 age_rounds=int(age[r, s]),
                 at_step=self.rt.step_idx,
             )
+            if self.drill_phase is not None:
+                # an elastic drill (fence/drain/flip) is active: a wedged
+                # op must be attributable to it from the timeline alone
+                diag["drill"] = self.drill_phase
             new_diags.append(diag)
             self.stuck_ops.append(diag)
             self.rt._trace("stuck_op", **diag)
@@ -671,6 +746,187 @@ class KVS:
             self.step()
         self.flush()  # pipelined: the last round's resolution may be deferred
         return all(f.done() for f in futures)
+
+    # -- elastic operations (round-10, hermes_tpu/elastic) -------------------
+
+    def fence_slots(self, lo: int, hi: int) -> int:
+        """Reject-new over dense slots ``[lo, hi)`` — the first step of a
+        key-range migration's drain.  Queued-but-uninjected ops on the
+        range are rejected NOW (their futures resolve kind='rejected');
+        in-flight ops keep running (drain flushes them).  The fence stays
+        until ``release_slots`` — after a flip it stays forever on the
+        source: the range has a new owner.  Returns the number of queued
+        ops rejected.  Sparse-key mode requires ``hi <= len(index)``:
+        fresh client keys allocate slots at the dense frontier, and a
+        fence over unallocated slots would let new keys land INSIDE a
+        draining range."""
+        if not (0 <= lo < hi <= self.cfg.n_keys):
+            raise ValueError(f"range [{lo}, {hi}) outside "
+                             f"[0, {self.cfg.n_keys})")
+        if self.index is not None and hi > self.index.n_used:
+            raise ValueError(
+                f"fence [{lo}, {hi}) reaches past the allocated slot "
+                f"frontier ({self.index.n_used}): a fresh sparse key could "
+                "allocate into the draining range; migrate allocated "
+                "ranges only")
+        self._fence_mask[lo:hi] = True
+        rejected = 0
+        # sweep queued per-op traffic on the range
+        for rs_key in list(self._queued_slots):
+            q = self._queues[rs_key]
+            keep = collections.deque()
+            while q:
+                item = q.popleft()
+                if lo <= item[1] < hi:
+                    item[4]._result = Completion(kind="rejected",
+                                                 key=item[2], found=False)
+                    rejected += 1
+                else:
+                    keep.append(item)
+            if keep:
+                self._queues[rs_key] = keep
+            else:
+                self._queued_slots.discard(rs_key)
+        # sweep staged-but-uninjected batch items on the range
+        for bid, b in list(self._bat.items()):
+            n = b["opc"].shape[0]
+            idx = np.arange(n)
+            rej = (idx >= b["cursor"]) & (b["slots"] >= lo) & (b["slots"] < hi)
+            if rej.any():
+                bf: BatchFutures = b["bf"]
+                bf.code[b["gix"][rej]] = C_REJECTED
+                bf.found[b["gix"][rej]] = False
+                rejected += int(rej.sum())
+                keep = ~rej
+                for f in ("opc", "slots", "uval", "gix"):
+                    b[f] = b[f][keep]
+                if b["cursor"] >= b["opc"].shape[0] and bf.all_done():
+                    del self._bat[bid]
+        self.rejected_ops += rejected
+        return rejected
+
+    def release_slots(self, lo: int, hi: int) -> None:
+        """Clear a fence (migration abort path — after a flip the source's
+        fence stays: the keys live elsewhere now)."""
+        self._fence_mask[lo:hi] = False
+
+    def range_inflight(self, lo: int, hi: int) -> int:
+        """Client ops currently in flight whose dense slot is in
+        ``[lo, hi)`` — the drain-progress poll of a range migration."""
+        active = self._kindarr != t.OP_NOP
+        in_range = (self._key[:, :, 0] >= lo) & (self._key[:, :, 0] < hi)
+        return int(np.count_nonzero(active & in_range))
+
+    def salvage_slots(self, lo: int, hi: int) -> int:
+        """Forced cutover (round-10): client ops on ``[lo, hi)`` that did
+        NOT drain are salvaged, never silently dropped — the recorder folds
+        still-in-flight updates as ``maybe_w`` (their broadcast may yet
+        commit via replay; the checker may — but need not — linearize
+        them), their futures resolve loudly as kind='lost', and their
+        session/replay slots lose their volatile state exactly like a
+        crash (chaos.recovery.wipe_volatile) so the range's coordination
+        dies with the migration.  Returns the number of ops salvaged."""
+        from hermes_tpu.chaos import recovery as recovery_lib
+
+        rt = self.rt
+        rt.flush_pipeline()  # land every already-produced completion first
+        key = self._key[:, :, 0]
+        mask = (self._kindarr != t.OP_NOP) & (key >= lo) & (key < hi)
+        if rt.recorder is not None and mask.any():
+            rt.recorder.fold_pending(rt._sess_view(), mask=mask)
+        # replay slots re-broadcasting range keys die with the cutover: a
+        # post-flip replay commit on the source would change rows the
+        # destination already copied
+        rp_key = np.asarray(jax.device_get(rt.fs.replay.key))
+        rp_active = np.asarray(jax.device_get(rt.fs.replay.active))
+        replay_mask = rp_active & (rp_key >= lo) & (rp_key < hi)
+        salvaged = 0
+        if mask.any() or replay_mask.any():
+            recovery_lib.wipe_volatile(rt, mask, replay_mask)
+        if mask.any():
+            for r, s in np.argwhere(mask):
+                r, s = int(r), int(s)
+                if (r, s) in self._inflight:
+                    _kind, fut, ck = self._inflight.pop((r, s))
+                    fut._result = Completion(kind="lost", key=ck, found=False)
+                    salvaged += 1
+                elif self._slot_bid[r, s] >= 0:
+                    bid = int(self._slot_bid[r, s])
+                    b = self._bat.get(bid)
+                    if b is not None:
+                        bf: BatchFutures = b["bf"]
+                        gi = int(self._slot_bix[r, s])
+                        bf.code[gi] = C_LOST
+                        bf.found[gi] = False
+                        if b["cursor"] >= b["opc"].shape[0] and bf.all_done():
+                            del self._bat[bid]
+                    self._slot_bid[r, s] = -1
+                    salvaged += 1
+            rows, cols = np.nonzero(mask)
+            self._op[rows, cols, 0] = t.OP_NOP
+            self._kindarr[rows, cols] = t.OP_NOP
+            self._slot_inject[rows, cols] = -1
+            self._dirty = True
+            # freed slots with queued per-op traffic become injectable
+            # again (the same re-ready _on_replica_crash does): without
+            # this, an op queued BEHIND a salvaged one would strand —
+            # _ready is only refreshed on the empty->nonempty enqueue
+            # transition and at completion of the op it waited behind
+            for rs_key in self._queued_slots:
+                if mask[rs_key]:
+                    self._ready.add(rs_key)
+        return salvaged
+
+    def _replica_busy(self, replica: int) -> bool:
+        return (any(rs[0] == replica for rs in self._inflight)
+                or bool((self._slot_bid[replica] >= 0).any()))
+
+    def shrink(self, replica: int, drain_steps: int = 2000) -> None:
+        """Live resize OUT under traffic: retire ``replica`` (no new
+        injections; its queued ops reject loudly), drain its in-flight
+        client ops to normal completion — zero checker impact — then
+        fence + remove it from quorums (FastRuntime.shrink).  A replica
+        that cannot drain (its quorum is gone) raises rather than
+        silently wedging; crash-restart it instead."""
+        if not (int(self.rt.live[0]) >> replica) & 1:
+            # validate BEFORE mutating client state: retiring a non-live
+            # replica would reject its traffic forever while the runtime
+            # (rejoined by heal/crash-restart, which never touch the KVS
+            # retirement set) says it is serving
+            raise ValueError(f"replica {replica} is not live")
+        self._retired.add(replica)
+        # reject queued traffic targeted at the retiring replica
+        for rs_key in list(self._queued_slots):
+            if rs_key[0] != replica:
+                continue
+            q = self._queues[rs_key]
+            while q:
+                _k, _sl, ck, _v, fut = q.popleft()
+                fut._result = Completion(kind="rejected", key=ck, found=False)
+                self.rejected_ops += 1
+            self._queued_slots.discard(rs_key)
+        for _ in range(drain_steps):
+            if not self._replica_busy(replica):
+                break
+            self.step()
+        else:
+            self._retired.discard(replica)
+            raise RuntimeError(
+                f"shrink: replica {replica} did not drain its in-flight "
+                f"ops in {drain_steps} rounds (quorum gone?); use "
+                "chaos.restart_replica for a non-cooperative removal")
+        self.flush()
+        self.rt.shrink(replica)
+
+    def grow(self, replica: int, from_replica: Optional[int] = None) -> None:
+        """Live resize IN: value-sync via the join state-transfer path,
+        re-admit into quorums, and resume accepting client ops."""
+        self.rt.grow(replica, from_replica)
+        self._retired.discard(replica)
+        # slots freed while retired may hold queued traffic again
+        for rs_key in self._queued_slots:
+            if rs_key[0] == replica and rs_key not in self._inflight:
+                self._ready.add(rs_key)
 
     # -- crash support (chaos.recovery.restart_replica) ----------------------
 

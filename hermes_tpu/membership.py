@@ -54,7 +54,10 @@ from hermes_tpu.config import HermesConfig
 @dataclasses.dataclass
 class MembershipEvent:
     step: int
-    kind: str  # 'remove' | 'join' (suspect/suspect_clear are timeline-only)
+    # 'remove' (detector-driven) | 'join' | 'shrink' (administrative
+    # removal, round-10 elastic resize); suspect/suspect_clear are
+    # timeline-only
+    kind: str
     replica: int
     live_mask: int
 
@@ -172,4 +175,15 @@ class MembershipService:
         self._joined_at[replica] = rt.step_idx
         self.events.append(
             MembershipEvent(rt.step_idx, "join", replica, int(rt.live[0]))
+        )
+
+    def note_shrink(self, rt, replica: int) -> None:
+        """Administrative removal (round-10 elastic resize: the runtime's
+        ``shrink`` fenced + removed the replica deliberately).  Clears any
+        live suspicion and logs the event as ``shrink`` so the membership
+        log attributes the removal to the operator, not the detector."""
+        self.suspects.pop(replica, None)
+        self._joined_at.pop(replica, None)
+        self.events.append(
+            MembershipEvent(rt.step_idx, "shrink", replica, int(rt.live[0]))
         )

@@ -54,6 +54,54 @@ class _ObsHooks:
             self.obs.tracer.event(name, step=self.step_idx, **fields)
 
 
+class _ElasticResize:
+    """Live group resize (round-10, hermes_tpu/elastic): administrative
+    grow/shrink of the replica set under traffic, shared by both run
+    drivers.  ``shrink`` composes the existing fence+remove (a removed
+    replica self-fences and quorums re-evaluate against the shrunken
+    mask); ``grow`` composes the existing join-with-state-transfer
+    (value sync from a live donor, coordinator/replay re-validation of
+    the donor's in-flight keys).  Both flush the serving pipeline first
+    so every completion of the old quorum era lands before the epoch
+    bumps, and both land on the obs timeline — distinct from detector-
+    driven removals, which trace as suspect→remove."""
+
+    def shrink(self, replica: int) -> None:
+        """Resize OUT: fence + remove ``replica`` from every quorum.  The
+        membership service (if attached) logs the removal as
+        administrative (``note_shrink``) so a timeline reader can tell a
+        planned shrink from a detector ejection."""
+        if not (int(self.live[0]) >> replica) & 1:
+            raise ValueError(f"replica {replica} is not live")
+        if hasattr(self, "flush_pipeline"):
+            self.flush_pipeline()
+        self.remove(replica)
+        if self.membership is not None:
+            self.membership.note_shrink(self, replica)
+        self._trace("shrink", replica=replica, live_mask=int(self.live[0]))
+
+    def grow(self, replica: int, from_replica: Optional[int] = None) -> None:
+        """Resize IN: value-sync ``replica`` from a live unfrozen donor
+        (default: the lowest) via the join state-transfer path and
+        re-admit it into quorums."""
+        if (int(self.live[0]) >> replica) & 1 and not self.frozen[replica]:
+            raise ValueError(f"replica {replica} is already live")
+        if from_replica is None:
+            live = int(self.live[0])
+            cands = [d for d in range(self.cfg.n_replicas)
+                     if d != replica and (live >> d) & 1
+                     and not self.frozen[d]]
+            if not cands:
+                raise RuntimeError("grow needs a live unfrozen donor; "
+                                   "none left")
+            from_replica = cands[0]
+        if hasattr(self, "flush_pipeline"):
+            self.flush_pipeline()
+        self.join(replica, from_replica)
+        self._trace("grow", replica=replica, donor=from_replica,
+                    live_mask=int(self.live[0]))
+
+
 def _sum_meta_counters(m) -> dict:
     """Shared ``counters()`` body of both runtimes (round-8 satellite):
     the Meta tree is fetched ONCE by the caller; this just sums the
@@ -69,7 +117,7 @@ def _sum_meta_counters(m) -> dict:
     )
 
 
-class Runtime(_ObsHooks):
+class Runtime(_ObsHooks, _ElasticResize):
     def __init__(
         self,
         cfg: HermesConfig,
@@ -298,7 +346,7 @@ def _to_jnp(block):
     return jax.tree.map(jnp.asarray, block)
 
 
-class FastRuntime(_ObsHooks):
+class FastRuntime(_ObsHooks, _ElasticResize):
     """Run driver for the TPU-optimized round (core/faststep.py): same
     membership / failure-injection / history-recording surface as Runtime,
     over the packed-column FastState.  Backends: ``batched`` (R replicas on

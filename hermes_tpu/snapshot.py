@@ -19,6 +19,14 @@ per-array sha256).  ``load`` verifies the manifest before any mutation: a
 bit-rotted or hand-edited array rejects loudly ("torn"), a missing array
 flows to the targeted incompleteness errors below, and a config
 fingerprint mismatch is reported before the full config diff.
+
+Scope (round-10, elastic operations): every manifest declares what the
+archive HOLDS — ``scope: "full"`` (the whole state tree, a crash-recovery
+archive) or ``scope: "range:[lo,hi)"`` (just the table rows of a dense
+key-slot range, a migration transfer archive written by ``save_range``).
+``load`` refuses a range-scoped archive outright: a migration transfer can
+never be mistaken for crash-recovery state, however valid its checksums
+are.  ``load_range`` enforces the inverse.
 """
 
 from __future__ import annotations
@@ -184,12 +192,20 @@ def save(path: str, rt) -> None:
     # -- checksummed manifest + tmp/rename (crash consistency, round-9) ----
     manifest = dict(
         version=MANIFEST_VERSION,
+        scope="full",
         config_sha256=config_fingerprint(rt.cfg),
         step=int(rt.step_idx),
         pipeline_depth=int(rt.cfg.pipeline_depth),
         ring_flushed=int(ring_flushed),
         arrays={k: _array_sha256(v) for k, v in arrays.items()},
     )
+    _atomic_savez(path, arrays, manifest)
+
+
+def _atomic_savez(path: str, arrays: dict, manifest: dict) -> None:
+    """Embed the manifest and write tmp+fsync+rename (shared by ``save``
+    and ``save_range``): a crash mid-save never tears PATH."""
+    arrays = dict(arrays)
     arrays[MANIFEST_KEY] = np.frombuffer(
         json.dumps(manifest, sort_keys=True).encode(), dtype=np.uint8)
     if not path.endswith(".npz"):
@@ -256,6 +272,14 @@ def load(path: str, rt) -> None:
     # matters).  Archives without a manifest predate round-9 and cannot be
     # verified — refuse them outright.
     manifest = _verify_npz(z)
+    scope = manifest.get("scope", "full")  # pre-round-10 archives are full
+    if scope != "full":
+        raise ValueError(
+            f"snapshot is scope={scope!r} — a key-range migration transfer "
+            "archive (snapshot.save_range), not full crash-recovery state; "
+            "restoring it as a full snapshot would resurrect a runtime "
+            "from a sliver of one table.  Range archives restore through "
+            "snapshot.load_range / hermes_tpu.elastic.migrate_range")
     if manifest.get("config_sha256") != config_fingerprint(rt.cfg):
         raise ValueError(
             "snapshot config fingerprint mismatch (manifest "
@@ -369,3 +393,226 @@ def load(path: str, rt) -> None:
         rt.rebases = int(z["ctl.rebases"])
         rt._next_rebase_at = int(z["ctl.next_rebase_at"])
         rt.quiesce = bool(z["ctl.quiesce"])
+
+
+# --------------------------------------------------------------------------
+# Range-scoped archives (round-10 elastic operations: key-range migration)
+# --------------------------------------------------------------------------
+#
+# A migration moves the table rows of a dense slot range [lo, hi) between
+# replica groups.  The transfer artifact is a snapshot in this module's
+# format — tmp+rename, checksummed manifest — but scope-tagged so the full
+# restore path can NEVER be offered one (and vice versa).  Host-side the
+# bank rows travel as int32 words via the same byte order faststep's
+# _bank_to_i32 defines on device.
+
+
+def _rows_to_i32(rows8: np.ndarray) -> np.ndarray:
+    """Host mirror of faststep._bank_to_i32: int8 byte rows (..., 4*W) ->
+    int32 words (..., W), little-endian byte composition."""
+    u = rows8.view(np.uint8).astype(np.uint32)
+    w = (u[..., 0::4] | (u[..., 1::4] << 8)
+         | (u[..., 2::4] << 16) | (u[..., 3::4] << 24))
+    return np.ascontiguousarray(w).view(np.int32)
+
+
+def _i32_to_rows(rows32: np.ndarray) -> np.ndarray:
+    """Inverse of _rows_to_i32 (host mirror of faststep._i32_to_bank)."""
+    u = np.ascontiguousarray(rows32).view(np.uint32)
+    parts = np.stack([((u >> (8 * k)) & 0xFF) for k in range(4)],
+                     axis=-1).astype(np.uint8)
+    b = parts.reshape(rows32.shape[:-1] + (4 * rows32.shape[-1],))
+    return b.view(np.int8)
+
+
+def _range_rows(rt, lo: int, hi: int):
+    """(vpts (n,) int32, bank (n, 4*(2+V)) int8) of slots [lo, hi), taken
+    from the lowest live unfrozen replica's table copy.  On the sharded
+    engine every OTHER live unfrozen copy must be byte-identical over the
+    range — the drained-range precondition, verified loudly rather than
+    trusted (a range with in-flight coordination is not transferable)."""
+    import jax.lax
+
+    cfg = rt.cfg
+    K, n = cfg.n_keys, hi - lo
+    tbl = rt.fs.table
+    if tbl.vpts.shape[0] == K:  # batched: one shared authoritative copy
+        vpts = jax.lax.dynamic_slice_in_dim(tbl.vpts, lo, n)
+        bank = jax.lax.dynamic_slice_in_dim(tbl.bank, lo, n)
+        return (np.asarray(jax.device_get(vpts)),
+                np.asarray(jax.device_get(bank)))
+    live = int(rt.live[0])
+    cands = [r for r in range(cfg.n_replicas)
+             if (live >> r) & 1 and not rt.frozen[r]]
+    if not cands:
+        raise RuntimeError("save_range needs at least one live unfrozen "
+                           "replica to donate the range rows")
+    got = {}
+    for r in cands:
+        vpts = jax.lax.dynamic_slice_in_dim(tbl.vpts, r * K + lo, n)
+        bank = jax.lax.dynamic_slice_in_dim(tbl.bank, r * K + lo, n)
+        got[r] = (np.asarray(jax.device_get(vpts)),
+                  np.asarray(jax.device_get(bank)))
+    donor = cands[0]
+    for r in cands[1:]:
+        if not (np.array_equal(got[r][0], got[donor][0])
+                and np.array_equal(got[r][1], got[donor][1])):
+            raise RuntimeError(
+                f"range [{lo}, {hi}) is not quiesced: replicas {donor} and "
+                f"{r} disagree on its rows — drain the range (reject-new + "
+                "flush in-flight) before snapshotting it")
+    return got[donor]
+
+
+def save_range(path: str, rt, lo: int, hi: int) -> dict:
+    """Snapshot ONLY the table rows of dense slots ``[lo, hi)`` of a
+    FastRuntime (or the runtime under a KVS facade) into a range-scoped
+    archive — the transfer artifact of a live key-range migration
+    (hermes_tpu/elastic).  The range must be DRAINED: in-flight pipeline
+    rounds are flushed here, and on the sharded engine the live replicas'
+    copies of the range are verified byte-identical.  Carries the range's
+    cumulative version-rebase deltas (``ver_base``) so the destination can
+    re-anchor recorded versions into the source's global version space.
+    Returns the manifest."""
+    if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
+        rt.flush()
+        rt = rt.rt
+    if not hasattr(rt, "fs"):
+        raise NotImplementedError(
+            "save_range reads the faststep table (FastRuntime/KVS); the "
+            "phases Runtime has no elastic migration path")
+    if not (0 <= lo < hi <= rt.cfg.n_keys):
+        raise ValueError(f"range [{lo}, {hi}) outside [0, {rt.cfg.n_keys})")
+    rt.flush_pipeline()
+    vpts, bank = _range_rows(rt, lo, hi)
+    vb = (rt._ver_base[lo:hi].copy() if rt._ver_base is not None
+          else np.zeros(hi - lo, np.int64))
+    arrays = {
+        "range.vpts": vpts,
+        "range.bank": bank,
+        "range.ver_base": vb,
+        "meta.cfg": np.frombuffer(
+            json.dumps(dataclasses.asdict(rt.cfg)).encode(), dtype=np.uint8),
+    }
+    manifest = dict(
+        version=MANIFEST_VERSION,
+        scope=f"range:[{lo},{hi})",
+        lo=int(lo),
+        hi=int(hi),
+        value_words=int(rt.cfg.value_words),
+        config_sha256=config_fingerprint(rt.cfg),
+        step=int(rt.step_idx),
+        arrays={k: _array_sha256(v) for k, v in arrays.items()},
+    )
+    _atomic_savez(path, arrays, manifest)
+    return manifest
+
+
+def read_range(path: str):
+    """Verify and read a range-scoped archive WITHOUT touching any runtime:
+    returns ``(manifest, slots, vpts, rows32, ver_base)`` where ``slots``
+    is the archived ``[lo, hi)`` as an index array and ``rows32`` the bank
+    rows as int32 words ``[pts | sst | val...]`` — the form a migration
+    driver patches (uid re-mint) before restoring.  Refuses full-scoped
+    archives (the inverse of ``load``'s scope gate)."""
+    with np.load(path) as z:
+        manifest = _verify_npz(z)
+        scope = manifest.get("scope", "full")
+        if not scope.startswith("range:"):
+            raise ValueError(
+                f"archive is scope={scope!r}, not a range transfer; full "
+                "snapshots restore through snapshot.load")
+        missing = [k for k in ("range.vpts", "range.bank", "range.ver_base")
+                   if k not in z]
+        if missing:
+            raise ValueError(
+                f"range archive is incomplete (truncated/corrupt?): "
+                f"missing {missing}")
+        vpts = np.asarray(z["range.vpts"])
+        rows32 = _rows_to_i32(np.asarray(z["range.bank"]))
+        ver_base = np.asarray(z["range.ver_base"]).astype(np.int64)
+    lo, hi = int(manifest["lo"]), int(manifest["hi"])
+    if vpts.shape[0] != hi - lo or rows32.shape[0] != hi - lo:
+        raise ValueError(
+            f"range archive row count {vpts.shape[0]} != declared "
+            f"[{lo}, {hi})")
+    return manifest, np.arange(lo, hi, dtype=np.int64), vpts, rows32, ver_base
+
+
+def write_rows(rt, dest_slots, vpts, rows32) -> None:
+    """Write table rows into a FastRuntime at ``dest_slots`` (every replica
+    copy on the sharded engine — migrated rows arrive converged, exactly as
+    a committed VAL would leave them).  Mechanical: scope checks, uid
+    re-minting and version re-anchoring are the caller's job
+    (hermes_tpu.elastic.migrate_range / snapshot.load_range)."""
+    import jax.numpy as jnp
+
+    cfg = rt.cfg
+    K = cfg.n_keys
+    dest = np.asarray(dest_slots, np.int64)
+    if dest.size == 0:
+        return
+    if dest.min() < 0 or dest.max() >= K or np.unique(dest).size != dest.size:
+        raise ValueError("dest_slots must be distinct slots in [0, n_keys)")
+    if rows32.shape != (dest.size, 2 + cfg.value_words):
+        raise ValueError(
+            f"rows32 shape {rows32.shape} != ({dest.size}, "
+            f"{2 + cfg.value_words}) — value_words mismatch between the "
+            "archive and the destination config")
+    rt.flush_pipeline()
+    tbl = rt.fs.table
+    nv = tbl.vpts.shape[0] // K
+    flat = (np.arange(nv, dtype=np.int64)[:, None] * K + dest[None, :]).ravel()
+    bank8 = _i32_to_rows(np.ascontiguousarray(rows32, np.int32))
+    rt.fs = rt.fs._replace(table=tbl._replace(
+        vpts=tbl.vpts.at[flat].set(jnp.asarray(np.tile(vpts, nv))),
+        bank=tbl.bank.at[flat].set(jnp.asarray(np.tile(bank8, (nv, 1)))),
+    ))
+
+
+def anchor_ver_base(rt, dest_slots, ver_base) -> None:
+    """Adopt a migrated range's cumulative version-rebase deltas into the
+    destination runtime's re-anchoring table (shared by ``load_range`` and
+    elastic.migrate_range): completions recorded for the restored slots
+    must re-anchor into the SOURCE's global version space or the checker's
+    witness order would restart mid-history.  Fresh destination slots (the
+    migration precondition) carry no deltas of their own, so assignment —
+    not addition — is the correct fold."""
+    ver_base = np.asarray(ver_base, np.int64)
+    if not ver_base.any():
+        return
+    if rt._ver_base is None:
+        rt._ver_base = np.zeros(rt.cfg.n_keys, np.int64)
+    rt._ver_base[np.asarray(dest_slots, np.int64)] = ver_base
+
+
+def load_range(path: str, rt, dest_slots=None) -> dict:
+    """Restore a range-scoped archive into a FastRuntime (or KVS facade)
+    at ``dest_slots`` (default: the archived slots — identity placement).
+    The destination slots must be FRESH (no prior committed writes in the
+    destination's history): migration owns that precondition via routing —
+    a key lives in exactly one group.  Verifies scope + checksums first,
+    re-anchors the destination's ``_ver_base`` over the restored slots with
+    the source's deltas.  Returns the manifest.  NOTE: this mechanical
+    restore keeps the rows' original write uids; checker-recorded
+    destinations should migrate through hermes_tpu.elastic.migrate_range,
+    which re-mints uids and seeds the destination history."""
+    if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
+        rt.flush()
+        rt = rt.rt
+    if not hasattr(rt, "fs"):
+        raise NotImplementedError("load_range restores the faststep table")
+    manifest, slots, vpts, rows32, ver_base = read_range(path)
+    if int(manifest["value_words"]) != rt.cfg.value_words:
+        raise ValueError(
+            f"range archive value_words={manifest['value_words']} != "
+            f"destination {rt.cfg.value_words}; rows are not portable "
+            "across value widths")
+    dest = slots if dest_slots is None else np.asarray(dest_slots, np.int64)
+    if dest.shape != slots.shape:
+        raise ValueError(
+            f"dest_slots count {dest.size} != archived rows {slots.size}")
+    write_rows(rt, dest, vpts, rows32)
+    if hasattr(rt, "_ver_base"):
+        anchor_ver_base(rt, dest, ver_base)
+    return manifest

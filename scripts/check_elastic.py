@@ -1,0 +1,223 @@
+"""Round-10 elastic-operations gate (CI): live resize, key-range
+migration, and the rolling-restart drill must hold their contracts on
+every change.
+
+Four assertions, CPU-smoke sized (joins check_op_census.py,
+check_obs_overhead.py, check_analysis.py, check_pipeline.py and
+check_chaos.py in the verify flow — the SIX gates run SERIALLY, never
+beside pytest: the obs-overhead gate is contention-sensitive):
+
+  1. rolling-restart drill — every replica of an 8-replica group is
+     crash-restarted in sequence under depth-2 pipelined load
+     (hermes_tpu.elastic.run_rolling_restart) on BOTH engines: all 8
+     restarts apply, the cluster drains, the linearizability checker
+     passes with zero violations, and the worst-window throughput dip is
+     measured and recorded (dip_pct);
+  2. drill determinism — the same seed + config replays the rolling
+     drill to a byte-identical executed-event log and final state tree;
+  3. live resize — every replica shrunk (fence + client drain + quorum
+     remove) and grown (join value sync) in sequence through the KVS
+     under standing client load, both engines, checker-gated; ops routed
+     at a retired replica land as kind='rejected', never stranded;
+  4. live key-range migration — the composed drill
+     (hermes_tpu.elastic.migration_drill: fence → drain → snapshot →
+     transfer → flip → release) under depth-2 load, both engines plus a
+     sparse-key (KeyIndex remap) cell: post-flip destination reads serve
+     the migrated values, boundary routing is exact at lo/hi-1,
+     mid-drain ops land rejected/salvaged (never dropped), and BOTH
+     groups' histories pass the checker.
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_elastic.py
+
+Prints one JSON line (also written to ELASTIC_SOAK.json); exit non-zero
+on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+SEED = 31
+ROLL_START, ROLL_SPACING = 4, 10
+
+
+def _drill_cfg(**over):
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    kw = dict(
+        n_replicas=8, n_keys=128, n_sessions=4, replay_slots=6,
+        ops_per_session=96, value_words=6, replay_age=6,
+        replay_scan_every=4, rebroadcast_every=2, lease_steps=6,
+        pipeline_depth=2,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.2, seed=SEED),
+    )
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _mesh():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("replica",))
+
+
+def _rolling(backend):
+    from hermes_tpu import elastic
+    from hermes_tpu.runtime import FastRuntime
+
+    cfg = _drill_cfg()
+    rt = FastRuntime(cfg, backend=backend,
+                     mesh=_mesh() if backend == "sharded" else None,
+                     record=True)
+    res = elastic.run_rolling_restart(
+        rt, start=ROLL_START, spacing=ROLL_SPACING, check=True)
+    return rt, res
+
+
+def check_rolling(report: dict) -> None:
+    for backend in ("batched", "sharded"):
+        rt, res = _rolling(backend)
+        assert res["restarts"] == rt.cfg.n_replicas, (
+            f"{backend}: only {res['restarts']}/{rt.cfg.n_replicas} "
+            "replicas restarted")
+        assert res["drained"], f"{backend}: did not drain after the drill"
+        assert res["checked_ok"], (
+            f"{backend}: checker FAIL {res.get('check_failures')}")
+        dip = res["dip"]
+        assert dip["dip_pct"] is not None and dip["windows"] > 0, dip
+        report[f"{backend}_rolling"] = dict(
+            restarts=res["restarts"], lost_ops=res["lost_ops"],
+            checked_ok=True, dip_pct=dip["dip_pct"],
+            worst_window=dip["worst_window"])
+
+
+def check_determinism(report: dict) -> None:
+    import jax
+    import numpy as np
+
+    logs, states = [], []
+    for _ in range(2):
+        from hermes_tpu import chaos
+        from hermes_tpu import elastic
+        from hermes_tpu.runtime import FastRuntime
+
+        cfg = _drill_cfg()
+        rt = FastRuntime(cfg, record=True)
+        sched = chaos.Schedule.rolling_restart(cfg, start=ROLL_START,
+                                               spacing=ROLL_SPACING)
+        runner = chaos.ChaosRunner(
+            rt, sched, spec=chaos.ChaosSpec(min_healthy=2))
+        res = runner.run(ROLL_START + ROLL_SPACING * (cfg.n_replicas + 1),
+                         check=True)
+        assert res["checked_ok"], res
+        logs.append(runner.log_json())
+        states.append(jax.tree.leaves(jax.device_get(rt.fs)))
+    assert logs[0] == logs[1], "rolling drill executed logs differ"
+    for x, y in zip(states[0], states[1]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    report["deterministic_replay"] = True
+
+
+def check_resize(report: dict) -> None:
+    from hermes_tpu import elastic
+    from hermes_tpu.kvs import KVS
+
+    for backend in ("batched", "sharded"):
+        cfg = _drill_cfg()
+        kvs = KVS(cfg, backend=backend,
+                  mesh=_mesh() if backend == "sharded" else None,
+                  record=True)
+        # size the standing load to outlast the whole drill (~R cycles of
+        # 2*hold_steps rounds plus per-cycle drains, up to R*S completions
+        # per round), so every sampled window measures service under load,
+        # not load exhaustion
+        rounds_est = cfg.n_replicas * (2 * 8 + 6) + 24
+        bf = elastic.submit_drill_mix(
+            kvs, rounds_est * cfg.n_replicas * cfg.n_sessions, seed=SEED)
+        res = elastic.rolling_resize(kvs, check=True)
+        assert kvs.run_batch(bf), f"{backend}: standing load stranded"
+        assert res["resizes"] == cfg.n_replicas, res
+        assert res["checked_ok"], (
+            f"{backend}: resize checker FAIL {res.get('check_failures')}")
+        # a retired replica rejects loudly, then serves again after grow
+        kvs.shrink(0)
+        f = kvs.put(0, 0, 1, [7])
+        assert f.done() and f.result().kind == "rejected"
+        kvs.grow(0)
+        f = kvs.put(0, 0, 1, [7])
+        assert kvs.run_until([f]) and f.result().kind == "put"
+        report[f"{backend}_resize"] = dict(
+            resizes=res["resizes"], rejected_ops=res["rejected_ops"],
+            checked_ok=True, dip_pct=res["dip"]["dip_pct"])
+
+
+def check_migration(report: dict) -> None:
+    from hermes_tpu import elastic
+    from hermes_tpu.kvs import KVS
+
+    for backend in ("batched", "sharded"):
+        cfg = _drill_cfg()
+        res = elastic.migration_drill(
+            cfg, backend=backend,
+            mesh=_mesh() if backend == "sharded" else None,
+            record=True, seed=SEED, check=True)
+        assert res["src_checked_ok"] and res["dst_checked_ok"], res
+        report[f"{backend}_migration"] = dict(
+            rows=res["rows"], rejected=res["live_rejected"],
+            salvaged=res["salvaged"], drained=res["drained"],
+            checked_ok=True)
+
+    # sparse-key remap cell (batched): client keys keep resolving through
+    # the destination's KeyIndex after the flip
+    from hermes_tpu.config import WorkloadConfig
+
+    cfg = _drill_cfg(n_keys=64, n_replicas=4,
+                     workload=WorkloadConfig(seed=SEED))
+    src = KVS(cfg, record=True, sparse_keys=True)
+    dst = KVS(cfg, record=True, sparse_keys=True)
+    keys = [(i + 1) * 10**12 for i in range(12)]
+    futs = [src.put(i % 4, i % 4, k, [i]) for i, k in enumerate(keys)]
+    assert src.run_until(futs)
+    res = elastic.migrate_range(src, dst, 4, 10)
+    for i in range(4, 10):
+        g = dst.get(0, 0, keys[i])
+        assert dst.run_until([g]) and g.result().value[:1] == [i], i
+    assert src.rt.check().ok and dst.rt.check().ok
+    report["sparse_migration"] = dict(rows=res["rows"], checked_ok=True)
+
+
+def main() -> int:
+    report: dict = {"gate": "elastic"}
+    try:
+        check_rolling(report)
+        check_determinism(report)
+        check_resize(report)
+        check_migration(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report, default=str))
+        return 1
+    report["ok"] = True
+    out = os.path.join(os.path.dirname(__file__), "..", "ELASTIC_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    print(json.dumps(report, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

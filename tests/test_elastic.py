@@ -1,0 +1,431 @@
+"""Elastic operations (round-10, hermes_tpu/elastic): live resize,
+key-range migration routing/rejection/salvage, range-scoped snapshots,
+and the rolling-restart drill — every path checker-gated."""
+
+import numpy as np
+import pytest
+
+from hermes_tpu import elastic
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.keyindex import RangeRouter
+from hermes_tpu.kvs import KVS, C_REJECTED, StuckOpError
+from hermes_tpu.runtime import FastRuntime
+
+
+def _cfg(**over):
+    kw = dict(n_replicas=4, n_keys=64, n_sessions=4, value_words=6,
+              replay_slots=8, workload=WorkloadConfig(seed=3))
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+# -- routing ----------------------------------------------------------------
+
+
+def test_range_router_boundaries_exact():
+    """Post-flip routing is EXACT at range boundaries: lo moves, lo-1
+    stays; hi-1 moves, hi stays (the satellite's off-by-one probe)."""
+    router = RangeRouter(64, default_group=0)
+    lo, hi = 16, 32
+    router.begin_drain(lo, hi)
+    assert bool(router.draining(lo)) and bool(router.draining(hi - 1))
+    assert not router.draining(lo - 1) and not router.draining(hi)
+    assert int(router.owner(lo)) == 0  # drain does NOT move ownership
+    router.flip(lo, hi, 7)
+    assert int(router.owner(lo)) == 7 and int(router.owner(hi - 1)) == 7
+    assert int(router.owner(lo - 1)) == 0 and int(router.owner(hi)) == 0
+    # the flip is atomic: drain cleared in the same update
+    assert not router.draining(np.arange(64)).any()
+    np.testing.assert_array_equal(
+        router.routable(np.array([lo - 1, lo, hi - 1, hi]), 7),
+        [False, True, True, False])
+
+
+def test_range_router_release_and_validation():
+    router = RangeRouter(16)
+    router.begin_drain(4, 8)
+    router.release(4, 8)
+    assert not router.draining(np.arange(16)).any()
+    assert (router.owner(np.arange(16)) == 0).all()
+    with pytest.raises(ValueError):
+        router.begin_drain(8, 4)
+    with pytest.raises(ValueError):
+        router.flip(0, 17, 1)
+
+
+# -- live resize ------------------------------------------------------------
+
+
+def test_resize_shrink_grow_under_traffic_checked():
+    """Shrink rejects the retired replica's traffic loudly, drains its
+    in-flight ops to normal completion, grow re-admits via join value
+    sync — checker green with client sessions issuing throughout."""
+    kvs = KVS(_cfg(), record=True)
+    futs = [kvs.put(r, s, (r * 4 + s) % 64, [r, s])
+            for r in range(4) for s in range(4)]
+    assert kvs.run_until(futs)
+    # queued op on the retiring replica is rejected by the shrink sweep
+    queued = kvs.put(3, 0, 7, [1])
+    kvs.shrink(3)
+    assert queued.done() and queued.result().kind == "rejected"
+    # new traffic to the retired replica rejects immediately
+    f = kvs.put(3, 1, 5, [9])
+    assert f.done() and f.result().kind == "rejected"
+    # the shrunken group keeps serving
+    f2 = kvs.put(0, 0, 5, [9])
+    assert kvs.run_until([f2]) and f2.result().kind == "put"
+    kvs.grow(3)
+    g = kvs.get(3, 0, 5)
+    assert kvs.run_until([g]) and g.result().value[:1] == [9]
+    assert kvs.rt.check().ok
+    assert kvs.rejected_ops == 2
+
+
+def test_resize_guards():
+    kvs = KVS(_cfg())
+    with pytest.raises(ValueError):
+        kvs.rt.grow(2)  # already live
+    kvs.shrink(2)
+    with pytest.raises(ValueError):
+        kvs.rt.shrink(2)  # not live anymore
+    kvs.grow(2)
+    f = kvs.put(2, 0, 1, [1])
+    assert kvs.run_until([f]) and f.result().kind == "put"
+
+
+def test_kvs_shrink_of_non_live_replica_leaves_no_retirement():
+    """kvs.shrink validates liveness BEFORE mutating client state: a
+    replica removed by other means (detector, crash) must not end up
+    silently retired at the KVS when the shrink call is refused."""
+    kvs = KVS(_cfg())
+    kvs.rt.remove(2)  # detector-style removal, KVS knows nothing
+    with pytest.raises(ValueError, match="not live"):
+        kvs.shrink(2)
+    assert 2 not in kvs._retired
+    kvs.rt.join(2, from_replica=0)
+    f = kvs.put(2, 0, 1, [1])  # traffic at the rejoined replica serves
+    assert kvs.run_until([f]) and f.result().kind == "put"
+
+
+def test_shrink_refuses_wedged_drain():
+    """A replica whose in-flight op cannot drain (quorum frozen) raises
+    instead of silently wedging — and rolls the retirement back."""
+    kvs = KVS(_cfg())
+    kvs.freeze(2)
+    kvs.put(1, 0, 5, [1])
+    for _ in range(3):
+        kvs.step()
+    with pytest.raises(RuntimeError, match="did not drain"):
+        kvs.shrink(1, drain_steps=5)
+    assert 1 not in kvs._retired
+
+
+def test_shrink_logs_administrative_remove():
+    """An elastic shrink lands on the membership log as kind='shrink'
+    (administrative), not a detector 'remove'."""
+    from hermes_tpu.membership import MembershipService
+
+    cfg = _cfg()
+    rt = FastRuntime(cfg)
+    svc = MembershipService(cfg, confirm_steps=3)
+    rt.attach_membership(svc)
+    rt.run(2)
+    rt.shrink(1)
+    kinds = [e.kind for e in svc.events]
+    assert kinds == ["shrink"]
+    rt.grow(1)
+    assert [e.kind for e in svc.events] == ["shrink", "join"]
+
+
+# -- key-range migration ----------------------------------------------------
+
+
+def test_migration_dense_end_to_end_checked():
+    cfg = _cfg()
+    src, dst = KVS(cfg, record=True), KVS(cfg, record=True)
+    router = RangeRouter(cfg.n_keys)
+    futs = [src.put(0, 0, k, [k, 100 + k]) for k in range(8, 16)]
+    assert src.run_until(futs)
+    res = elastic.migrate_range(src, dst, 8, 16, router=router, dst_group=1)
+    assert res["drained"] and res["salvaged"] == 0
+    assert (router.owner(np.arange(8, 16)) == 1).all()
+    assert int(router.owner(7)) == 0 and int(router.owner(16)) == 0
+    # src rejects the moved range forever; dst serves it
+    f = src.get(0, 0, 9)
+    assert f.done() and f.result().kind == "rejected"
+    g = dst.get(1, 0, 9)
+    assert dst.run_until([g]) and g.result().value[:2] == [9, 109]
+    # writes continue the version chain on the destination
+    w = dst.put(2, 1, 9, [77])
+    assert dst.run_until([w])
+    g2 = dst.get(0, 2, 9)
+    assert dst.run_until([g2]) and g2.result().value[:1] == [77]
+    assert src.rt.check().ok and dst.rt.check().ok
+
+
+def test_migration_sparse_remaps_client_keys():
+    """Sparse mode: migrated client keys re-resolve through the
+    destination's KeyIndex (fresh dense slots), values intact, both
+    histories checker-green."""
+    cfg = _cfg()
+    src = KVS(cfg, record=True, sparse_keys=True)
+    dst = KVS(cfg, record=True, sparse_keys=True)
+    keys = [(i + 1) * 10**12 for i in range(12)]
+    futs = [src.put(i % 4, i % 4, k, [i]) for i, k in enumerate(keys)]
+    assert src.run_until(futs)
+    res = elastic.migrate_range(src, dst, 4, 10)
+    assert res["rows"] == 6
+    for i in range(4, 10):
+        g = dst.get(0, 0, keys[i])
+        assert dst.run_until([g])
+        assert g.result().found and g.result().value[:1] == [i]
+    # boundary slots 3 and 10 stayed on the source
+    for i in (3, 10):
+        g = src.get(0, 0, keys[i])
+        assert src.run_until([g]) and g.result().value[:1] == [i]
+    r = src.get(0, 0, keys[4])
+    assert r.done() and r.result().kind == "rejected"
+    assert src.rt.check().ok and dst.rt.check().ok
+
+
+def test_migration_mid_drain_ops_rejected_never_dropped():
+    """Ops issued to a range mid-drain land as rejected (per-op AND batch
+    paths) — counted, resolved, never stranded."""
+    cfg = _cfg()
+    src = KVS(cfg, record=True)
+    futs = [src.put(0, 0, k, [k]) for k in range(8, 16)]
+    assert src.run_until(futs)
+    src.fence_slots(8, 16)
+    f = src.put(1, 1, 9, [5])
+    assert f.done() and f.result().kind == "rejected"
+    bf = src.submit_batch(
+        np.array([KVS.PUT, KVS.PUT], np.int32), np.array([9, 20]),
+        np.array([[1], [2]], np.int32))
+    assert bf.code[0] == C_REJECTED and not bf.found[0]
+    assert src.run_batch(bf)
+    assert bf.completion(0).kind == "rejected"
+    assert bf.completion(1).kind == "put"
+    src.release_slots(8, 16)
+    f2 = src.put(1, 1, 9, [5])
+    assert src.run_until([f2]) and f2.result().kind == "put"
+    assert src.rt.check().ok
+
+
+def test_migration_salvages_wedged_ops_as_maybe_w():
+    """Forced cutover: an op wedged by a frozen quorum member is salvaged
+    — future resolves kind='lost', the history holds a maybe_w, BOTH
+    checkers stay green, and the destination serves the range."""
+    cfg = _cfg()
+    src, dst = KVS(cfg, record=True), KVS(cfg, record=True)
+    ws = [src.put(0, 0, k, [k]) for k in range(8)]
+    assert src.run_until(ws)
+    src.freeze(2)
+    wedge = src.put(1, 1, 10, [999])
+    for _ in range(4):
+        src.step()
+    assert not wedge.done()
+    res = elastic.migrate_range(src, dst, 8, 12, drain_steps=6, force=True)
+    assert res["salvaged"] == 1 and not res["drained"]
+    assert wedge.done() and wedge.result().kind == "lost"
+    src.rt.thaw(2)
+    assert src.rt.check().ok
+    g = dst.get(0, 0, 10)
+    assert dst.run_until([g]) and g.result().found
+    assert dst.rt.check().ok
+
+
+def test_salvage_does_not_strand_queued_ops_behind_salvaged_slot():
+    """An op queued BEHIND a salvaged in-flight op (on a key OUTSIDE the
+    range) must re-inject after the cutover frees the slot — the salvage
+    re-readies freed slots exactly like a crash does."""
+    cfg = _cfg()
+    src, dst = KVS(cfg, record=True), KVS(cfg, record=True)
+    ws = [src.put(0, 0, k, [k]) for k in range(8)]
+    assert src.run_until(ws)
+    src.freeze(2)
+    wedge = src.put(1, 1, 10, [999])   # in the migrating range, will wedge
+    queued = src.put(1, 1, 50, [7])    # behind it, key OUTSIDE the range
+    for _ in range(3):
+        src.step()
+    elastic.migrate_range(src, dst, 8, 12, drain_steps=5, force=True)
+    assert wedge.done() and wedge.result().kind == "lost"
+    src.rt.thaw(2)
+    assert src.run_until([queued], max_steps=200)
+    assert queued.result().kind == "put"
+    assert src.rt.check().ok
+
+
+def test_migration_cleans_transfer_tempdir(tmp_path, monkeypatch):
+    """The default (tempdir) transfer archive is removed on success AND on
+    a post-fence failure — range data must not accumulate under /tmp."""
+    import tempfile as tempfile_mod
+
+    monkeypatch.setattr(tempfile_mod, "tempdir", str(tmp_path))
+    cfg = _cfg()
+    src, dst = KVS(cfg, record=True), KVS(cfg, record=True)
+    ws = [src.put(0, 0, k, [k]) for k in range(8, 16)]
+    assert src.run_until(ws)
+    elastic.migrate_range(src, dst, 8, 12)
+    assert list(tmp_path.glob("hermes_migrate_*")) == []
+    # failure path: wedged drain without force aborts after nothing was
+    # archived; wedged drain WITH force archives then completes — cover
+    # the abort-after-fence case via a destination that rejects at restore
+    src2, dst2 = KVS(cfg, record=True), KVS(cfg, record=True)
+    ws = [src2.put(0, 0, k, [k]) for k in range(8, 16)]
+    assert src2.run_until(ws)
+    import hermes_tpu.snapshot as snap
+
+    real = snap.read_range
+    monkeypatch.setattr(snap, "read_range", lambda *a, **k: (_ for _ in ()).throw(
+        ValueError("boom")))
+    with pytest.raises(ValueError, match="boom"):
+        elastic.migrate_range(src2, dst2, 12, 16)
+    monkeypatch.setattr(snap, "read_range", real)
+    assert list(tmp_path.glob("hermes_migrate_*")) == []
+    assert not src2._fence_mask.any()  # abort released the fence
+
+
+def test_sparse_migration_capacity_checked_before_fence():
+    """Sparse mode refuses a migration the destination index cannot hold
+    BEFORE fencing (zero side effects) — not at transfer time."""
+    cfg = _cfg()
+    small = HermesConfig(n_replicas=4, n_keys=4, n_sessions=4,
+                         value_words=6, replay_slots=8,
+                         workload=WorkloadConfig(seed=3))
+    src = KVS(cfg, record=True, sparse_keys=True)
+    dst = KVS(small, record=True, sparse_keys=True)
+    keys = [(i + 1) * 10**12 for i in range(8)]
+    futs = [src.put(0, 0, k, [i]) for i, k in enumerate(keys)]
+    assert src.run_until(futs)
+    with pytest.raises(ValueError, match="fresh destination slot"):
+        elastic.migrate_range(src, dst, 0, 8)
+    assert not src._fence_mask.any() and src.rejected_ops == 0
+
+
+def test_migration_abort_releases_fence_and_drain():
+    """A migration that fails mid-drain takes the ABORT path: the fence
+    and router drain release, and the source serves the range again —
+    never a permanently-unavailable range."""
+    cfg = _cfg()
+    src, dst = KVS(cfg, record=True), KVS(cfg, record=True)
+    router = RangeRouter(cfg.n_keys)
+    ws = [src.put(0, 0, k, [k]) for k in range(8)]
+    assert src.run_until(ws)
+    src.freeze(2)
+    src.put(1, 1, 10, [5])
+    for _ in range(3):
+        src.step()
+    with pytest.raises(RuntimeError, match="did not drain"):
+        elastic.migrate_range(src, dst, 8, 12, router=router, drain_steps=5)
+    assert src.drill_phase is None
+    assert not src._fence_mask.any()
+    assert not router.draining(np.arange(cfg.n_keys)).any()
+    assert (router.owner(np.arange(8, 12)) == 0).all()
+    src.rt.thaw(2)
+    f = src.put(1, 2, 10, [6])  # the source serves the range again
+    assert src.run_until([f]) and f.result().kind == "put"
+    assert src.rt.check().ok
+
+
+def test_migration_destination_must_be_fresh_before_fencing():
+    """A refusable migration is refused BEFORE the fence: zero side
+    effects on the source (no fence, no rejected ops, no salvage)."""
+    cfg = _cfg()
+    src, dst = KVS(cfg, record=True), KVS(cfg, record=True)
+    fs = [src.put(0, 0, 9, [1]), dst.put(0, 0, 9, [2])]
+    assert src.run_until([fs[0]]) and dst.run_until([fs[1]])
+    with pytest.raises(ValueError, match="not fresh"):
+        elastic.migrate_range(src, dst, 8, 12)
+    assert not src._fence_mask.any() and src.rejected_ops == 0
+    # dense capacity is also checked up front
+    small = KVS(HermesConfig(n_replicas=4, n_keys=8, n_sessions=4,
+                             value_words=6, replay_slots=8))
+    with pytest.raises(ValueError, match="n_keys"):
+        elastic.migrate_range(src, small, 8, 12)
+    assert not src._fence_mask.any()
+
+
+def test_sparse_fence_past_allocation_frontier_rejected():
+    """Sparse mode refuses to fence unallocated slots: a fresh client key
+    would otherwise allocate INSIDE the draining range."""
+    cfg = _cfg()
+    kvs = KVS(cfg, sparse_keys=True)
+    f = kvs.put(0, 0, 10**15, [1])
+    assert kvs.run_until([f])
+    with pytest.raises(ValueError, match="frontier"):
+        kvs.fence_slots(0, 8)
+    kvs.fence_slots(0, 1)  # the allocated prefix is fine
+
+
+# -- stuck-op drill attribution ---------------------------------------------
+
+
+def test_stuck_op_diagnostics_carry_drill_phase():
+    cfg = _cfg(op_timeout_rounds=3)
+    kvs = KVS(cfg, strict_timeouts=True)
+    kvs.freeze(2)
+    kvs.put(0, 0, 5, [1])
+    kvs.drill_phase = "drain"
+    with pytest.raises(StuckOpError, match="drill=drain"):
+        for _ in range(8):
+            kvs.step()
+    assert kvs.stuck_ops[0]["drill"] == "drain"
+    # no drill active -> no drill field
+    kvs2 = KVS(cfg, strict_timeouts=False)
+    kvs2.freeze(2)
+    kvs2.put(0, 0, 5, [1])
+    for _ in range(8):
+        kvs2.step()
+    assert kvs2.stuck_ops and "drill" not in kvs2.stuck_ops[0]
+
+
+# -- rolling-restart drill --------------------------------------------------
+
+
+def _drill_cfg():
+    return HermesConfig(
+        n_replicas=4, n_keys=96, n_sessions=4, replay_slots=6,
+        ops_per_session=48, replay_age=6, replay_scan_every=4,
+        rebroadcast_every=2, lease_steps=6, pipeline_depth=2,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.25, seed=7))
+
+
+def test_rolling_restart_drill_all_replicas_checked():
+    rt = FastRuntime(_drill_cfg(), record=True)
+    res = elastic.run_rolling_restart(rt, start=4, spacing=8, check=True)
+    assert res["restarts"] == 4
+    assert res["drained"] and res["checked_ok"]
+    dip = res["dip"]
+    assert dip["dip_pct"] is not None and dip["windows"] > 0
+    assert "worst_window" in dip
+
+
+def test_rolling_restart_schedule_deterministic():
+    """Same seed + config => byte-identical executed log and final state
+    (the drill rides the chaos subsystem's determinism contract)."""
+    import jax
+    from hermes_tpu import chaos
+
+    logs, states = [], []
+    for _ in range(2):
+        cfg = _drill_cfg()
+        rt = FastRuntime(cfg, record=True)
+        sched = chaos.Schedule.rolling_restart(cfg, start=4, spacing=8)
+        runner = chaos.ChaosRunner(
+            rt, sched, spec=chaos.ChaosSpec(min_healthy=2))
+        res = runner.run(44, check=True)
+        assert res["checked_ok"]
+        logs.append(runner.log_json())
+        states.append(jax.tree.leaves(jax.device_get(rt.fs)))
+    assert logs[0] == logs[1]
+    for x, y in zip(states[0], states[1]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_rolling_resize_drill_checked():
+    kvs = KVS(_cfg(ops_per_session=1), record=True)
+    bf = elastic.submit_drill_mix(kvs, 600, seed=5)
+    res = elastic.rolling_resize(kvs, hold_steps=4, check=True)
+    assert kvs.run_batch(bf)
+    assert res["resizes"] == 4 and res["checked_ok"]
+    assert res["dip"]["dip_pct"] is not None

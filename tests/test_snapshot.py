@@ -243,3 +243,69 @@ def test_sharded_snapshot_roundtrip(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(a.fs.sess.status)),
         np.asarray(jax.device_get(b.fs.sess.status)))
+
+
+def test_range_archive_refused_as_full_restore(tmp_path):
+    """Scope red test (round-10): a range-scoped migration archive can
+    NEVER be mistaken for a crash-recovery archive — ``load`` refuses it
+    on the manifest scope before touching any state, and the inverse path
+    (``read_range`` of a full archive) refuses too."""
+    import pytest
+
+    cfg = HermesConfig(n_replicas=3, n_keys=128, n_sessions=8, replay_slots=4,
+                       ops_per_session=16, workload=WorkloadConfig(seed=66))
+    a = FastRuntime(cfg)
+    a.run(5)
+    rp = str(tmp_path / "range.npz")
+    fp = str(tmp_path / "full.npz")
+    m = snapshot.save_range(rp, a, 16, 48)
+    assert m["scope"] == "range:[16,48)"
+    snapshot.save(fp, a)
+    assert snapshot.read_manifest(fp)["scope"] == "full"
+
+    tgt = FastRuntime(cfg)
+    before = get(tgt.fs.table.vpts).copy()
+    with pytest.raises(ValueError, match="scope="):
+        snapshot.load(rp, tgt)
+    np.testing.assert_array_equal(before, get(tgt.fs.table.vpts))
+    with pytest.raises(ValueError, match="not a range transfer"):
+        snapshot.read_range(fp)
+
+
+def test_range_archive_roundtrip_and_checksum(tmp_path):
+    """save_range -> load_range restores the exact rows (identity
+    placement), leaves everything outside the range untouched, and a
+    bit-flipped range archive rejects on its checksum."""
+    import zipfile
+
+    import pytest
+
+    cfg = HermesConfig(n_replicas=3, n_keys=128, n_sessions=8, replay_slots=4,
+                       ops_per_session=16, workload=WorkloadConfig(seed=67))
+    a = FastRuntime(cfg)
+    a.run(6)
+    a.drain(200)
+    p = str(tmp_path / "range.npz")
+    snapshot.save_range(p, a, 32, 64)
+
+    tgt = FastRuntime(cfg)
+    outside = get(tgt.fs.table.vpts).copy()
+    snapshot.load_range(p, tgt)
+    np.testing.assert_array_equal(
+        get(a.fs.table.vpts)[32:64], get(tgt.fs.table.vpts)[32:64])
+    np.testing.assert_array_equal(
+        get(a.fs.table.bank)[32:64], get(tgt.fs.table.bank)[32:64])
+    np.testing.assert_array_equal(
+        outside[:32], get(tgt.fs.table.vpts)[:32])
+    np.testing.assert_array_equal(
+        outside[64:], get(tgt.fs.table.vpts)[64:])
+
+    torn = str(tmp_path / "torn.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(torn, "w") as zout:
+        for name in zin.namelist():
+            data = bytearray(zin.read(name))
+            if name.startswith("range.bank"):
+                data[len(data) // 2] ^= 0xFF
+            zout.writestr(name, bytes(data))
+    with pytest.raises(ValueError, match="checksum|torn"):
+        snapshot.read_range(torn)
