@@ -438,14 +438,25 @@ def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
 
     window = max(4, rounds // 8)
     cells = {}
-    for name in ("clean", "chaos"):
+    # round-11 third cell: the same serving loop under deterministic
+    # PARTITION+HEAL cycles (asymmetric outbound blackouts driven through
+    # the detector oracle — membership.sever; heal rejoins epoch-fenced)
+    # — what LOSING AND REGAINING replicas to the network costs, vs the
+    # crash/freeze mix.  Deterministic cycles, not seeded draws: a random
+    # partition with no recovery path just shrinks the cluster for the
+    # rest of the run, and the dip stops being comparable across seeds.
+    for name in ("clean", "chaos", "partition"):
         cfg = _cfg("a", dict(pipeline_depth=depth))
         rt = FastRuntime(cfg)
         rt.attach_membership(MembershipService(cfg, confirm_steps=4))
         rt.run(warmup)
         rt.counters()  # close the deferred-execution window before timing
-        sched = (chaos_lib.Schedule.random(cfg, seed, rounds)
-                 if name == "chaos" else chaos_lib.Schedule([]))
+        if name == "chaos":
+            sched = chaos_lib.Schedule.random(cfg, seed, rounds)
+        elif name == "partition":
+            sched = chaos_lib.Schedule.partition_drill(cfg, rounds)
+        else:
+            sched = chaos_lib.Schedule([])
         # BOTH cells carry the sampler: its per-window counters() sync is
         # part of the measured wall, so the clean-vs-chaos comparison
         # stays apples-to-apples (only the chaos cell's windows are
@@ -468,7 +479,7 @@ def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
         )
         cells[name]["writes_per_sec"] = round(
             cells[name]["writes"] / max(1e-9, wall), 1)
-        if name == "chaos":
+        if name != "clean":
             sampler.finish()
             cells[name]["event_log"] = runner.log
             cells[name]["worst_window"] = sampler.report(
@@ -478,11 +489,14 @@ def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
         "slowdown": round(cells["chaos"]["round_us"]
                           / max(1e-9, cells["clean"]["round_us"]), 3),
         "dip_pct": cells["chaos"]["worst_window"]["dip_pct"],
+        "partition_dip_pct": cells["partition"]["worst_window"]["dip_pct"],
         "platform": jax.devices()[0].platform,
         "device": getattr(jax.devices()[0], "device_kind", "?"),
         "note": "rate cells only (dip_pct = worst chaos window vs clean "
-                "rate); linearizability under the same fault classes is "
-                "gated by scripts/check_chaos.py / check_elastic.py",
+                "rate; partition cell = detector-oracle asymmetric "
+                "blackouts); linearizability under the same fault classes "
+                "is gated by scripts/check_chaos.py / check_elastic.py / "
+                "check_netchaos.py",
     }
 
 

@@ -21,8 +21,17 @@ Three legs:
      ``ChaosRunner`` drives them against FastRuntime / KVS / sim Runtime,
      every event on the obs timeline, gated end-to-end by the
      linearizability checker (scripts/check_chaos.py is the CI gate).
+  4. **Adversarial wire chaos** (round-11, ``chaos.net``) — the
+     transport-generic ``FaultingTransport`` interposer injects seeded
+     drop / duplicate / reorder / delay / corrupt / partition faults per
+     directed peer pair over ANY HostTransport; frames carry a codec CRC
+     so corruption is detected and downgraded to a drop; the ``partition``
+     /``heal`` schedule verbs compose with the detector so a
+     partitioned-but-alive replica is fenced, kept, and epoch-fenced back
+     in (scripts/check_netchaos.py is the CI gate).
 """
 
+from hermes_tpu.chaos.net import FaultingTransport, WireWindow, WIRE_OPS
 from hermes_tpu.chaos.recovery import restart_replica
 from hermes_tpu.chaos.schedule import (
     ChaosEvent,
@@ -33,6 +42,6 @@ from hermes_tpu.chaos.schedule import (
 )
 
 __all__ = [
-    "ChaosEvent", "ChaosRunner", "ChaosSpec", "NetChaos", "Schedule",
-    "restart_replica",
+    "ChaosEvent", "ChaosRunner", "ChaosSpec", "FaultingTransport",
+    "NetChaos", "Schedule", "WireWindow", "WIRE_OPS", "restart_replica",
 ]

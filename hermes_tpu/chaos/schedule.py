@@ -14,6 +14,10 @@ drive:
         @30 crash_restart 2 donor=0
         @40 hb_skew 1 skew=9 until=55
         @15 net_drop 0 dst=3 until=40
+        @20 netcorrupt 1 dst=2 until=35     # round-11 wire verbs: need a
+        @25 partition 0 until=50            # FaultingTransport interposer
+        @55 heal                            # (partition also drives the
+                                            # fast engines' detector oracle)
 
     ``Schedule.parse`` / ``Schedule.format`` round-trip it;
     ``Schedule.random(cfg, seed, steps, spec)`` draws a seeded program
@@ -47,7 +51,20 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 EVENT_KINDS = ("freeze", "thaw", "remove", "join", "crash_restart",
-               "hb_skew", "net_drop", "net_delay", "net_dup")
+               "hb_skew", "net_drop", "net_delay", "net_dup",
+               # round-11 wire-adversary verbs (chaos/net.py interposer;
+               # partition also drives the fast engines' detector oracle)
+               "netdrop", "netdelay", "netdup", "netreorder", "netcorrupt",
+               "partition", "heal")
+
+# round-11 verb -> FaultingTransport wire op.  The legacy net_* verbs keep
+# their NetChaos routing (sim-transport schedule windows) but fall back to
+# the interposer when only a FaultingTransport is attached — the same
+# fault, injected one layer up.
+WIRE_EVENTS = {"netdrop": "drop", "netdelay": "delay", "netdup": "dup",
+               "netreorder": "reorder", "netcorrupt": "corrupt"}
+LEGACY_NET_EVENTS = {"net_drop": "drop", "net_delay": "delay",
+                     "net_dup": "dup"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,10 +109,16 @@ class ChaosSpec:
     p_crash: float = 0.02
     p_skew: float = 0.02
     p_net: float = 0.0  # sim engine only; ignored elsewhere
+    # round-11 wire adversary: per-step rate of drawing ONE of the five
+    # interposer verbs (netdrop/netdelay/netdup/netreorder/netcorrupt,
+    # uniform among them) and of opening a directed partition
+    p_wire: float = 0.0
+    p_partition: float = 0.0
     skew_amount: int = 6
     skew_window: int = 12
     net_window: int = 10
     net_delay: int = 2
+    partition_window: int = 14
     # legality floor: never freeze/crash below this many healthy replicas
     min_healthy: int = 3
     # detector-less fallback: a replica frozen longer than this is removed
@@ -178,6 +201,28 @@ class Schedule:
         ])
 
     @classmethod
+    def partition_drill(cls, cfg, rounds: int, window: int = 14,
+                        spacing: int = 30, start: int = 8) -> "Schedule":
+        """Deterministic partition+heal cycles (round-11): replica
+        ``i % R``'s outbound side goes dark for ``window`` rounds starting
+        at ``start + i*spacing``, followed by a ``heal`` two rounds after
+        the window closes — so the cluster LOSES and REGAINS a replica
+        each cycle (detector ejection -> epoch-fenced rejoin) instead of
+        monotonically shrinking.  No draws: same config replays the same
+        program (the bench partition cell and soak triage both want
+        comparable cycles, not seed-lottery cluster sizes)."""
+        events = []
+        step, i = start, 0
+        while step + window + 2 < rounds:
+            events.append(ChaosEvent(step=step, kind="partition",
+                                     replica=i % cfg.n_replicas,
+                                     until=step + window))
+            events.append(ChaosEvent(step=step + window + 2, kind="heal"))
+            step += spacing
+            i += 1
+        return cls(events)
+
+    @classmethod
     def random(cls, cfg, seed: int, steps: int,
                spec: Optional[ChaosSpec] = None) -> "Schedule":
         """Seeded event program: one uniform per step selects the event
@@ -191,6 +236,7 @@ class Schedule:
             u = float(rng.random())
             pick = float(rng.random())
             lo = 0.0
+            wire_verbs = tuple(WIRE_EVENTS)
             for kind, p in (("freeze", spec.p_freeze),
                             ("thaw", spec.p_thaw),
                             ("join", spec.p_join),
@@ -198,15 +244,24 @@ class Schedule:
                             ("hb_skew", spec.p_skew),
                             ("net_drop", spec.p_net / 3),
                             ("net_delay", spec.p_net / 3),
-                            ("net_dup", spec.p_net / 3)):
+                            ("net_dup", spec.p_net / 3),
+                            ("partition", spec.p_partition),
+                            ) + tuple(
+                                (v, spec.p_wire / len(wire_verbs))
+                                for v in wire_verbs):
                 if lo <= u < lo + p:
                     kw: dict = dict(step=step, kind=kind, u=pick)
                     if kind == "hb_skew":
                         kw.update(skew=spec.skew_amount,
                                   until=step + spec.skew_window)
-                    elif kind.startswith("net_"):
+                    elif kind.startswith("net_") or kind in WIRE_EVENTS:
                         kw.update(until=step + spec.net_window,
                                   skew=spec.net_delay)
+                    elif kind == "partition":
+                        # directed (dst=-1 -> the target's whole outbound
+                        # side goes dark: an ASYMMETRIC partition — its
+                        # inbound still flows)
+                        kw.update(until=step + spec.partition_window)
                     events.append(ChaosEvent(**kw))
                     break
                 lo += p
@@ -261,7 +316,13 @@ class ChaosRunner:
 
     ``target``: FastRuntime, KVS facade, or sim-backed Runtime.
     ``net``: the NetChaos installed in the target's SimTransport (sim
-    engine only) — net_* events are logged as skipped without it.
+    engine only).
+    ``wire``: the chaos.net.FaultingTransport interposer wrapping the
+    target's HostTransport (round-11) — carries the netdrop/netdelay/
+    netdup/netreorder/netcorrupt/partition verbs (and the legacy net_*
+    verbs when ``net`` is absent).  Schedules with net-fault lines are
+    REFUSED at construction when no carrier is attached (the error names
+    the transport class).
     ``snapshot_path``: opts crash_restart into snapshot-seeded restore;
     with ``snapshot_every`` > 0 the runner refreshes the snapshot itself
     at that cadence (fast engines, quiescent boundaries only — the KVS
@@ -271,6 +332,7 @@ class ChaosRunner:
     def __init__(self, target, schedule: Schedule,
                  spec: Optional[ChaosSpec] = None,
                  net: Optional[NetChaos] = None,
+                 wire=None,
                  snapshot_path: Optional[str] = None,
                  on_step: Optional[Callable[[int], None]] = None):
         self.kvs = target if (hasattr(target, "rt")
@@ -280,6 +342,9 @@ class ChaosRunner:
         self.schedule = schedule
         self.spec = spec or ChaosSpec()
         self.net = net
+        # round-11: the transport-generic fault interposer
+        # (chaos.net.FaultingTransport wrapping the target's HostTransport)
+        self.wire = wire
         self.snapshot_path = snapshot_path
         self.on_step = on_step
         self.log: List[dict] = []
@@ -288,13 +353,59 @@ class ChaosRunner:
         self._frozen_since: Dict[int, int] = {}
         self._removed: set = set()
         self._skew_until: Dict[int, int] = {}
+        # active partitions: (until, src, dst, start) — start is kept so
+        # expiring one window can re-derive the oracle's severed set from
+        # the windows still active (overlapping windows on the same src
+        # must not end each other early)
+        self._partition_until: List[Tuple[int, int, int, int]] = []
+        self._check_net_faults_routable()
+
+    def _transport_name(self) -> str:
+        tr = getattr(self.rt, "transport", None)
+        if tr is not None:
+            return type(tr).__name__
+        return (f"{type(self.rt).__name__}"
+                f"[{getattr(self.rt, 'backend', '?')}] (no host transport)")
+
+    def _check_net_faults_routable(self) -> None:
+        """Refuse net-fault schedule lines UP FRONT when no interposer can
+        carry them (round-11 satellite): before this check, a sim-only
+        composition failed silently (events logged 'skipped') or late.  The
+        error names the transport class so the fix is actionable."""
+        wire_lines = [e for e in self.schedule if e.kind in WIRE_EVENTS]
+        legacy_lines = [e for e in self.schedule
+                        if e.kind in LEGACY_NET_EVENTS]
+        part_lines = [e for e in self.schedule if e.kind == "partition"]
+        name = self._transport_name()
+        if wire_lines and self.wire is None:
+            ls = ", ".join(e.format() for e in wire_lines[:3])
+            raise ValueError(
+                f"schedule contains wire-fault events ({ls}) but no fault "
+                f"interposer is attached to {name}: wrap the transport in "
+                "chaos.net.FaultingTransport and pass it as "
+                "ChaosRunner(..., wire=...)")
+        if legacy_lines and self.wire is None and self.net is None:
+            ls = ", ".join(e.format() for e in legacy_lines[:3])
+            raise ValueError(
+                f"schedule contains net-fault events ({ls}) but {name} has "
+                "no fault hook: pass net=NetChaos() installed as the "
+                "SimTransport schedule, or wire=chaos.net.FaultingTransport "
+                "wrapping the transport")
+        if part_lines and self.wire is None:
+            # fast engines: partition is detector-level (membership oracle)
+            if self.rt.membership is None:
+                ls = ", ".join(e.format() for e in part_lines[:3])
+                raise ValueError(
+                    f"schedule contains partition events ({ls}) but {name} "
+                    "has no fault interposer and no MembershipService: on "
+                    "the fast engines a partition acts through the "
+                    "detector — attach_membership(...) first (or run the "
+                    "sim engine with wire=FaultingTransport(...))")
 
     # -- bookkeeping ---------------------------------------------------------
 
     def _healthy(self) -> List[int]:
-        live = int(self.rt.live[0])
-        return [r for r in range(self.rt.cfg.n_replicas)
-                if (live >> r) & 1 and not self.rt.frozen[r]]
+        return self.rt.healthy_replicas()
 
     def _note(self, step: int, kind: str, **fields) -> None:
         self.log.append(dict(step=step, kind=kind, **fields))
@@ -389,18 +500,50 @@ class ChaosRunner:
                       until=self._skew_until[r])
             self._note(step, "hb_skew", replica=r, skew=e.skew,
                        until=self._skew_until[r])
-        elif e.kind.startswith("net_"):
-            if self.net is None:
-                self._note(step, "skipped", event=e.kind,
-                           reason="no sim transport")
-                return
+        elif e.kind in LEGACY_NET_EVENTS or e.kind in WIRE_EVENTS:
+            # one body for both verb generations; only the carrier differs
+            # (legacy net_* prefers the NetChaos sim schedule when present,
+            # everything else rides the round-11 interposer — construction
+            # refused schedules with no carrier at all)
+            op = LEGACY_NET_EVENTS.get(e.kind) or WIRE_EVENTS[e.kind]
             R = rt.cfg.n_replicas
             src = e.replica if e.replica >= 0 else self._pick(range(R), e.u)
             until = e.until if e.until >= 0 else step + self.spec.net_window
-            op = e.kind[len("net_"):]
-            self.net.add(op, src, e.dst, step, until, delta=e.skew)
+            if e.kind in LEGACY_NET_EVENTS and self.net is not None:
+                self.net.add(op, src, e.dst, step, until, delta=e.skew)
+            else:
+                self.wire.add(op, src, e.dst, step, until,
+                              param=e.skew if e.skew else self.spec.net_delay)
             rt._trace(e.kind, src=src, dst=e.dst, until=until)
             self._note(step, e.kind, src=src, dst=e.dst, until=until)
+            self._update_net_phase(step)
+        elif e.kind == "partition":
+            # directed: src -> dst goes dark (dst=-1: src's whole OUTBOUND
+            # side — an asymmetric partition; src still hears the cluster).
+            # On a wired engine the interposer blacks the edges out and the
+            # detector sees the starvation organically; on the fast engines
+            # (no wire) the membership oracle models exactly the
+            # detector-visible consequence (membership.sever) — the data
+            # plane of the fused round is untouched, so safety there rests
+            # on the lease rule: the ejected replica is fenced by remove().
+            R = rt.cfg.n_replicas
+            src = e.replica if e.replica >= 0 else self._pick(range(R), e.u)
+            until = e.until if e.until >= 0 else (
+                step + self.spec.partition_window)
+            if self.wire is not None:
+                self.wire.add("partition", src, e.dst, step, until)
+            svc = rt.membership
+            if self.wire is None and svc is not None:
+                svc.sever(src, e.dst, at_step=step)
+            self._partition_until.append((until, src, e.dst, step))
+            rt._trace("partition", src=src, dst=e.dst, until=until)
+            self._note(step, "partition", src=src, dst=e.dst, until=until)
+            self._update_net_phase(step)
+        elif e.kind == "heal":
+            self._heal_adversary(step)
+            self._heal_cluster(step)
+            self._note(step, "heal")
+            self._update_net_phase(step)
 
     def _expire_skews(self, step: int) -> None:
         svc = self.rt.membership
@@ -409,6 +552,84 @@ class ChaosRunner:
                 if svc is not None:
                     svc.skew[r] = 0
                 del self._skew_until[r]
+
+    def _expire_partitions(self, step: int) -> None:
+        """Restore detector-oracle partitions whose window elapsed (wire
+        windows expire by their own step test).  The severed set is
+        RE-DERIVED from the still-active windows rather than edge-wise
+        restored: a wildcard restore for one lapsed window must not end an
+        overlapping window on the same src early."""
+        if not self._partition_until:
+            return
+        svc = self.rt.membership
+        live = [p for p in self._partition_until if p[0] > step]
+        if len(live) != len(self._partition_until):
+            self._partition_until = live
+            if self.wire is None and svc is not None:
+                svc.heal_partitions()
+                # earliest-start first: sever() keeps the first since-step
+                # per edge, so overlapping windows retain the oldest age
+                for _until, src, dst, start in sorted(live,
+                                                      key=lambda p: p[3]):
+                    svc.sever(src, dst, at_step=start)
+            self._update_net_phase(step)
+
+    def _update_net_phase(self, step: int) -> None:
+        """Publish the active adversary windows into the KVS stuck-op
+        diagnostics channel (round-11 satellite: StuckOpError carries the
+        partition/drop spec + affected peer pairs, like the round-10 drill
+        phase)."""
+        if self.kvs is None:
+            return
+        edges = []
+        if self.wire is not None:
+            edges = [f"{w['op']}:{w['src']}->{w['dst']}@{w['until']}"
+                     for w in self.wire.active_windows(step)]
+        else:
+            edges = [f"partition:{src}->{dst}@{until}"
+                     for until, src, dst, _start in self._partition_until
+                     if until > step]
+        self.kvs.net_phase = dict(windows=sorted(edges)) if edges else None
+
+    def _heal_adversary(self, step: int) -> None:
+        """Clear every active network-level fault: wire windows, legacy
+        NetChaos windows, detector-oracle partitions, heartbeat skews."""
+        rt = self.rt
+        if self.net is not None:
+            self.net.clear()
+        if self.wire is not None:
+            self.wire.heal(step)
+        if rt.membership is not None:
+            rt.membership.heal_partitions()
+            for r in list(self._skew_until):
+                rt.membership.skew[r] = 0
+        self._skew_until.clear()
+        self._partition_until.clear()
+
+    def _heal_cluster(self, step: int) -> None:
+        """Thaw every frozen replica and rejoin every non-live one through
+        the epoch-fenced state-transfer join — the partition+heal cycle's
+        recovery half (a partitioned-but-alive replica kept its state; the
+        join re-validates, it never diverges).  Skips loudly when no live
+        donor exists."""
+        rt = self.rt
+        for r in list(self._frozen_since):
+            rt.thaw(r)
+            self._note(step, "thaw", replica=r, by="heal")
+        self._frozen_since.clear()
+        # the detector may have removed replicas on its own — rejoin every
+        # non-live replica, not just the runner's bookkeeping
+        for r in range(rt.cfg.n_replicas):
+            if not (int(rt.live[0]) >> r) & 1:
+                donors = self._healthy()
+                if not donors:
+                    self._note(step, "skipped", event="join", replica=r,
+                               reason="no live donor")
+                    continue
+                rt.join(r, from_replica=donors[0])
+                self._note(step, "join", replica=r, donor=donors[0],
+                           by="heal")
+        self._removed.clear()
 
     def _lease_rule(self, step: int) -> None:
         """Detector-less removal: a replica frozen past the lease window is
@@ -441,6 +662,12 @@ class ChaosRunner:
         nxt = next(ev, None)
         for step in range(steps):
             self._expire_skews(step)
+            self._expire_partitions(step)
+            if self.kvs is not None and self.wire is not None:
+                # wire windows expire by their own step test: refresh the
+                # diagnostics channel so a stuck op is never blamed on a
+                # window that already ended
+                self._update_net_phase(step)
             self._lease_rule(step)
             while nxt is not None and nxt.step <= step:
                 self._apply(step, nxt)
@@ -452,31 +679,11 @@ class ChaosRunner:
                             lost_client_futures=self.lost_client)
         if heal:
             rt = self.rt
-            if self.net is not None:
-                self.net.clear()
-            for r in list(self._skew_until):
-                if rt.membership is not None:
-                    rt.membership.skew[r] = 0
-            self._skew_until.clear()
-            for r in list(self._frozen_since):
-                rt.thaw(r)
-                self._note(steps, "thaw", replica=r, by="heal")
-            self._frozen_since.clear()
-            # the detector may have removed replicas on its own — rejoin
-            # every non-live replica, not just the runner's bookkeeping
+            self._heal_adversary(steps)
             # (skip loudly if no live donor exists rather than crash: an
             # adversarial schedule can legally empty the healthy set)
-            for r in range(rt.cfg.n_replicas):
-                if not (int(rt.live[0]) >> r) & 1:
-                    donors = self._healthy()
-                    if not donors:
-                        self._note(steps, "skipped", event="join", replica=r,
-                                   reason="no live donor")
-                        continue
-                    rt.join(r, from_replica=donors[0])
-                    self._note(steps, "join", replica=r, donor=donors[0],
-                               by="heal")
-            self._removed.clear()
+            self._heal_cluster(steps)
+            self._update_net_phase(steps)
             if self.kvs is not None:
                 # pipelined KVS: _pending (the deferred round) refills on
                 # every step, so quiescence is judged on client work only

@@ -250,6 +250,26 @@ def _check_key_exact(key: int, ops: Sequence[Op], initial_uid: Uid, max_states: 
     )
 
 
+def committed_write_lost(committed_uids, ops: Sequence[Op],
+                         aborted_uids: Optional[set] = None) -> List[Uid]:
+    """Round-11 safety cross-check, structural form of the PR-5 bug class
+    (committed-and-observed write reported aborted): given the write uids
+    the CLIENT saw commit (resolved put/rmw futures), return every uid the
+    recorded history contradicts — reported aborted, or recorded only as a
+    non-committed row (maybe_w/absent counts as lost: the history must
+    carry a definite committed write for every client-visible commit).
+    Empty list = no committed-and-observed write was ever reported
+    lost/aborted — the partition+heal acceptance criterion."""
+    aborted = aborted_uids or set()
+    definite = {o.wuid for o in ops if o.kind in ("w", "rmw")
+                and o.wuid is not None}
+    lost = []
+    for uid in committed_uids:
+        if uid in aborted or uid not in definite:
+            lost.append(uid)
+    return lost
+
+
 def sample_keys(ops: Sequence[Op], max_keys: int = 512, seed: int = 0) -> List[Op]:
     """Down-sample a huge history to ``max_keys`` keys (bench-scale runs
     check a sample; tests check everything).  Keeps whole per-key

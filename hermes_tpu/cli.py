@@ -98,6 +98,20 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="R:FROM:TO",
                     help="failure injection: freeze replica R at step FROM, "
                     "thaw at step TO (repeatable; emits obs fault events)")
+    ap.add_argument("--op-timeout", type=int, default=0, metavar="ROUNDS",
+                    help="stuck-op watchdog budget (cfg.op_timeout_rounds): "
+                    "a client op pending past this many rounds surfaces a "
+                    "stuck_op diagnostic; 0 disables")
+    ap.add_argument("--op-retries", type=int, default=0, metavar="N",
+                    help="bounded client retry (round-11, "
+                    "cfg.op_retry_limit): ops wedged on a fenced replica "
+                    "are salvaged and re-routed up to N times (needs "
+                    "--op-timeout); 0 disables")
+    ap.add_argument("--degraded-floor", type=int, default=0, metavar="N",
+                    help="quorum-loss degraded mode (round-11, cfg."
+                    "min_healthy_for_writes): with fewer than N healthy "
+                    "replicas new writes are shed loudly (kind='rejected') "
+                    "instead of wedging; 0 disables")
     ap.add_argument("--detect", type=int, default=None, metavar="CONFIRM",
                     help="attach the lease failure detector "
                     "(membership.MembershipService) with the given confirm "
@@ -303,6 +317,9 @@ def main(argv=None) -> int:
         auto_rebase=not args.no_auto_rebase,
         pipeline_depth=args.pipeline_depth,
         donate_state=not args.no_donate,
+        op_timeout_rounds=args.op_timeout,
+        op_retry_limit=args.op_retries,
+        min_healthy_for_writes=args.degraded_floor,
         workload=WorkloadConfig(
             distribution=args.distribution,
             zipf_theta=args.zipf_theta,

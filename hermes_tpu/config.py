@@ -203,6 +203,32 @@ class HermesConfig:
     # opt-in strict mode (KVS(strict_timeouts=True)) raises StuckOpError.
     op_timeout_rounds: int = 0
 
+    # Bounded client retry for ops wedged by an adversary (round-11; needs
+    # op_timeout_rounds > 0).  A stuck op whose coordinator replica is
+    # FENCED (removed from the live set or frozen — e.g. partitioned away
+    # and ejected by the detector) is salvaged exactly like a crash loses
+    # it (history fold as maybe_w for updates, volatile slot wipe so the
+    # dead uid never re-mints) and transparently re-submitted on a healthy
+    # replica with a fresh write uid, up to this many times; the ORIGINAL
+    # future resolves when the retry completes.  Exhausted retries resolve
+    # kind='lost'.  A stuck op on a HEALTHY coordinator is never retried
+    # (it may still commit — blind retry would double-write); the watchdog
+    # re-examines it after an exponential backoff instead.  0 disables
+    # (the round-9 diagnose-only watchdog).  Per-op-future path only; the
+    # batch path keeps watchdog diagnostics.
+    op_retry_limit: int = 0
+    # Backoff multiplier between stuck-op re-examinations: the k-th check
+    # of one op waits op_timeout_rounds * op_backoff**k rounds.
+    op_backoff: int = 2
+
+    # Quorum-loss degraded mode (round-11): with fewer than this many
+    # healthy (live, unfrozen, unretired) replicas, NEW puts/RMWs are shed
+    # loudly at submission (kind='rejected' / C_REJECTED — the op never
+    # entered the store, retry later) instead of queueing into a cluster
+    # that cannot commit them; gets still serve.  Entry/exit land on the
+    # obs timeline as ``degraded``/``degraded_clear``.  0 disables.
+    min_healthy_for_writes: int = 0
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or
@@ -240,6 +266,17 @@ class HermesConfig:
             raise ValueError("rmw_retries must be in [0, 2^20]")
         if self.op_timeout_rounds < 0:
             raise ValueError("op_timeout_rounds must be >= 0 (0 disables)")
+        if self.op_retry_limit < 0:
+            raise ValueError("op_retry_limit must be >= 0 (0 disables)")
+        if self.op_retry_limit and not self.op_timeout_rounds:
+            raise ValueError(
+                "op_retry_limit needs op_timeout_rounds > 0 (the watchdog "
+                "is what detects a wedged op in the first place)")
+        if self.op_backoff < 1:
+            raise ValueError("op_backoff must be >= 1")
+        if not (0 <= self.min_healthy_for_writes <= self.n_replicas):
+            raise ValueError(
+                "min_healthy_for_writes must be in [0, n_replicas]")
         if not (1 <= self.pipeline_depth <= 64):
             raise ValueError(
                 "pipeline_depth must be in [1, 64] (each in-flight round "

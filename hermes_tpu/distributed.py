@@ -24,6 +24,26 @@ import pickle
 import numpy as np
 
 
+def parse_wire_faults(spec: str):
+    """Parse the ``--wire-faults`` mini-spec: semicolon-separated
+    ``op:src:dst:from:until[:param]`` windows (op per chaos.net.WIRE_OPS;
+    src/dst of -1 match any endpoint).  Every rank passes the SAME spec, so
+    the per-rank interposers make consistent seeded decisions with no
+    coordination."""
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        toks = part.split(":")
+        if not (5 <= len(toks) <= 6):
+            raise ValueError(
+                f"bad wire fault {part!r}: want op:src:dst:from:until[:param]")
+        out.append((toks[0], int(toks[1]), int(toks[2]), int(toks[3]),
+                    int(toks[4]), int(toks[5]) if len(toks) == 6 else 0))
+    return out
+
+
 def run_replica(
     cfg,
     rank: int,
@@ -32,39 +52,38 @@ def run_replica(
     base_port: int = 29500,
     hosts: str | None = None,
     out_path: str | None = None,
+    wire_seed: int = 0,
+    wire_faults: str | None = None,
 ):
     import jax
     import jax.numpy as jnp
 
     from hermes_tpu.checker.history import HistoryRecorder
     from hermes_tpu.core import state as st, step as step_lib
-    from hermes_tpu.transport import codec
-    from hermes_tpu.transport.tcp import TcpMesh
+    from hermes_tpu.transport.tcp import TcpHostTransport
     from hermes_tpu.workload import ycsb
 
-    mesh = TcpMesh(rank, n_ranks, hosts=hosts, base_port=base_port)
+    tcp_t = TcpHostTransport(cfg, rank, n_ranks, hosts=hosts,
+                             base_port=base_port)
+    transport = tcp_t
+    wire = None
+    if wire_faults:
+        # adversarial wire chaos over the REAL socket transport (round-11):
+        # the interposer runs per rank on the inbound path; identical specs
+        # + seed on every rank give a consistent global adversary
+        from hermes_tpu.chaos.net import FaultingTransport
+
+        wire = FaultingTransport(tcp_t, n_ranks, seed=wire_seed,
+                                 local_rank=rank)
+        for op, src, dst, from_step, until, param in parse_wire_faults(
+                wire_faults):
+            wire.add(op, src, dst, from_step, until, param)
+        transport = wire
     rs = st.init_replica_state(cfg)
     stream = jax.tree.map(jnp.asarray, ycsb.make_stream(cfg, rank))
     recorder = HistoryRecorder(cfg)
 
     ph = {k: jax.jit(v) for k, v in step_lib.phase_fns(cfg).items()}
-
-    inv_t = st.empty_invs(cfg)
-    ack_row_t = jax.tree.map(lambda x: x[0], st.empty_acks(cfg, lead=(n_ranks,)))
-    val_t = st.empty_vals(cfg)
-
-    def bcast(kind_template, block):
-        """Broadcast: same serialized block to every peer."""
-        b = codec.pack(jax.device_get(block))
-        inb = mesh.exchange(np.tile(b[None], (n_ranks, 1)))
-        return codec.stack([codec.unpack(kind_template, inb[r]) for r in range(n_ranks)])
-
-    def route_ack(block):
-        """Acks: row p of my (R, L) block goes to rank p."""
-        blk = jax.device_get(block)
-        rows = [codec.pack(jax.tree.map(lambda x: np.asarray(x)[p], blk)) for p in range(n_ranks)]
-        inb = mesh.exchange(np.stack(rows))
-        return codec.stack([codec.unpack(ack_row_t, inb[r]) for r in range(n_ranks)])
 
     to_j = lambda b: jax.tree.map(jnp.asarray, b)
 
@@ -80,9 +99,9 @@ def run_replica(
         rs, comp = step_lib._step_core(
             cfg,
             ph,
-            lambda blk: to_j(bcast(inv_t, blk)),
-            lambda blk: to_j(route_ack(blk)),
-            lambda blk: to_j(bcast(val_t, blk)),
+            lambda blk, s=step: to_j(transport.exchange_inv(blk, s)),
+            lambda blk, s=step: to_j(transport.exchange_ack(blk, s)),
+            lambda blk, s=step: to_j(transport.exchange_val(blk, s)),
             rs,
             stream,
             ctl,
@@ -111,11 +130,15 @@ def run_replica(
             n_rmw=int(jax.device_get(rs.meta.n_rmw)),
             n_abort=int(jax.device_get(rs.meta.n_abort)),
         ),
+        corrupt_dropped=tcp_t.corrupt_dropped,
+        wire=(dict(counters=dict(wire.counters),
+                   fault_log_len=len(wire.fault_log))
+              if wire is not None else None),
     )
     if out_path:
         with open(out_path, "wb") as f:
             pickle.dump(result, f)
-    mesh.close()
+    tcp_t.close()
     return result
 
 
@@ -147,6 +170,12 @@ def _main():
     ap.add_argument("--read-frac", type=float, default=0.5)
     ap.add_argument("--rmw-frac", type=float, default=0.2)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--wire-seed", type=int, default=0,
+                    help="seed for the adversarial wire interposer")
+    ap.add_argument("--wire-faults", type=str, default=None,
+                    help="semicolon-separated op:src:dst:from:until[:param] "
+                         "windows injected by chaos.net.FaultingTransport "
+                         "over the tcp transport (same spec on every rank)")
     args = ap.parse_args()
 
     from hermes_tpu.config import HermesConfig, WorkloadConfig
@@ -168,6 +197,8 @@ def _main():
         base_port=args.base_port,
         hosts=args.hosts,
         out_path=args.out,
+        wire_seed=args.wire_seed,
+        wire_faults=args.wire_faults,
     )
 
 

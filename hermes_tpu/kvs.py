@@ -73,6 +73,7 @@ class StuckOpError(RuntimeError):
                 f"r{d['replica']}/s{d['session']} {d['kind']} key={d['key']} "
                 f"phase={d['phase']}"
                 + (f" drill={d['drill']}" if "drill" in d else "")
+                + (f" net={d['net']}" if "net" in d else "")
                 + f" age={d['age_rounds']}"
                 for d in diagnostics[:4]))
 
@@ -266,6 +267,23 @@ class KVS:
         self._fence_mask = np.zeros(cfg.n_keys, bool)
         self.drill_phase: Optional[str] = None
         self.rejected_ops = 0
+        # adversarial wire chaos (round-11, hermes_tpu/chaos/net.py):
+        # net_phase tags the active adversary window (partition / net-fault
+        # spec + affected peer pairs, set by chaos.ChaosRunner — the
+        # drill_phase pattern for the wire) into stuck-op diagnostics so
+        # soak triage needs no log cross-referencing.  Bounded retry
+        # (cfg.op_retry_limit): per-(replica, session) escalation state of
+        # the stuck-op watchdog — next re-examination step and how many
+        # backoff windows have elapsed.  Degraded mode
+        # (cfg.min_healthy_for_writes): on quorum loss new writes shed
+        # loudly (kind='rejected') instead of wedging; shed_writes counts
+        # them and the transition lands on the obs timeline.
+        self.net_phase: Optional[dict] = None
+        self._retry_next: Dict[Tuple[int, int], int] = {}
+        self._retry_k: Dict[Tuple[int, int], int] = {}
+        self.retried_ops = 0
+        self.shed_writes = 0
+        self._degraded = False
         # sparse-key mode (SURVEY.md §1 L2, MICA-index parity): arbitrary
         # 64-bit client keys map to dense device slots through an exact
         # open-addressing index (hermes_tpu/keyindex.py); completions
@@ -286,6 +304,19 @@ class KVS:
             raise ValueError(f"replica {replica} out of range [0, {cfg.n_replicas})")
         if not (0 <= session < cfg.n_sessions):
             raise ValueError(f"session {session} out of range [0, {cfg.n_sessions})")
+        if kind != "get" and self._degraded_now():
+            # quorum-loss degraded mode (round-11): the cluster cannot
+            # commit writes right now — shed loudly instead of wedging the
+            # session until the watchdog complains.  BEFORE the sparse-key
+            # index insert: a shed op must not consume a dense slot
+            # (KeyIndex never deletes; an outage of novel-key puts would
+            # otherwise burn the keyspace).  Counted in shed_writes ONLY
+            # (rejected_ops stays the elastic fence/retire count).
+            self.shed_writes += 1
+            fut = Future()
+            fut._result = Completion(kind="rejected", key=int(key),
+                                     found=False)
+            return fut
         if self.index is not None:
             client_key = int(key)
             if not (0 <= client_key < (1 << 64) - 1):
@@ -313,11 +344,28 @@ class KVS:
             # client is told NOW, not stranded
             return self._rejected_future(client_key)
         fut = Future()
-        self._queues[(replica, session)].append((kind, slot, client_key, value, fut))
+        self._queues[(replica, session)].append(
+            (kind, slot, client_key, value, fut, 0))
         self._queued_slots.add((replica, session))
         if (replica, session) not in self._inflight:
             self._ready.add((replica, session))
         return fut
+
+    def _degraded_now(self) -> bool:
+        """Quorum-loss degraded mode (cfg.min_healthy_for_writes): too few
+        healthy un-retired replicas to commit new writes.  Transitions land
+        on the obs timeline as ``degraded`` / ``degraded_clear``."""
+        floor = self.cfg.min_healthy_for_writes
+        if not floor:
+            return False
+        healthy = [r for r in self.rt.healthy_replicas()
+                   if r not in self._retired]
+        degraded = len(healthy) < floor
+        if degraded != self._degraded:
+            self._degraded = degraded
+            self.rt._trace("degraded" if degraded else "degraded_clear",
+                           healthy=len(healthy), floor=floor)
+        return degraded
 
     def _rejected_future(self, client_key: int) -> Future:
         self.rejected_ops += 1
@@ -377,13 +425,22 @@ class KVS:
                 raise ValueError(f"values must be (n, <={u}) int32 words")
             uval[:, : v.shape[1]] = v
         bf = BatchFutures(opc.copy(), keys_arr.copy(), u)
+        if self._degraded_now():
+            # quorum-loss degraded mode (round-11): shed writes loudly
+            # BEFORE the sparse-key index mapping — a shed op must not
+            # consume a dense slot; gets still serve
+            shed = opc != t.OP_READ
+            if shed.any():
+                bf.code[shed] = C_REJECTED
+                bf.found[shed] = False
+                self.shed_writes += int(shed.sum())
         if self.index is not None:
             k64 = keys_arr.astype(np.uint64)
             slots = np.zeros(n, np.int32)
-            wr = opc != t.OP_READ
+            wr = (opc != t.OP_READ) & (bf.code == 0)
             if wr.any():
                 slots[wr] = self.index.get_slots(k64[wr])
-            rd = ~wr
+            rd = (opc == t.OP_READ) & (bf.code == 0)
             if rd.any():
                 got = self.index.get_slots(k64[rd], insert=False)
                 gi = np.nonzero(rd)[0]
@@ -483,7 +540,7 @@ class KVS:
                 # the replica retired after these ops were queued: reject
                 # them loudly (shrink() sweeps too; this covers races)
                 while q:
-                    _k, _sl, ck, _v, fut = q.popleft()
+                    _k, _sl, ck, _v, fut, _n = q.popleft()
                     fut._result = Completion(kind="rejected", key=ck,
                                              found=False)
                     self.rejected_ops += 1
@@ -492,7 +549,7 @@ class KVS:
             if self._slot_bid[rs_key] >= 0:
                 waiting.add(rs_key)
                 continue
-            kind, slot, client_key, value, fut = q.popleft()
+            kind, slot, client_key, value, fut, nretry = q.popleft()
             if self._fence_mask[slot]:
                 # the range fenced after this op was queued (fence_slots
                 # sweeps the queues, but an op enqueued mid-drain by a
@@ -512,7 +569,7 @@ class KVS:
             self._key[r, s, 0] = slot
             if value is not None:
                 self._uval[r, s, 0] = value
-            self._inflight[rs_key] = (kind, fut, client_key)
+            self._inflight[rs_key] = (kind, fut, client_key, value, nretry)
             self._kindarr[r, s] = self._OPC[kind]
             self._slot_inject[r, s] = self.rt.step_idx
             self._dirty = True
@@ -588,7 +645,9 @@ class KVS:
                     self._ready.add(rs_key)
         for r, s in np.argwhere(done_mask & ~bdone):
             r, s = int(r), int(s)
-            kind, fut, client_key = self._inflight.pop((r, s))
+            kind, fut, client_key, _value, _nretry = self._inflight.pop((r, s))
+            self._retry_next.pop((r, s), None)
+            self._retry_k.pop((r, s), None)
             c = int(code[r, s])
             done = Completion(
                 kind="rmw_abort" if c == t.C_RMW_ABORT else kind,
@@ -633,43 +692,133 @@ class KVS:
             if tag not in self._stuck_flagged:
                 self._stuck_flagged.add(tag)
                 fresh.append((int(r), int(s)))
-        if not fresh:
-            return
-        sess = self.rt.fs.sess
-        status = np.asarray(jax.device_get(sess.status))
-        acks = np.asarray(jax.device_get(sess.acks))
         new_diags = []
-        for r, s in fresh:
-            # report the CLIENT's key: in sparse-key mode the staged
-            # stream holds the dense device slot, which the client never
-            # saw — the per-op inflight entry / batch columns carry the
-            # submitted key
-            if (r, s) in self._inflight:
-                ckey = self._inflight[(r, s)][2]
-            elif self._slot_bid[r, s] >= 0:
-                b = self._bat.get(int(self._slot_bid[r, s]))
-                ckey = (int(b["bf"].key[int(self._slot_bix[r, s])])
-                        if b is not None else int(self._key[r, s, 0]))
-            else:
-                ckey = int(self._key[r, s, 0])
-            diag = dict(
-                replica=r, session=s,
-                key=int(ckey),
-                kind=BatchFutures._KINDSTR.get(int(self._kindarr[r, s]), "?"),
-                phase=self._PHASE.get(int(status[r, s]), "?"),
-                acks=int(acks[r, s]),
-                age_rounds=int(age[r, s]),
-                at_step=self.rt.step_idx,
-            )
-            if self.drill_phase is not None:
-                # an elastic drill (fence/drain/flip) is active: a wedged
-                # op must be attributable to it from the timeline alone
-                diag["drill"] = self.drill_phase
-            new_diags.append(diag)
-            self.stuck_ops.append(diag)
-            self.rt._trace("stuck_op", **diag)
+        if fresh:
+            sess = self.rt.fs.sess
+            status = np.asarray(jax.device_get(sess.status))
+            acks = np.asarray(jax.device_get(sess.acks))
+            for r, s in fresh:
+                # report the CLIENT's key: in sparse-key mode the staged
+                # stream holds the dense device slot, which the client never
+                # saw — the per-op inflight entry / batch columns carry the
+                # submitted key
+                if (r, s) in self._inflight:
+                    ckey = self._inflight[(r, s)][2]
+                elif self._slot_bid[r, s] >= 0:
+                    b = self._bat.get(int(self._slot_bid[r, s]))
+                    ckey = (int(b["bf"].key[int(self._slot_bix[r, s])])
+                            if b is not None else int(self._key[r, s, 0]))
+                else:
+                    ckey = int(self._key[r, s, 0])
+                diag = dict(
+                    replica=r, session=s,
+                    key=int(ckey),
+                    kind=BatchFutures._KINDSTR.get(
+                        int(self._kindarr[r, s]), "?"),
+                    phase=self._PHASE.get(int(status[r, s]), "?"),
+                    acks=int(acks[r, s]),
+                    age_rounds=int(age[r, s]),
+                    at_step=self.rt.step_idx,
+                )
+                if self.drill_phase is not None:
+                    # an elastic drill (fence/drain/flip) is active: a
+                    # wedged op must be attributable to it from the
+                    # timeline alone
+                    diag["drill"] = self.drill_phase
+                if self.net_phase is not None:
+                    # adversarial wire window active (round-11): the diag
+                    # carries the partition/drop spec and affected peer
+                    # pairs, so soak triage needs no log cross-referencing
+                    diag["net"] = self.net_phase
+                new_diags.append(diag)
+                self.stuck_ops.append(diag)
+                self.rt._trace("stuck_op", **diag)
+        if self.cfg.op_retry_limit:
+            self._escalate_stuck(stuck)
         if self.strict_timeouts and new_diags:
             raise StuckOpError(new_diags)
+
+    def _escalate_stuck(self, stuck: np.ndarray) -> None:
+        """Bounded retry with backoff (round-11, cfg.op_retry_limit): a
+        stuck per-op future whose coordinator is FENCED (not live, frozen,
+        or retired — e.g. partitioned away and ejected by the detector) is
+        salvaged and re-submitted on a healthy replica; a stuck op on a
+        healthy coordinator is re-examined after an exponential backoff
+        window instead (it may yet commit — blind retry would
+        double-write)."""
+        step = self.rt.step_idx
+        healthy = set(self.rt.healthy_replicas()) - self._retired
+        for rs_key in [k for k in list(self._inflight) if stuck[k]]:
+            if rs_key not in self._inflight:
+                continue  # resolved by an earlier salvage's pipeline flush
+            r, s = rs_key
+            nxt = self._retry_next.get(rs_key)
+            if nxt is None:
+                self._retry_next[rs_key] = step  # examine immediately
+            elif step < nxt:
+                continue
+            if r in healthy:
+                # coordinator healthy: back off — the op may still commit
+                k = self._retry_k.get(rs_key, 0)
+                self._retry_k[rs_key] = k + 1
+                self._retry_next[rs_key] = step + (
+                    self.cfg.op_timeout_rounds * self.cfg.op_backoff ** (k + 1))
+                continue
+            self._salvage_retry(r, s, sorted(healthy))
+
+    def _salvage_retry(self, r: int, s: int, healthy: list) -> None:
+        """Salvage one wedged per-op future off fenced coordinator ``r``
+        (exactly the crash model, per slot: history fold as maybe_w for
+        updates, volatile wipe so the dead uid never re-mints, staged
+        stream slot cleared) and re-enqueue it on a healthy replica with
+        the SAME future; exhausted retries (or no healthy replica, or a
+        fenced range) resolve loudly instead."""
+        from hermes_tpu.chaos import recovery as recovery_lib
+
+        rt = self.rt
+        rt.flush_pipeline()  # a deferred round may have completed this op
+        if (r, s) not in self._inflight or self._slot_inject[r, s] < 0:
+            self._retry_next.pop((r, s), None)
+            self._retry_k.pop((r, s), None)
+            return
+        kind, fut, ck, value, nretry = self._inflight.pop((r, s))
+        slot = int(self._key[r, s, 0])
+        mask = np.zeros((self.cfg.n_replicas, self.cfg.n_sessions), bool)
+        mask[r, s] = True
+        if kind != "get" and rt.recorder is not None:
+            # the wedged broadcast may still commit via replay: the history
+            # must be ALLOWED — not required — to linearize it
+            rt.recorder.fold_pending(rt._sess_view(), mask=mask)
+        recovery_lib.wipe_volatile(rt, mask)
+        self._op[r, s, 0] = t.OP_NOP
+        self._kindarr[r, s] = t.OP_NOP
+        self._slot_inject[r, s] = -1
+        self._dirty = True
+        self._retry_next.pop((r, s), None)
+        self._retry_k.pop((r, s), None)
+        terminal = None
+        if self._fence_mask[slot]:
+            terminal = "rejected"  # the range migrated away mid-wedge
+        elif nretry >= self.cfg.op_retry_limit or not healthy:
+            terminal = "lost"  # retries exhausted / nowhere to go
+        if terminal is not None:
+            fut._result = Completion(kind=terminal, key=ck, found=False)
+            if terminal == "rejected":
+                self.rejected_ops += 1
+            rt._trace("op_retry_exhausted", replica=r, session=s, key=ck,
+                      outcome=terminal, retries=nretry)
+        else:
+            target = healthy[(r + 1 + nretry) % len(healthy)]
+            self.retried_ops += 1
+            rt._trace("op_retry", replica=r, session=s, key=ck,
+                      target=target, attempt=nretry + 1)
+            self._queues[(target, s)].append(
+                (kind, slot, ck, value, fut, nretry + 1))
+            self._queued_slots.add((target, s))
+            if (target, s) not in self._inflight:
+                self._ready.add((target, s))
+        if self._queues.get((r, s)):
+            self._ready.add((r, s))  # traffic queued behind the salvaged op
 
     def step(self) -> int:
         """Inject queued ops, run one protocol round, resolve completions.
@@ -847,7 +996,7 @@ class KVS:
             for r, s in np.argwhere(mask):
                 r, s = int(r), int(s)
                 if (r, s) in self._inflight:
-                    _kind, fut, ck = self._inflight.pop((r, s))
+                    _kind, fut, ck, _v, _n = self._inflight.pop((r, s))
                     fut._result = Completion(kind="lost", key=ck, found=False)
                     salvaged += 1
                 elif self._slot_bid[r, s] >= 0:
@@ -901,7 +1050,7 @@ class KVS:
                 continue
             q = self._queues[rs_key]
             while q:
-                _k, _sl, ck, _v, fut = q.popleft()
+                _k, _sl, ck, _v, fut, _n = q.popleft()
                 fut._result = Completion(kind="rejected", key=ck, found=False)
                 self.rejected_ops += 1
             self._queued_slots.discard(rs_key)
@@ -940,7 +1089,7 @@ class KVS:
         number of client ops lost."""
         lost = 0
         for rs_key in [k for k in self._inflight if k[0] == replica]:
-            _kind, fut, client_key = self._inflight.pop(rs_key)
+            _kind, fut, client_key, _v, _n = self._inflight.pop(rs_key)
             fut._result = Completion(kind="lost", key=client_key, found=False)
             lost += 1
         for s in np.nonzero(self._slot_bid[replica] >= 0)[0]:
@@ -959,6 +1108,9 @@ class KVS:
         self._kindarr[replica] = t.OP_NOP
         self._slot_inject[replica] = -1
         self._dirty = True
+        for rs_key in [k for k in self._retry_next if k[0] == replica]:
+            self._retry_next.pop(rs_key, None)
+            self._retry_k.pop(rs_key, None)
         for rs_key in self._queued_slots:
             if rs_key[0] == replica:
                 self._ready.add(rs_key)

@@ -88,6 +88,46 @@ class MembershipService:
         # injected heartbeat clock-skew, added to every observed age of the
         # replica (chaos.schedule's hb_skew events)
         self.skew = np.zeros(cfg.n_replicas, np.int64)
+        # partition oracle (round-11, chaos/net.py): directed heartbeat
+        # edges severed by an adversarial partition.  The FAST engines have
+        # no wire to cut — their round is fused on one device/mesh — so a
+        # ``partition`` schedule verb models exactly the detector-visible
+        # consequence: observer ``dst`` stops hearing replica ``src`` from
+        # the sever step on, and the observed age is floored at
+        # ``step - since`` for that edge.  The sim/tcp engines never need
+        # this (FaultingTransport starves last_seen organically); directed
+        # edges make partitions asymmetric, and the min-over-observers rule
+        # below already guarantees ONE severed observer cannot eject a
+        # replica the rest of the cluster hears fine.
+        self._severed: Dict[tuple, int] = {}  # (src, dst) -> since step
+
+    # -- partition oracle (round-11) ----------------------------------------
+
+    def sever(self, src: int, dst: int, at_step: int) -> None:
+        """Cut the directed heartbeat edge src -> dst (dst = -1: src's
+        heartbeats reach NO observer — full outbound isolation)."""
+        dsts = range(self.cfg.n_replicas) if dst < 0 else (dst,)
+        for d in dsts:
+            if d != src:
+                self._severed.setdefault((src, d), at_step)
+
+    def restore(self, src: int = -1, dst: int = -1) -> int:
+        """Re-connect matching severed edges (-1 = wildcard); returns the
+        number restored."""
+        victims = [e for e in self._severed
+                   if (src < 0 or e[0] == src) and (dst < 0 or e[1] == dst)]
+        for e in victims:
+            del self._severed[e]
+        return len(victims)
+
+    def heal_partitions(self) -> int:
+        n = len(self._severed)
+        self._severed.clear()
+        return n
+
+    def severed_edges(self) -> list:
+        """Active severed (src, dst) edges — diagnostics surface."""
+        return sorted(self._severed)
 
     # -- detector input ------------------------------------------------------
 
@@ -142,8 +182,16 @@ class MembershipService:
                 # rejoin — no post-join lease window has been observed yet
                 continue
             # freshest observation of r = max last_seen over observers
-            # = MIN age over observers
-            age = int(min(int(ages[i, r]) for i in observers))
+            # = MIN age over observers; a severed edge r -> i floors
+            # observer i's view at the partition age (round-11 oracle)
+            def _age(i: int) -> int:
+                a = int(ages[i, r])
+                since = self._severed.get((r, i))
+                if since is not None:
+                    a = max(a, step - since)
+                return a
+
+            age = int(min(_age(i) for i in observers))
             age += int(self.skew[r])
             if age <= self.cfg.lease_steps:
                 if self.suspects.pop(r, None) is not None:

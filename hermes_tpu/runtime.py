@@ -53,6 +53,15 @@ class _ObsHooks:
         if self.obs is not None:
             self.obs.tracer.event(name, step=self.step_idx, **fields)
 
+    def healthy_replicas(self) -> list:
+        """Replicas that are live AND unfrozen — the set that can serve and
+        ack right now.  One definition for every consumer (chaos runner
+        legality floors, KVS degraded mode + retry routing, grow/restart
+        donor selection) so 'healthy' cannot drift between subsystems."""
+        live = int(self.live[0])
+        return [r for r in range(self.cfg.n_replicas)
+                if (live >> r) & 1 and not self.frozen[r]]
+
 
 class _ElasticResize:
     """Live group resize (round-10, hermes_tpu/elastic): administrative
@@ -87,10 +96,7 @@ class _ElasticResize:
         if (int(self.live[0]) >> replica) & 1 and not self.frozen[replica]:
             raise ValueError(f"replica {replica} is already live")
         if from_replica is None:
-            live = int(self.live[0])
-            cands = [d for d in range(self.cfg.n_replicas)
-                     if d != replica and (live >> d) & 1
-                     and not self.frozen[d]]
+            cands = [d for d in self.healthy_replicas() if d != replica]
             if not cands:
                 raise RuntimeError("grow needs a live unfrozen donor; "
                                    "none left")

@@ -1,0 +1,283 @@
+"""Round-11 adversarial wire chaos gate (CI): the transport-generic fault
+interposer, the CRC'd frame layer, and partition tolerance must hold their
+contracts on every change.
+
+Five assertions, CPU-smoke sized (the SEVENTH gate — joins census,
+obs-overhead, analysis, pipeline, chaos and elastic in the verify flow;
+scripts/run_gates.py runs all of them serially):
+
+  1. wire-matrix soak — a seeded schedule of drop / duplicate / reorder /
+     delay / corrupt / asymmetric-partition windows (chaos.net.
+     FaultingTransport) composed with freezes, on the sim engine with the
+     failure detector attached: the linearizability checker passes, every
+     fault class actually fired, a partitioned replica was ejected and
+     rejoined through the epoch-fenced join, and NO corrupted frame was
+     ever applied (CRC downgraded every one to a drop);
+  2. transport-generic — the SAME interposer and schedule over a different
+     inner transport (the lockstep loopback), checker-gated: the adversary
+     is not welded to the sim transport;
+  3. determinism — same seed + config replays a byte-identical executed
+     fault log (runner events + wire fault log) AND final state;
+  4. CRC red test — a corrupted frame is rejected by codec.frame_unpack,
+     and the crc=False interposer path proves the damage would otherwise
+     reach the protocol (scrambled bytes delivered);
+  5. partition tolerance at pipeline depth 2, BOTH fast engines — a
+     KVS(depth=2) run under partition/heal schedules with the detector
+     attached: every client future resolves despite the adversary (bounded
+     retry re-routes ops wedged on the ejected replica), the checker
+     passes, and no committed-and-observed write is ever reported
+     lost/aborted across the partition+heal cycle
+     (lin.committed_write_lost == []).
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_netchaos.py
+
+Prints one JSON line (also written to NETCHAOS_SOAK.json); exit non-zero on
+any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+SEED = 31
+STEPS = 80
+
+
+def _wire_cfg():
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    return HermesConfig(
+        n_replicas=4, n_keys=64, n_sessions=4, replay_slots=8,
+        ops_per_session=16, replay_age=5, lease_steps=6,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.2, seed=SEED),
+    )
+
+
+WIRE_SCHEDULE = """
+    @4  netdrop 0 dst=2 until=24
+    @6  netdelay 1 skew=2 until=30
+    @8  netdup 2 until=26
+    @10 netreorder 3 dst=0 skew=3 until=32
+    @12 netcorrupt 1 dst=3 until=28
+    @16 partition 2 until=40        # asymmetric: 2's outbound goes dark
+    @20 freeze 3
+    @28 thaw 3
+    @44 heal
+"""
+
+
+def _run_wire(inner_kind: str):
+    from hermes_tpu import chaos
+    from hermes_tpu.membership import MembershipService
+    from hermes_tpu.runtime import Runtime
+    from hermes_tpu.transport.base import LockstepHostTransport
+    from hermes_tpu.transport.sim import SimTransport
+
+    cfg = _wire_cfg()
+    inner = (SimTransport(cfg.n_replicas) if inner_kind == "sim"
+             else LockstepHostTransport())
+    wire = chaos.FaultingTransport(inner, cfg.n_replicas, seed=SEED)
+    rt = Runtime(cfg, backend="sim", record=True, transport=wire)
+    rt.attach_membership(MembershipService(cfg, confirm_steps=2))
+    sched = chaos.Schedule.parse(WIRE_SCHEDULE)
+    runner = chaos.ChaosRunner(rt, sched, wire=wire)
+    res = runner.run(64, check=True)
+    return rt, wire, runner, res
+
+
+def check_wire_matrix(report: dict) -> None:
+    for inner_kind in ("sim", "lockstep"):
+        rt, wire, runner, res = _run_wire(inner_kind)
+        assert res["drained"], f"{inner_kind}: did not drain"
+        assert res["checked_ok"], (
+            f"{inner_kind}: checker FAIL {res['check_failures']}")
+        c = wire.counters
+        for op in ("drop", "delay", "dup", "reorder", "partition"):
+            assert c.get(f"wire_{op}", 0) > 0, (
+                f"{inner_kind}: fault class {op} never fired ({dict(c)})")
+        assert c.get("wire_corrupt", 0) > 0, f"{inner_kind}: no corruption"
+        assert c.get("wire_corrupt_dropped", 0) == c["wire_corrupt"], (
+            f"{inner_kind}: corrupt frames not all dropped ({dict(c)})")
+        assert c.get("wire_corrupt_applied", 0) == 0, (
+            f"{inner_kind}: a corrupted frame was APPLIED")
+        mem = [(e.kind, e.replica) for e in rt.membership.events]
+        assert ("remove", 2) in mem and ("join", 2) in mem, (
+            f"{inner_kind}: partitioned replica not ejected+rejoined {mem}")
+        report[f"wire_{inner_kind}"] = dict(
+            events=len(runner.log), faults=dict(c),
+            membership=[f"{k}:{r}" for k, r in mem], checked_ok=True)
+
+
+def check_determinism(report: dict) -> None:
+    import jax
+    import numpy as np
+
+    logs, states = [], []
+    for _ in range(2):
+        rt, wire, runner, res = _run_wire("sim")
+        assert res["checked_ok"]
+        logs.append(runner.log_json() + "\n" + wire.fault_log_json())
+        states.append(jax.tree.leaves(jax.device_get(rt.rs)))
+    assert logs[0] == logs[1], "executed fault logs differ across replays"
+    for x, y in zip(states[0], states[1]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    report["deterministic_replay"] = True
+
+
+def check_crc_red(report: dict) -> None:
+    import numpy as np
+
+    from hermes_tpu import chaos
+    from hermes_tpu.core import state as st
+    from hermes_tpu.transport import codec
+    from hermes_tpu.transport.base import LockstepHostTransport
+
+    # codec level: a flipped payload byte must be REJECTED
+    payload = np.arange(256, dtype=np.uint8)
+    frame = codec.frame_pack(payload)
+    np.testing.assert_array_equal(codec.frame_unpack(frame), payload)
+    torn = frame.copy()
+    torn[codec.FRAME_OVERHEAD + 40] ^= 0x01
+    try:
+        codec.frame_unpack(torn)
+        raise AssertionError("corrupted frame passed the checksum")
+    except codec.FrameCorrupt:
+        pass
+
+    # interposer level: with CRC the corrupted pair frame is NEVER applied
+    # (zero block); without it the scramble reaches the protocol — the red
+    # half that proves what the checksum is for
+    cfg = _wire_cfg()
+    out = st.empty_invs(cfg, lead=(cfg.n_replicas,))
+    out = out._replace(
+        valid=np.ones_like(np.asarray(out.valid)),
+        key=np.full_like(np.asarray(out.key), 7),
+        alive=np.ones_like(np.asarray(out.alive)))
+    clean = {f: np.asarray(v)[1, 0]  # dst=1, src=0 pair, unfaulted
+             for f, v in LockstepHostTransport().exchange_inv(
+                 out, 0)._asdict().items()}
+    delivered = {}
+    for crc in (True, False):
+        wire = chaos.FaultingTransport(LockstepHostTransport(),
+                                       cfg.n_replicas, seed=3, crc=crc)
+        wire.add("corrupt", 0, 1, 0, 10)
+        inb = wire.exchange_inv(out, step=0)
+        delivered[crc] = {f: np.asarray(v)[1, 0]
+                          for f, v in inb._asdict().items()}
+        if crc:
+            assert wire.counters["wire_corrupt_dropped"] > 0
+        else:
+            assert wire.counters["wire_corrupt_applied"] > 0
+    for f, v in delivered[True].items():
+        assert (v == 0).all(), (
+            f"CRC on: corrupted frame must arrive as a DROP (zero block); "
+            f"field {f} leaked through")
+    assert any(not np.array_equal(delivered[False][f], clean[f])
+               for f in clean), (
+        "crc=False run should show the scramble reaching the protocol")
+    report["crc_red_test"] = True
+
+
+def check_partition_fast(report: dict) -> None:
+    import jax
+    import numpy as np
+
+    from hermes_tpu import chaos
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.membership import MembershipService
+    from hermes_tpu.obs import Observability
+
+    for backend in ("batched", "sharded"):
+        mesh = None
+        if backend == "sharded":
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()[:5]), ("replica",))
+        cfg = HermesConfig(
+            n_replicas=5, n_keys=64, n_sessions=4, replay_slots=6,
+            value_words=4, ops_per_session=1, lease_steps=5,
+            pipeline_depth=2, op_timeout_rounds=6, op_retry_limit=2,
+            rebroadcast_every=2, replay_scan_every=4,
+            workload=WorkloadConfig(seed=SEED))
+        kvs = KVS(cfg, backend=backend, mesh=mesh, record=True)
+        obs = kvs.rt.attach_obs(Observability())
+        kvs.rt.attach_membership(MembershipService(cfg, confirm_steps=2))
+        sched = chaos.Schedule.parse(
+            "@4 partition 1 until=60\n@14 freeze 3\n@24 thaw 3\n@62 heal\n")
+        runner = chaos.ChaosRunner(kvs, sched)
+        futs = []
+
+        def on_step(step):
+            if step % 3 == 0 and step < 55:
+                r = (step // 3) % cfg.n_replicas
+                futs.append(kvs.put(r, (step // 15) % cfg.n_sessions,
+                                    (7 * step) % cfg.n_keys, [step + 1]))
+
+        runner.on_step = on_step
+        res = runner.run(110, check=True)
+        assert res["drained"], f"{backend}: did not drain"
+        assert res["checked_ok"], (
+            f"{backend}: checker FAIL {res['check_failures']}")
+        unresolved = [f for f in futs if not f.done()]
+        assert not unresolved, (
+            f"{backend}: {len(unresolved)} futures stranded by the adversary")
+        mem = [(e.kind, e.replica) for e in kvs.rt.membership.events]
+        assert ("remove", 1) in mem and ("join", 1) in mem, (
+            f"{backend}: partitioned replica not ejected+rejoined {mem}")
+        assert kvs.retried_ops > 0, (
+            f"{backend}: no bounded retry fired (stuck={len(kvs.stuck_ops)})")
+        ev = [r.get("name") for r in obs.records if r.get("kind") == "event"]
+        assert ev.count("membership_fetch") == 0, (
+            f"{backend}: detector fetched on the dispatch path")
+        committed = [f.result().uid for f in futs
+                     if f.result().kind == "put"]
+        lost = lin.committed_write_lost(
+            committed, kvs.rt.history_ops(), kvs.rt.recorder.aborted_uids)
+        assert not lost, (
+            f"{backend}: committed-and-observed writes reported "
+            f"lost/aborted across partition+heal: {lost}")
+        kinds: dict = {}
+        for f in futs:
+            kinds[f.result().kind] = kinds.get(f.result().kind, 0) + 1
+        report[f"partition_{backend}"] = dict(
+            ops=len(futs), kinds=kinds, retried=kvs.retried_ops,
+            stuck=len(kvs.stuck_ops), committed=len(committed),
+            membership=[f"{k}:{r}" for k, r in mem],
+            membership_fetches=0, checked_ok=True)
+
+
+def main() -> int:
+    report: dict = {"gate": "netchaos"}
+    try:
+        check_wire_matrix(report)
+        check_determinism(report)
+        check_crc_red(report)
+        check_partition_fast(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report))
+        return 1
+    report["ok"] = True
+    out = os.path.join(os.path.dirname(__file__), "..", "NETCHAOS_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

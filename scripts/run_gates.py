@@ -1,0 +1,150 @@
+"""One serial runner for every CI gate (round-11 satellite).
+
+The seven gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
+netchaos — MUST run serially and never beside a pytest run: the
+obs-overhead gate measures per-round wall time against an ablation
+baseline and is contention-sensitive (a parallel pytest's CPU load turns a
+behavior-identical change into a spurious overhead failure).  That rule
+used to live in docs; this runner enforces it in tooling:
+
+  * gates run one at a time, in canonical order, each in its own process
+    with the canonical CPU env;
+  * a live pytest on the machine aborts the run up front (override with
+    --force if you know the contention is harmless, e.g. a collect-only);
+  * per-gate wall time and the gate's own JSON report land in ONE summary
+    (GATES_SUMMARY.json + one printed JSON line), exit non-zero if any
+    gate failed.
+
+    python scripts/run_gates.py [--only chaos,netchaos] [--force]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# canonical order: cheap structural gates first, soaks last
+GATES = (
+    ("census", "check_op_census.py"),
+    ("obs-overhead", "check_obs_overhead.py"),
+    ("analysis", "check_analysis.py"),
+    ("pipeline", "check_pipeline.py"),
+    ("chaos", "check_chaos.py"),
+    ("elastic", "check_elastic.py"),
+    ("netchaos", "check_netchaos.py"),
+)
+
+
+def pytest_running() -> list:
+    """Best-effort scan for live pytest processes (Linux /proc)."""
+    hits = []
+    for cmdline in glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            with open(cmdline, "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if any(b"pytest" in a for a in argv):
+            hits.append(cmdline.split("/")[2])
+    return hits
+
+
+def gate_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return env
+
+
+def run_gate(name: str, script: str, timeout: int) -> dict:
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", script)],
+            cwd=REPO, env=gate_env(), timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        rc = proc.returncode
+        out = proc.stdout.decode(errors="replace")
+        err = proc.stderr.decode(errors="replace")
+    except subprocess.TimeoutExpired:
+        return dict(gate=name, ok=False, rc=-1, seconds=timeout,
+                    error=f"timed out after {timeout}s")
+    secs = round(time.perf_counter() - t0, 2)
+    report = None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            report = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    return dict(gate=name, ok=(rc == 0), rc=rc, seconds=secs,
+                report=report,
+                **({} if rc == 0 else {"stderr_tail": err[-1500:]}))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", type=str, default=None,
+                    help="comma-separated subset of gate names to run")
+    ap.add_argument("--timeout", type=int, default=1200,
+                    help="per-gate timeout in seconds")
+    ap.add_argument("--force", action="store_true",
+                    help="run even while a pytest is live (contention risk:"
+                         " the obs-overhead gate may fail spuriously)")
+    args = ap.parse_args()
+
+    names = [g[0] for g in GATES]
+    only = None
+    if args.only:
+        only = [s.strip() for s in args.only.split(",") if s.strip()]
+        unknown = [s for s in only if s not in names]
+        if unknown:
+            ap.error(f"unknown gate(s) {unknown}; want a subset of {names}")
+
+    pids = pytest_running()
+    if pids and not args.force:
+        print(json.dumps(dict(
+            ok=False,
+            error=f"pytest is running (pid {', '.join(pids)}): the gates "
+                  "are contention-sensitive (obs-overhead measures wall "
+                  "time) and must never run beside a test suite — wait for "
+                  "it or pass --force")))
+        return 2
+
+    results = []
+    for name, script in GATES:
+        if only is not None and name not in only:
+            continue
+        print(f"[run_gates] {name} ...", file=sys.stderr, flush=True)
+        r = run_gate(name, script, args.timeout)
+        print(f"[run_gates] {name}: "
+              f"{'ok' if r['ok'] else 'FAIL'} in {r['seconds']}s",
+              file=sys.stderr, flush=True)
+        results.append(r)
+
+    summary = dict(
+        ok=all(r["ok"] for r in results),
+        gates={r["gate"]: dict(ok=r["ok"], seconds=r["seconds"])
+               for r in results},
+        total_seconds=round(sum(r["seconds"] for r in results), 2),
+        results=results,
+    )
+    out = os.path.join(REPO, "GATES_SUMMARY.json")
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(dict(ok=summary["ok"], gates=summary["gates"],
+                          total_seconds=summary["total_seconds"])))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

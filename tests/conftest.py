@@ -44,6 +44,9 @@ import pytest  # noqa: E402
 SLOW_TESTS = {
     "test_two_process_dcn_launch",          # multi-process jax.distributed
     "test_three_process_tcp_run",           # multi-process C++ tcp
+    "test_tcp_wire_corruption_end_to_end",  # multi-process C++ tcp + wire chaos
+    "test_tcp_staggered_start_retries_dial",  # multi-process, sleeps in dial
+    "test_tcp_peer_death_fails_loudly_not_hang",  # multi-process death drill
     "test_chaos_drop_dup_delay",            # 12-seed adversarial soak
     "test_main_records_dryrun_before_entry_outage",  # subprocess re-exec
     "test_parity_on_clean_runs",

@@ -1,0 +1,463 @@
+"""Round-11 adversarial wire chaos: the transport-generic fault interposer
+(chaos/net.py), CRC-checksummed frames (transport/codec.py), partition
+tolerance through the detector, and the KVS's bounded-retry / degraded-mode
+client answers — each contract unit-tested here, soak-gated by
+scripts/check_netchaos.py."""
+
+import numpy as np
+import pytest
+
+from hermes_tpu import chaos
+from hermes_tpu.chaos.net import FaultingTransport
+from hermes_tpu.checker import linearizability as lin
+from hermes_tpu.checker.history import Op
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.core import state as st
+from hermes_tpu.kvs import KVS, StuckOpError
+from hermes_tpu.membership import MembershipService
+from hermes_tpu.runtime import FastRuntime, Runtime
+from hermes_tpu.transport import codec
+from hermes_tpu.transport.base import LockstepHostTransport
+from hermes_tpu.transport.sim import SimTransport
+
+
+# -- frame codec (CRC layer) -------------------------------------------------
+
+
+def test_frame_roundtrip_and_red():
+    payload = np.arange(300, dtype=np.uint8)
+    frame = codec.frame_pack(payload)
+    assert frame.nbytes == payload.nbytes + codec.FRAME_OVERHEAD
+    np.testing.assert_array_equal(codec.frame_unpack(frame), payload)
+    # single flipped payload bit -> rejected
+    torn = frame.copy()
+    torn[codec.FRAME_OVERHEAD + 123] ^= 0x01
+    with pytest.raises(codec.FrameCorrupt, match="checksum"):
+        codec.frame_unpack(torn)
+    # header damage -> rejected
+    bad_magic = frame.copy()
+    bad_magic[0] ^= 0xFF
+    with pytest.raises(codec.FrameCorrupt, match="magic"):
+        codec.frame_unpack(bad_magic)
+    # truncation -> rejected (both below-header and mid-payload)
+    with pytest.raises(codec.FrameCorrupt, match="truncated"):
+        codec.frame_unpack(frame[:4])
+    with pytest.raises(codec.FrameCorrupt, match="length"):
+        codec.frame_unpack(frame[:-10])
+
+
+# -- the interposer, pair by pair -------------------------------------------
+
+
+def _cfg_sim(**kw):
+    base = dict(
+        n_replicas=3, n_keys=64, n_sessions=4, replay_slots=8,
+        ops_per_session=10, replay_age=5,
+        workload=WorkloadConfig(read_frac=0.5, rmw_frac=0.3, seed=5),
+    )
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def _inv_block(cfg, key=7):
+    out = st.empty_invs(cfg, lead=(cfg.n_replicas,))
+    return out._replace(
+        valid=np.ones_like(np.asarray(out.valid)),
+        key=np.full_like(np.asarray(out.key), key),
+        alive=np.ones_like(np.asarray(out.alive)))
+
+
+def test_wire_drop_and_partition_are_directed():
+    cfg = _cfg_sim()
+    wire = FaultingTransport(LockstepHostTransport(), 3, seed=1)
+    wire.add("drop", 0, 2, 0, 10)          # 0 -> 2 dark
+    wire.add("partition", 1, -1, 0, 10)    # 1's whole outbound dark
+    inb = wire.exchange_inv(_inv_block(cfg), step=0)
+    valid = np.asarray(inb.valid)
+    alive = np.asarray(inb.alive)
+    assert not valid[2, 0].any() and not alive[2, 0]      # dropped edge
+    assert valid[1, 0].any()                              # 0 -> 1 fine
+    for dst in (0, 2):
+        assert not valid[dst, 1].any(), "partitioned src leaked outbound"
+    assert valid[0, 1].sum() == 0 and valid[1, 2].any()   # asymmetric: 1
+    assert alive[1, 2] and alive[0, 2]                    # still HEARS peers
+
+
+def test_wire_delay_holds_and_redelivers():
+    cfg = _cfg_sim()
+    wire = FaultingTransport(LockstepHostTransport(), 3, seed=1)
+    wire.add("delay", 0, 1, 0, 1, param=3)  # only step 0's frame delayed
+    blk = _inv_block(cfg, key=9)
+    inb0 = wire.exchange_inv(blk, step=0)
+    assert not np.asarray(inb0.valid)[1, 0].any(), "delayed frame arrived"
+    assert wire.pending() == 1
+    # nothing new sent on the edge: deliver an EMPTY outbound at step 3
+    empty = st.empty_invs(cfg, lead=(3,))
+    inb3 = wire.exchange_val(empty, step=3)  # different kind: still held
+    assert not np.asarray(inb3.valid)[1, 0].any()
+    inb_due = wire.exchange_inv(empty, step=3)
+    assert np.asarray(inb_due.valid)[1, 0].any(), "held frame not delivered"
+    assert (np.asarray(inb_due.key)[1, 0][np.asarray(inb_due.valid)[1, 0]]
+            == 9).all()
+    assert wire.pending() == 0
+
+
+def test_wire_dup_composes_and_corrupt_crc_modes():
+    cfg = _cfg_sim()
+    wire = FaultingTransport(LockstepHostTransport(), 3, seed=2)
+    wire.add("dup", 0, 1, 0, 4)
+    wire.exchange_inv(_inv_block(cfg), step=0)
+    assert wire.counters["wire_dup"] == 1 and wire.pending() >= 1
+    # crc=True: corrupt detected -> drop; the pair block arrives ZEROED
+    for crc, applied in ((True, 0), (False, 1)):
+        w = FaultingTransport(LockstepHostTransport(), 3, seed=3, crc=crc)
+        w.add("corrupt", 0, 1, 0, 4)
+        inb = w.exchange_inv(_inv_block(cfg), step=0)
+        if crc:
+            assert not np.asarray(inb.valid)[1, 0].any()
+        assert w.counters.get("wire_corrupt_applied", 0) == (applied and 1)
+        assert w.counters.get("wire_corrupt_dropped", 0) == (not applied and 1)
+
+
+def test_sim_engine_wire_matrix_checked():
+    """Composed drop/delay/dup/reorder/corrupt on the sim engine: the run
+    drains and the history linearizes — corruption is detected (CRC) and
+    downgraded to drops the protocol already tolerates."""
+    cfg = _cfg_sim()
+    wire = FaultingTransport(SimTransport(3), 3, seed=7)
+    wire.add("drop", 0, 2, 2, 12)
+    wire.add("delay", 1, -1, 4, 16, param=2)
+    wire.add("dup", 2, -1, 6, 14)
+    wire.add("reorder", 0, 1, 3, 18, param=3)
+    wire.add("corrupt", 2, 0, 5, 15)
+    rt = Runtime(cfg, backend="sim", record=True, transport=wire)
+    assert rt.drain(400), "did not drain"
+    v = rt.check()
+    assert v.ok, (v.failures[:2], v.undecided[:2])
+    c = wire.counters
+    for op in ("drop", "delay", "dup", "reorder", "corrupt"):
+        assert c.get(f"wire_{op}", 0) > 0, dict(c)
+    assert c["wire_corrupt_dropped"] == c["wire_corrupt"]
+    assert c.get("wire_corrupt_applied", 0) == 0
+
+
+def test_partition_heal_cycle_sim_engine():
+    """A partitioned-but-alive replica is ejected by the detector (epoch
+    bump, fenced), keeps its state, and rejoins on heal through the
+    epoch-fenced state-transfer join — no committed write is lost."""
+    cfg = _cfg_sim(n_replicas=4, n_sessions=4, ops_per_session=12,
+                   lease_steps=5)
+    wire = FaultingTransport(SimTransport(4), 4, seed=5)
+    rt = Runtime(cfg, backend="sim", record=True, transport=wire)
+    rt.attach_membership(MembershipService(cfg, confirm_steps=2))
+    sched = chaos.Schedule.parse("@5 partition 2 until=30\n@34 heal\n")
+    runner = chaos.ChaosRunner(rt, sched, wire=wire)
+    res = runner.run(60, check=True)
+    assert res["drained"] and res["checked_ok"], res
+    kinds = [(e.kind, e.replica) for e in rt.membership.events]
+    assert ("remove", 2) in kinds and ("join", 2) in kinds, kinds
+
+
+# -- schedule verbs + runner refusal ----------------------------------------
+
+
+def test_schedule_new_verbs_roundtrip():
+    text = ("@4 netdrop 0 dst=2 until=24\n"
+            "@6 netreorder 1 skew=3 until=30\n"
+            "@8 netcorrupt 1 dst=3 until=28\n"
+            "@10 partition 2 until=40\n"
+            "@44 heal\n")
+    sched = chaos.Schedule.parse(text)
+    assert len(sched) == 5
+    again = chaos.Schedule.parse(sched.format())
+    assert again.events == sched.events
+
+
+def test_random_schedule_draws_wire_and_partition_verbs():
+    cfg = _cfg_sim()
+    spec = chaos.ChaosSpec(p_freeze=0, p_thaw=0, p_join=0, p_crash=0,
+                           p_skew=0, p_wire=0.5, p_partition=0.2)
+    sched = chaos.Schedule.random(cfg, seed=3, steps=200, spec=spec)
+    kinds = {e.kind for e in sched}
+    assert "partition" in kinds
+    assert kinds & set(chaos.schedule.WIRE_EVENTS), kinds
+    # deterministic draw
+    again = chaos.Schedule.random(cfg, seed=3, steps=200, spec=spec)
+    assert again.events == sched.events
+
+
+def test_runner_refuses_net_faults_without_interposer():
+    """Satellite red test: net-fault schedule lines on a transport with no
+    interposer attached fail AT CONSTRUCTION with an error naming the
+    transport class (previously: silently skipped, or failed late)."""
+    cfg = _cfg_sim()
+    sched = chaos.Schedule.parse("@4 netdrop 0 dst=2 until=24\n")
+    rt = Runtime(cfg, backend="sim", transport=SimTransport(3))
+    with pytest.raises(ValueError, match="SimTransport.*FaultingTransport"):
+        chaos.ChaosRunner(rt, sched)
+    # legacy net_* verbs: same early refusal when neither carrier exists
+    legacy = chaos.Schedule.parse("@4 net_drop 0 dst=2 until=24\n")
+    with pytest.raises(ValueError, match="SimTransport"):
+        chaos.ChaosRunner(Runtime(cfg, backend="sim",
+                                  transport=SimTransport(3)), legacy)
+    # fast engine: no host transport at all — the error still names it
+    fcfg = _cfg_sim(n_replicas=3)
+    frt = FastRuntime(fcfg)
+    with pytest.raises(ValueError, match="FastRuntime.*FaultingTransport"):
+        chaos.ChaosRunner(frt, sched)
+    # partition on a fast engine needs the detector oracle
+    psched = chaos.Schedule.parse("@4 partition 1 until=20\n")
+    with pytest.raises(ValueError, match="MembershipService"):
+        chaos.ChaosRunner(frt, psched)
+    # ... and is accepted once one is attached
+    frt.attach_membership(MembershipService(fcfg))
+    chaos.ChaosRunner(frt, psched)
+
+
+def test_legacy_net_verbs_route_to_interposer():
+    """net_drop/net_delay/net_dup fall back to the FaultingTransport when
+    only it is attached — the same fault, one layer up."""
+    cfg = _cfg_sim()
+    wire = FaultingTransport(SimTransport(3), 3, seed=4)
+    rt = Runtime(cfg, backend="sim", record=True, transport=wire)
+    sched = chaos.Schedule.parse("@2 net_drop 0 dst=2 until=12\n")
+    runner = chaos.ChaosRunner(rt, sched, wire=wire)
+    res = runner.run(30, check=True)
+    assert res["drained"] and res["checked_ok"]
+    assert wire.counters["wire_drop"] > 0
+
+
+# -- membership partition oracle (fast engines) ------------------------------
+
+
+def test_sever_min_over_observers_protects_healthy_replica():
+    """One severed observer edge must NOT eject a replica the rest of the
+    cluster hears fine (the min-over-observers rule) — only severing the
+    replica's whole outbound side starves every observer."""
+    cfg = _cfg_sim(n_replicas=4, lease_steps=4)
+    rt = FastRuntime(cfg, record=True)
+    svc = MembershipService(cfg, confirm_steps=1)
+    rt.attach_membership(svc)
+    svc.sever(2, 0, at_step=0)  # only observer 0 stops hearing replica 2
+    rt.run(20)
+    assert not any(e.kind == "remove" for e in svc.events), svc.events
+    svc.sever(2, -1, at_step=rt.step_idx)  # now EVERY observer starves
+    rt.run(20)
+    removed = [e.replica for e in svc.events if e.kind == "remove"]
+    assert removed == [2], svc.events
+    # heal + rejoin: partitioned replica kept its state, joins epoch-fenced
+    svc.heal_partitions()
+    rt.join(2, from_replica=0)
+    rt.run(4)
+    assert rt.drain(400)
+    assert rt.check().ok
+
+
+# -- KVS: bounded retry, degraded mode, diagnostics --------------------------
+
+
+def _kvs_cfg(**kw):
+    base = dict(
+        n_replicas=5, n_keys=64, n_sessions=4, replay_slots=6,
+        value_words=4, ops_per_session=1, lease_steps=5,
+        pipeline_depth=2, op_timeout_rounds=6, op_retry_limit=2,
+        rebroadcast_every=2, replay_scan_every=4,
+        workload=WorkloadConfig(seed=9))
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def test_kvs_retry_reroutes_ops_wedged_by_partition():
+    """An op wedged on a partition-ejected (fenced) coordinator is salvaged
+    (maybe_w fold + volatile wipe — the crash model, per slot) and
+    transparently re-submitted on a healthy replica: the ORIGINAL future
+    resolves, the history still linearizes, and no committed write is
+    reported lost."""
+    cfg = _kvs_cfg()
+    kvs = KVS(cfg, record=True)
+    svc = MembershipService(cfg, confirm_steps=2)
+    kvs.rt.attach_membership(svc)
+    sched = chaos.Schedule.parse("@4 partition 1 until=60\n@62 heal\n")
+    runner = chaos.ChaosRunner(kvs, sched)
+    futs = []
+
+    def on_step(step):
+        if step % 3 == 0 and step < 55:
+            futs.append(kvs.put((step // 3) % 5, (step // 15) % 4,
+                                (7 * step) % 64, [step + 1]))
+
+    runner.on_step = on_step
+    res = runner.run(110, check=True)
+    assert res["drained"] and res["checked_ok"], res
+    assert all(f.done() for f in futs), "futures stranded by the adversary"
+    assert kvs.retried_ops > 0
+    assert ("remove", 1) in [(e.kind, e.replica) for e in svc.events]
+    committed = [f.result().uid for f in futs if f.result().kind == "put"]
+    assert committed, "no writes committed under the adversary"
+    lost = lin.committed_write_lost(committed, kvs.rt.history_ops(),
+                                    kvs.rt.recorder.aborted_uids)
+    assert not lost, lost
+    # the stuck-op diagnostics carried the adversary window (satellite 3)
+    assert kvs.stuck_ops and "net" in kvs.stuck_ops[0], kvs.stuck_ops[:1]
+    assert "partition:1->-1" in kvs.stuck_ops[0]["net"]["windows"][0]
+
+
+def test_kvs_retry_exhaustion_resolves_lost():
+    """With no healthy replica to re-route to, retries exhaust and the
+    future resolves loudly as kind='lost' — never a silent hang."""
+    cfg = _kvs_cfg(n_replicas=3, op_retry_limit=1, op_timeout_rounds=4)
+    kvs = KVS(cfg, record=True)
+    # fence the WHOLE cluster first: remove the coordinator, freeze the
+    # rest — the op wedges at injection and has nowhere to be re-routed
+    kvs.rt.remove(2)
+    kvs.rt.freeze(0)
+    kvs.rt.freeze(1)
+    fut = kvs.put(2, 0, 5, [1])
+    for _ in range(30):
+        if fut.done():
+            break
+        kvs.step()
+    assert fut.done(), "wedged future never resolved"
+    assert fut.result().kind == "lost"
+
+
+def test_kvs_backoff_never_retries_healthy_coordinator():
+    """A stuck op whose coordinator is HEALTHY (its quorum is what's
+    frozen) is re-examined with backoff but never salvaged — blind retry
+    would double-write; once the quorum thaws the op completes normally."""
+    cfg = _kvs_cfg(n_replicas=3, op_timeout_rounds=4, op_retry_limit=3,
+                   lease_steps=100)  # detector-less: freezes stay
+    kvs = KVS(cfg, record=True)
+    kvs.rt.freeze(1)
+    kvs.rt.freeze(2)
+    fut = kvs.put(0, 0, 9, [3])
+    for _ in range(20):
+        kvs.step()
+    assert not fut.done() and kvs.retried_ops == 0
+    assert kvs.stuck_ops, "watchdog silent on a wedged op"
+    kvs.rt.thaw(1)
+    kvs.rt.thaw(2)
+    assert kvs.run_until([fut], 200)
+    assert fut.result().kind == "put"
+    assert kvs.retried_ops == 0
+    v = kvs.rt.check()
+    assert v.ok
+
+
+def test_kvs_degraded_mode_sheds_writes_loudly():
+    cfg = _kvs_cfg(n_replicas=3, pipeline_depth=1, op_timeout_rounds=0,
+                   op_retry_limit=0, min_healthy_for_writes=2)
+    kvs = KVS(cfg)
+    kvs.rt.freeze(1)
+    kvs.rt.freeze(2)
+    f_put = kvs.put(0, 0, 1, [5])
+    f_get = kvs.get(0, 0, 1)
+    assert f_put.done() and f_put.result().kind == "rejected"
+    assert kvs.shed_writes == 1
+    assert not f_get.done()  # reads are not shed
+    # batch path sheds too
+    bf = kvs.submit_batch(np.array([KVS.PUT, KVS.GET]), np.array([2, 2]),
+                          np.array([[7, 7]]).repeat(2, axis=0))
+    assert bf.code[0] == -3 and bf.code[1] == 0  # C_REJECTED / pending get
+    # healing clears degraded mode; writes flow again
+    kvs.rt.thaw(1)
+    kvs.rt.thaw(2)
+    f2 = kvs.put(0, 1, 1, [6])
+    assert kvs.run_until([f_get, f2], 300)
+    assert f2.result().kind == "put"
+
+
+def test_stuck_op_error_carries_net_window():
+    cfg = _kvs_cfg(n_replicas=3, pipeline_depth=1, op_timeout_rounds=3,
+                   op_retry_limit=0, lease_steps=100)
+    kvs = KVS(cfg, strict_timeouts=True)
+    kvs.net_phase = dict(windows=["partition:1->-1@40"])
+    kvs.rt.freeze(1)
+    kvs.rt.freeze(2)
+    kvs.put(0, 0, 2, [1])
+    with pytest.raises(StuckOpError, match="partition:1->-1"):
+        for _ in range(10):
+            kvs.step()
+
+
+def test_frame_unsupported_algo_fails_loudly():
+    """A receiver must never verify with the WRONG polynomial: an algo
+    this end cannot compute is a named FrameCorrupt, not a silent zlib
+    fallback that drops 100% of a better-equipped sender's frames."""
+    if codec._crc32c is None:
+        with pytest.raises(codec.FrameCorrupt, match="crc32c"):
+            codec.wire_crc(b"abc", algo=1)
+    with pytest.raises(codec.FrameCorrupt, match="unknown"):
+        codec.wire_crc(b"abc", algo=9)
+
+
+def test_degraded_shed_does_not_burn_sparse_slots():
+    """A shed write never enters the store — including the sparse-key
+    index: an outage of novel-key puts must not consume dense slots
+    (KeyIndex never deletes)."""
+    cfg = _kvs_cfg(n_replicas=3, pipeline_depth=1, op_timeout_rounds=0,
+                   op_retry_limit=0, min_healthy_for_writes=2)
+    kvs = KVS(cfg, sparse_keys=True)
+    kvs.rt.freeze(1)
+    kvs.rt.freeze(2)
+    f = kvs.put(0, 0, 0xDEAD_BEEF_0001, [1])
+    bf = kvs.submit_batch(np.array([KVS.PUT, KVS.GET]),
+                          np.array([0xDEAD_BEEF_0002, 0xDEAD_BEEF_0002],
+                                   dtype=np.uint64),
+                          np.array([[2, 2], [0, 0]]))
+    assert f.result().kind == "rejected"
+    assert bf.code[0] == -3  # C_REJECTED
+    # the shed write of a novel key must not have inserted it: the batch
+    # get of the same key reads not-found WITHOUT claiming a slot either
+    from hermes_tpu.core import types as t
+
+    assert bf.code[1] == t.C_READ and not bf.found[1]  # absent-key read
+    assert kvs.index.n_used == 0, "degraded shed consumed dense slots"
+    assert kvs.shed_writes == 2 and kvs.rejected_ops == 0
+
+
+def test_held_frames_die_in_partition_blackout():
+    """A partition is a SUSTAINED blackout: a frame delayed into the
+    window does not tunnel through it (a held heartbeat released
+    mid-blackout would refresh the observer and stall detector ejection)."""
+    cfg = _cfg_sim()
+    wire = FaultingTransport(LockstepHostTransport(), 3, seed=1)
+    wire.add("delay", 0, 1, 0, 1, param=3)      # step-0 frame due at 3
+    wire.add("partition", 0, 1, 2, 10)          # blackout opens at 2
+    empty = st.empty_invs(cfg, lead=(3,))
+    wire.exchange_inv(_inv_block(cfg), step=0)  # held
+    inb = wire.exchange_inv(empty, step=3)      # due mid-blackout: dies
+    assert not np.asarray(inb.valid)[1, 0].any()
+    assert wire.pending() == 0, "held frame survived the blackout"
+    assert any(f.get("held") == "dropped_in_blackout"
+               for f in wire.fault_log)
+
+
+def test_overlapping_partitions_do_not_heal_early():
+    """Two overlapping partition windows on the same src: expiring the
+    SHORT one must not restore edges the LONG one still claims (the
+    expiry path re-derives the severed set from live windows)."""
+    cfg = _cfg_sim(n_replicas=4, lease_steps=4)
+    rt = FastRuntime(cfg, record=True)
+    svc = MembershipService(cfg, confirm_steps=1)
+    rt.attach_membership(svc)
+    sched = chaos.Schedule.parse(
+        "@2 partition 2 until=8\n@4 partition 2 until=60\n")
+    runner = chaos.ChaosRunner(rt, sched)
+    runner.run(20, heal=False)
+    # the short window lapsed at 8; the long one still holds the edges
+    assert svc.severed_edges(), "long partition window ended early"
+    # and the oracle age still grounds the suspicion (replica removed)
+    assert any(e.kind == "remove" and e.replica == 2 for e in svc.events)
+
+
+def test_committed_write_lost_helper():
+    ops = [Op("w", 1, 0.0, 1.0, wuid=(1, 1)),
+           Op("maybe_w", 1, 0.0, 2.0, wuid=(2, 2)),
+           Op("rmw", 1, 2.0, 3.0, wuid=(3, 3))]
+    aborted = {(4, 4)}
+    assert lin.committed_write_lost([(1, 1), (3, 3)], ops, aborted) == []
+    # a client-visible commit recorded only as maybe_w counts as lost
+    assert lin.committed_write_lost([(2, 2)], ops, aborted) == [(2, 2)]
+    # ... as does one the recorder reported aborted
+    assert lin.committed_write_lost([(4, 4)], ops, aborted) == [(4, 4)]
