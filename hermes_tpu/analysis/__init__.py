@@ -7,18 +7,30 @@ runs, and long before the runtime linearizability checker could notice a
 corrupted history.  It walks the closed jaxpr of a protocol round with an
 abstract interval/bitwidth interpreter (interp.py, domain.py) seeded from
 ``HermesConfig`` + the declared field layouts (core/layouts.py), and runs
-four passes (passes.py):
+five passes (passes.py):
 
   bitpack   every shift/or pack overlap-free and int32-sign-safe
   dtype     no silent 64-bit/float upcasts; converts value-preserving
   scatter   set-scatters carry injectivity evidence; donation aliasable
+  refhazard kernel Refs inside pallas_call bodies: stores in-bounds
+            against the block shape, no read-before-init, BlockSpec
+            index maps inside the operand, grid-revisit accumulators
+            declared (audited); unmodeled kernels surface as
+            pallas-skipped info findings, never a silent TOP
   sharding  collectives name real mesh axes with agreeing sizes
+
+Since ISSUE 8 the interpreter descends INTO ``pallas_call`` bodies
+(analysis/pallas.py) and a differential sanitizer (analysis/diffcheck.py)
+cross-checks the abstract cells against seeded concrete interpret-mode
+runs of every in-tree kernel — the self-test that keeps the new kernel
+rules sound before the Pallas mega-round leans on them.
 
 Findings export in the obs run-log JSONL schema (kind="analysis") and are
 CI-gated by scripts/check_analysis.py against ANALYSIS_BASELINE.json —
 the same measure-then-gate pattern as the op census.  CLI:
 
     python -m hermes_tpu.analysis [--engine both] [--split-sort] ...
+    python -m hermes_tpu.analysis --kernels   # standalone kernel matrix
 """
 
 from __future__ import annotations
@@ -30,7 +42,10 @@ from hermes_tpu.analysis.domain import AbsVal, iv  # noqa: F401
 from hermes_tpu.analysis.engines import (  # noqa: F401
     Program, analyze_config, analyze_program, trace_program)
 from hermes_tpu.analysis.passes import (  # noqa: F401
-    ERROR, INFO, WARN, Finding, default_passes)
+    ERROR, INFO, WARN, Finding, RefHazardPass, default_passes)
+from hermes_tpu.analysis.diffcheck import (  # noqa: F401
+    KernelCell, analyze_kernel, diff_check, kernel_cells,
+    run_kernel_matrix)
 
 GATING = (ERROR, WARN)  # severities that fail the CI gate
 

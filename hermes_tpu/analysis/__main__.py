@@ -46,6 +46,53 @@ def _named_cfg(name: str, args):
     raise KeyError(name)
 
 
+def _kernels_main(args) -> int:
+    """``--kernels``: the standalone kernel matrix (analysis + the
+    differential sanitizer), one JSON summary line, exit 1 on any
+    gating finding or sanitizer violation."""
+    from hermes_tpu import analysis as ana
+
+    reports = ana.run_kernel_matrix(n_draws=args.draws)
+    n_err = n_warn = n_info = 0
+    ok = True
+    cells = {}
+    for r in reports:
+        errs = [f for f in r["findings"] if f.severity == ana.ERROR]
+        warns = [f for f in r["findings"] if f.severity == ana.WARN]
+        infos = [f for f in r["findings"] if f.severity == ana.INFO]
+        n_err += len(errs)
+        n_warn += len(warns)
+        n_info += len(infos)
+        san = r["sanitizer"]
+        ok = ok and san["ok"] and not errs and not warns
+        cells[r["engine"]] = dict(
+            seconds=r["seconds"], n_eqns=r["n_eqns"],
+            errors=len(errs), warnings=len(warns), infos=len(infos),
+            sanitizer_ok=san["ok"], draws=san["n_draws"])
+        if not args.json:
+            proved = " ".join(f"{k}={v}" for k, v in r["proved"].items())
+            print(f"== {r['engine']}: {r['n_eqns']} eqns, proved "
+                  f"[{proved}], {len(errs)} error / {len(warns)} warn / "
+                  f"{len(infos)} info, sanitizer "
+                  f"{'ok' if san['ok'] else 'VIOLATED'} "
+                  f"({san['n_draws']} draws) in {r['seconds']}s",
+                  file=sys.stderr)
+            for f in r["findings"]:
+                tag = f" (audit: {f.audit})" if f.audit else ""
+                print(f"  [{f.severity:<5}] {f.pass_name}/{f.code} "
+                      f"{f.site} in {f.fn} x{f.count}{tag}\n"
+                      f"          {f.message}", file=sys.stderr)
+            for v in san["violations"]:
+                print(f"  [UNSOUND] out{v['out']} draw{v['draw']} "
+                      f"{v['kind']}: concrete {v['concrete']} escapes "
+                      f"abstract {v['abstract']}", file=sys.stderr)
+    if args.out:
+        ana.export_findings(args.out, reports, extra={"config": "kernels"})
+    print(json.dumps(dict(config="kernels", ok=ok, errors=n_err,
+                          warnings=n_warn, infos=n_info, cells=cells)))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hermes_tpu.analysis",
@@ -70,9 +117,19 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="print one JSON summary line instead of the "
                     "human report")
+    ap.add_argument("--kernels", action="store_true",
+                    help="run ONLY the standalone kernel matrix: every "
+                    "in-tree Pallas kernel analyzed through the "
+                    "sub-interpreter + the differential sanitizer "
+                    "(seeded interpret-mode runs vs abstract cells)")
+    ap.add_argument("--draws", type=int, default=3,
+                    help="sanitizer draws per kernel cell (--kernels)")
     args = ap.parse_args(argv)
 
     from hermes_tpu import analysis as ana
+
+    if args.kernels:
+        return _kernels_main(args)
 
     cfg = _named_cfg(args.config, args)
     if args.split_sort:

@@ -1,0 +1,247 @@
+"""Differential sanitizer + the standalone kernel matrix (ISSUE 8).
+
+The kernel sub-interpreter (analysis/pallas.py) is new code proving
+soundness claims about other new code — a wrong transfer rule would
+silently BLESS the very kernels the mega-round is about to trust.  This
+module is the self-test that catches unsound rules before they do:
+
+  * ``kernel_cells()`` registers every in-tree Pallas kernel at several
+    shapes (single-block, multi-block, ragged padding — the grid-revisit
+    accumulation path included) with declared abstract input bounds
+    (analysis/seeds.py, fed by the same ``core.layouts`` tables the
+    kernels build their outputs from);
+  * ``analyze_kernel(cell)`` traces the kernel standalone and walks it
+    with the full pass set — the kernel analogue of
+    ``engines.analyze_program`` (the CI gate runs both, see
+    scripts/check_analysis.py);
+  * ``diff_check(cell)`` draws concrete inputs uniformly inside the
+    declared bounds, runs the kernel for real (``interpret=True`` on
+    CPU — the same path the test suite pins against pure jnp), and
+    asserts every concrete output element lies inside the abstract
+    interval (and possible-ones mask) the interpreter derived.  A rule
+    that under-approximates — the unsoundness that would turn the
+    analyzer into a rubber stamp — shows up as a concrete escape
+    (red-tested in tests/test_pallas_analysis.py with a deliberately
+    broken ``add`` rule).
+
+Everything here is CPU-safe and deterministic (seeded generator);
+``python -m hermes_tpu.analysis --kernels`` runs it standalone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from hermes_tpu.analysis import interp as I
+from hermes_tpu.analysis import seeds as seeds_lib
+from hermes_tpu.analysis.domain import AbsVal
+from hermes_tpu.analysis.passes import Finding, default_passes
+
+
+@dataclasses.dataclass
+class KernelCell:
+    """One kernel x shape: the traced fn, its arg shapes, and the
+    declared abstract input bounds (one AbsVal per positional arg)."""
+
+    name: str
+    fn: Callable
+    shapes: Tuple
+    in_avs: List[AbsVal]
+    note: str = ""
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _stats_cell(name: str, R: int, S: int, note: str = "") -> KernelCell:
+    import jax.numpy as jnp
+
+    from hermes_tpu.core import kernels
+
+    shapes = (_sds((), jnp.int32),) + tuple(
+        _sds((R, S), dt) for dt in (jnp.int32, jnp.int32, jnp.bool_,
+                                    jnp.bool_, jnp.bool_))
+    return KernelCell(name=name, fn=kernels.stats_block, shapes=shapes,
+                      in_avs=seeds_lib.seed_stats_block(), note=note)
+
+
+def _scan_acc_cell() -> KernelCell:
+    """Synthetic sentinel: a fori_loop accumulating into a ref — the
+    loop-carried cell pattern the mega-round's per-message apply will
+    use.  The sub-interpreter's scan fixpoint must widen the cell, not
+    'converge' after one body evaluation (an under-approximation the
+    sanitizer caught in review); keeping the pattern in the matrix
+    keeps that soundness property red-tested."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax.experimental import pallas as pl
+
+    M, W = 16, 8
+
+    def _kern(x_ref, o_ref):
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+        def body(i, _):
+            o_ref[:] = o_ref[:] + x_ref[pl.dslice(i, 1), :]
+            return 0
+
+        jax.lax.fori_loop(0, M, body, 0)
+
+    def fn(x):
+        return pl.pallas_call(
+            _kern,
+            in_specs=[pl.BlockSpec((M, W), lambda: (0, 0))],
+            out_specs=pl.BlockSpec((1, W), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, W), jnp.int32),
+            interpret=True)(x)
+
+    return KernelCell(name="synthetic/scan-accumulate", fn=fn,
+                      shapes=(_sds((M, W), jnp.int32),),
+                      in_avs=[seeds_lib.iv(0, 100)],
+                      note="loop-carried ref accumulation sentinel")
+
+
+def kernel_cells() -> List[KernelCell]:
+    """The gate's kernel matrix: every in-tree Pallas kernel at the
+    shapes that exercise its distinct code paths (the block-size
+    formula in kernels.stats_block makes R drive the block cap, so a
+    tall R forces the multi-block grid at small S), plus the synthetic
+    scan-accumulate sentinel."""
+    return [
+        _stats_cell("stats_block/r4s512", 4, 512,
+                    note="single block, no padding"),
+        _stats_cell("stats_block/r1024s600", 1024, 600,
+                    note="multi-block grid (revisit accumulation) + "
+                         "ragged neutral padding"),
+        _stats_cell("stats_block/r512s2000", 512, 2000,
+                    note="3-block grid, ragged"),
+        _scan_acc_cell(),
+    ]
+
+
+def cell_by_name(name: str) -> KernelCell:
+    for c in kernel_cells():
+        if c.name == name:
+            return c
+    raise KeyError(name)
+
+
+# --------------------------------------------------------------------------
+# abstract side (the kernel analogue of engines.analyze_program)
+# --------------------------------------------------------------------------
+
+
+def trace_cell(cell: KernelCell):
+    import jax
+
+    return jax.make_jaxpr(cell.fn)(*cell.shapes)
+
+
+def analyze_kernel(cell: KernelCell, passes=None) -> dict:
+    """Walk one kernel cell with the pass set; report dict shaped like
+    ``engines.analyze_program`` (findings engine-stamped
+    ``kernel/<name>`` so the baseline currency composes)."""
+    ps = passes if passes is not None else default_passes()
+    jx = trace_cell(cell)
+    ctx = I.Ctx(passes=ps, mesh_axes=None)
+    outs = I.eval_jaxpr(jx.jaxpr, list(cell.in_avs), ctx,
+                        consts=list(jx.consts))
+    findings: List[Finding] = []
+    proved = {}
+    for p in ps:
+        p.finalize(ctx)
+        for f in p.results():
+            f.engine = f"kernel/{cell.name}"
+            findings.append(f)
+        proved[p.name] = p.n_proved
+    return dict(engine=f"kernel/{cell.name}", n_eqns=ctx.n_eqns,
+                proved=proved, findings=findings, outs_abs=outs)
+
+
+# --------------------------------------------------------------------------
+# concrete side (the sanitizer)
+# --------------------------------------------------------------------------
+
+
+def _draw(rng, sds, av: AbsVal):
+    """One concrete argument uniformly inside the declared bound."""
+    dt = np.dtype(sds.dtype)
+    if dt == np.bool_:
+        lo, hi = max(0, av.lo), min(1, av.hi)
+        return rng.integers(lo, hi + 1, size=sds.shape).astype(np.bool_)
+    info = np.iinfo(dt)
+    lo = max(av.lo, int(info.min))
+    hi = min(av.hi, int(info.max))
+    return rng.integers(lo, hi + 1, size=sds.shape, dtype=np.int64).astype(dt)
+
+
+def diff_check(cell: KernelCell, n_draws: int = 3, seed: int = 0,
+               outs_abs: Optional[list] = None) -> dict:
+    """Run the kernel on ``n_draws`` seeded concrete inputs drawn from
+    the declared bounds; every concrete output element must lie inside
+    the abstract interval (and possible-ones mask) the interpreter
+    derived.  Returns ``dict(cell, ok, n_draws, violations, seconds)``
+    — a violation means an UNSOUND transfer rule, not a kernel bug."""
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    if outs_abs is None:
+        jx = trace_cell(cell)
+        ctx = I.Ctx(passes=[])
+        outs_abs = I.eval_jaxpr(jx.jaxpr, list(cell.in_avs), ctx,
+                                consts=list(jx.consts))
+    rng = np.random.default_rng(seed)
+    violations = []
+    for d in range(n_draws):
+        args = [_draw(rng, s, av) for s, av in zip(cell.shapes, cell.in_avs)]
+        outs = cell.fn(*[jnp.asarray(a) for a in args])
+        import jax
+
+        leaves = jax.tree.leaves(outs)
+        for i, (arr, av) in enumerate(zip(leaves, outs_abs)):
+            a = np.asarray(arr)
+            if a.size == 0:
+                continue
+            lo, hi = int(a.min()), int(a.max())
+            if lo < av.lo or hi > av.hi:
+                violations.append(dict(
+                    draw=d, out=i, concrete=[lo, hi],
+                    abstract=[int(av.lo), int(av.hi)],
+                    kind="interval"))
+            if (av.ones != -1 and lo >= 0
+                    and np.issubdtype(a.dtype, np.integer)):
+                bits = int(np.bitwise_or.reduce(
+                    a.ravel().astype(np.int64)))
+                if bits & ~av.ones:
+                    violations.append(dict(
+                        draw=d, out=i, kind="ones-mask",
+                        concrete=hex(bits), abstract=hex(av.ones)))
+    return dict(cell=cell.name, ok=not violations, n_draws=n_draws,
+                violations=violations,
+                seconds=round(time.perf_counter() - t0, 3))
+
+
+def run_kernel_matrix(n_draws: int = 3, seed: int = 0,
+                      passes_factory=default_passes) -> List[dict]:
+    """Analyze + sanitize every registered kernel cell (the CLI's
+    ``--kernels`` and the gate's kernel section share this driver).
+    Each entry: the analyze_kernel report plus a ``sanitizer`` dict and
+    per-cell wall time."""
+    out = []
+    for cell in kernel_cells():
+        t0 = time.perf_counter()
+        rep = analyze_kernel(cell, passes=passes_factory())
+        rep["sanitizer"] = diff_check(cell, n_draws=n_draws, seed=seed,
+                                      outs_abs=rep.pop("outs_abs"))
+        rep["seconds"] = round(time.perf_counter() - t0, 3)
+        rep["note"] = cell.note
+        out.append(rep)
+    return out

@@ -7,9 +7,12 @@ computed operand/result abstractions plus an ``wrapped`` overflow flag.
 Higher-order primitives recurse: ``pjit``/``closed_call`` bodies inline,
 ``cond`` branches join, ``scan``/``while`` carries run a small widening
 loop, ``shard_map`` pushes its mesh's axis sizes (for ``axis_index`` and
-the sharding-consistency pass).  ``pallas_call`` bodies are SKIPPED —
-kernel-internal state primitives (get/swap) are not part of the round's
-packing surface; outputs become dtype-TOP.
+the sharding-consistency pass).  ``pallas_call`` bodies are interpreted
+by the kernel sub-interpreter (analysis/pallas.py): Refs map to abstract
+cells, the state primitives (get/swap/addupdate) get transfer rules, and
+the Ref discipline is policed by ``RefHazardPass`` — a kernel the model
+cannot express falls back to dtype-TOP outputs plus a ``pallas-skipped``
+info finding (never a silent skip).
 
 Unknown primitives are sound by construction: outputs default to the
 dtype's full range.
@@ -167,9 +170,39 @@ def _bool_out(eqn, ins, ctx):
     return [D.iv(0, 1)]
 
 
-for _n in ("eq", "ne", "lt", "le", "gt", "ge", "reduce_or", "reduce_and",
-           "is_finite"):
+for _n in ("reduce_or", "reduce_and", "is_finite"):
     RULES[_n] = _bool_out
+
+
+def _cmp_rule(decide):
+    """Comparisons refine to a constant when the intervals decide them
+    — what makes ``pl.when(blk == 0)`` path-sensitive on the kernel's
+    first visit (blk pinned to 0 ⇒ pred provably 1)."""
+
+    def fn(eqn, ins, ctx):
+        if len(ins) == 2:
+            r = decide(ins[0], ins[1])
+            if r is not None:
+                return [D.iv(r)]
+        return [D.iv(0, 1)]
+
+    return fn
+
+
+RULES["eq"] = _cmp_rule(
+    lambda a, b: 1 if (a.is_const and b.is_const and a.lo == b.lo)
+    else (0 if (a.hi < b.lo or b.hi < a.lo) else None))
+RULES["ne"] = _cmp_rule(
+    lambda a, b: 0 if (a.is_const and b.is_const and a.lo == b.lo)
+    else (1 if (a.hi < b.lo or b.hi < a.lo) else None))
+RULES["lt"] = _cmp_rule(
+    lambda a, b: 1 if a.hi < b.lo else (0 if a.lo >= b.hi else None))
+RULES["le"] = _cmp_rule(
+    lambda a, b: 1 if a.hi <= b.lo else (0 if a.lo > b.hi else None))
+RULES["gt"] = _cmp_rule(
+    lambda a, b: 1 if a.lo > b.hi else (0 if a.hi <= b.lo else None))
+RULES["ge"] = _cmp_rule(
+    lambda a, b: 1 if a.lo >= b.hi else (0 if a.hi < b.lo else None))
 
 
 @rule("add")
@@ -466,9 +499,6 @@ _CALL_JAXPR_PRIMS = {
     "custom_vjp_call_jaxpr": "fun_jaxpr",
 }
 
-_SKIP_INNER = {"pallas_call"}  # kernel-internal state prims: outputs TOP
-
-
 def _as_open(j):
     Jaxpr, ClosedJaxpr = _jaxpr_types()
     if isinstance(j, ClosedJaxpr):
@@ -497,8 +527,10 @@ def eval_jaxpr(jaxpr, in_avs: List[AbsVal], ctx: Ctx,
 
 def _eval_eqn(eqn, ins, ctx):
     name = eqn.primitive.name
-    if name in _SKIP_INNER:
-        return [D.top(v.aval.dtype) for v in eqn.outvars], False
+    if name == "pallas_call":
+        from hermes_tpu.analysis import pallas as pallas_mod
+
+        return pallas_mod.eval_pallas_call(eqn, ins, ctx), False
     if name == "shard_map":
         return _eval_shard_map(eqn, ins, ctx), False
     if name == "cond":

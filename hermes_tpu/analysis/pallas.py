@@ -1,0 +1,692 @@
+"""Abstract sub-interpreter for ``pallas_call`` kernel bodies (ISSUE 8).
+
+Before this module the analyzer SKIPPED kernel bodies: every
+``pallas_call`` output was dtype-TOP and the one place the mega-round
+plan moves the per-key state machine was the one place the PR-3
+bitpack/dtype/scatter proofs could not see.  This module opens the box:
+
+  * every kernel Ref (input block, output block, scratch) maps to an
+    abstract **cell** — one ``AbsVal`` summarizing the block's content
+    plus an init state (NO/MAYBE/YES) — keyed through the interpreter's
+    alias chain so refs stay resolvable across ``cond``/``scan``/
+    ``pjit`` nesting inside the kernel;
+  * the state primitives get transfer rules: ``get`` reads the cell
+    (flagging read-before-init), ``swap`` stores (strong update for a
+    full-block store, weak join for a partial one; a ``DropVar`` result
+    is a pure store and never counts as a read), ``addupdate``
+    read-modify-writes; every dynamic index is bounds-checked against
+    the block shape (``oob-block-store`` / ``oob-block-load``);
+  * ``pl.when`` regions arrive as ``cond``: branch cell-states are
+    joined as interval unions, and a predicate the domain proves
+    constant (``blk == 0`` on the first visit) selects its branch
+    path-sensitively;
+  * ``pl.program_id``/``pl.num_programs`` are seeded from the grid, and
+    every BlockSpec index map is evaluated abstractly over the full
+    grid range and checked against the operand shape
+    (``blockspec-oob``); a grid-invariant output index map means the
+    block REVISITS across grid steps — the accumulator aliasing must be
+    declared with a ``layouts.audited`` tag on the call site
+    (``grid-revisit-accumulator``), the kernel analogue of PR-3's
+    scatter discipline;
+  * the body is evaluated in two phases: a **first visit** (program ids
+    pinned to 0, output cells uninitialized) that checks the
+    ``pl.when(blk == 0)`` init discipline exactly, then a **steady
+    state** (program ids spanning the grid, revisited cells carried)
+    run through a small widening loop.  ``fori_loop``-lowered scans get
+    an induction-variable refinement (carry ``c' = c + k`` over a known
+    length) so serial per-message kernels keep exact index bounds.
+
+Soundness stance: anything the model cannot faithfully express —
+scalar-prefetch grids, dynamic grid bounds, vmapped kernels, an
+unknown primitive touching a Ref (DMA, semaphores), an indexer tree we
+cannot parse — DEFEATS the sub-interpreter for that ``pallas_call``:
+outputs fall back to dtype-TOP (the pre-ISSUE-8 behavior) and a
+``pallas-skipped`` info finding names what defeated it, so the blind
+spot is visible in the findings stream instead of silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from hermes_tpu.analysis import domain as D
+from hermes_tpu.analysis import interp as I
+from hermes_tpu.analysis.domain import AbsVal
+
+# cell init lattice: join is min (a branch that may not store demotes YES)
+NO, MAYBE, YES = 0, 1, 2
+
+#: primitives with Ref operands the sub-interpreter models; anything
+#: else touching a Ref defeats the kernel (see module doc)
+_STATE_PRIMS = ("get", "swap", "addupdate")
+
+
+class Defeated(Exception):
+    """The kernel uses a feature outside the cell model; the caller
+    falls back to dtype-TOP outputs + a pallas-skipped finding."""
+
+    def __init__(self, what: str):
+        super().__init__(what)
+        self.what = what
+
+
+def _is_ref(aval) -> bool:
+    try:
+        from jax._src.state.types import AbstractRef
+
+        return isinstance(aval, AbstractRef)
+    except Exception:
+        return "Ref" in type(aval).__name__
+
+
+def _drop_var(v) -> bool:
+    return type(v).__name__ == "DropVar"
+
+
+@dataclasses.dataclass
+class RefCell:
+    """One kernel Ref: block shape/dtype + summarized abstract content."""
+
+    shape: Tuple[int, ...]
+    dtype: object
+    kind: str  # "in" | "out" | "scratch"
+    origin: str
+    av: Optional[AbsVal]  # None = nothing stored yet (bottom)
+    init: int  # NO / MAYBE / YES
+    revisit: bool = False  # out block grid-invariant (accumulator)
+
+    def read(self) -> AbsVal:
+        """Sound read value: the cell content, or dtype-TOP when the
+        block may hold garbage (uninitialized memory)."""
+        if self.init == YES and self.av is not None:
+            return self.av
+        top = D.top(self.dtype)
+        return top if self.av is None else D.join(self.av, top)
+
+    def out_value(self) -> AbsVal:
+        """The value this block contributes to the pallas output after a
+        visit (garbage-aware like read())."""
+        return self.read()
+
+    def snapshot(self) -> tuple:
+        return (self.av, self.init)
+
+    def restore(self, snap: tuple) -> None:
+        self.av, self.init = snap
+
+
+def _join_snaps(a: tuple, b: tuple) -> tuple:
+    av_a, in_a = a
+    av_b, in_b = b
+    if av_a is None:
+        av = av_b
+    elif av_b is None:
+        av = av_a
+    else:
+        av = D.join(av_a, av_b)
+    return (av, min(in_a, in_b))
+
+
+class KCtx:
+    """Kernel-local interpreter state riding beside the shared Ctx."""
+
+    def __init__(self, grid: Tuple[int, ...], hazard):
+        self.grid = grid
+        self.pid: List[AbsVal] = [D.iv(0) for _ in grid]
+        self.cells: Dict = {}  # canonical ref Var -> RefCell
+        self.hazard = hazard  # RefHazardPass or None
+
+    def cell_of(self, ctx: I.Ctx, atom) -> RefCell:
+        cell = self.cells.get(ctx.canon(atom))
+        if cell is None:
+            # a ref the call didn't bind (run_scoped views, transforms)
+            raise Defeated("unmapped-ref")
+        return cell
+
+    def emit(self, eqn, code, severity, message) -> None:
+        if self.hazard is not None:
+            self.hazard.emit(eqn, code, severity, message)
+
+    def proved(self) -> None:
+        if self.hazard is not None:
+            self.hazard.n_proved += 1
+
+
+def _hazard_pass(ctx: I.Ctx):
+    for p in ctx.passes:
+        if getattr(p, "name", "") == "refhazard":
+            return p
+    return None
+
+
+# --------------------------------------------------------------------------
+# entry point (called from interp._eval_eqn for every pallas_call)
+# --------------------------------------------------------------------------
+
+
+def eval_pallas_call(eqn, ins: List[AbsVal], ctx: I.Ctx) -> List[AbsVal]:
+    """Interpret one ``pallas_call`` equation.  Returns the output
+    abstractions; on defeat emits ``pallas-skipped`` (info) and returns
+    dtype-TOP for every output — the sound pre-ISSUE-8 behavior."""
+    try:
+        return _interpret_kernel(eqn, ins, ctx)
+    except Defeated as d:
+        hp = _hazard_pass(ctx)
+        if hp is not None:
+            hp.note_skipped(eqn, d.what)
+        return [D.top(v.aval.dtype) for v in eqn.outvars]
+
+
+def _interpret_kernel(eqn, ins: List[AbsVal], ctx: I.Ctx) -> List[AbsVal]:
+    gm = eqn.params["grid_mapping"]
+    jaxpr = eqn.params["jaxpr"]
+    if getattr(gm, "num_dynamic_grid_bounds", 0):
+        raise Defeated("dynamic-grid-bounds")
+    if getattr(gm, "num_index_operands", 0):
+        raise Defeated("scalar-prefetch")
+    if getattr(gm, "mapped_dims", ()) or getattr(gm, "vmapped_dims", ()):
+        raise Defeated("vmapped-pallas_call")
+    if getattr(jaxpr, "constvars", ()):
+        raise Defeated("kernel-constvars")
+    try:
+        grid = tuple(int(g) for g in gm.grid)
+    except Exception:
+        raise Defeated("symbolic-grid")
+    n_in, n_out = int(gm.num_inputs), int(gm.num_outputs)
+    bms = list(gm.block_mappings)
+    if len(bms) != n_in + n_out or len(jaxpr.invars) < n_in + n_out:
+        raise Defeated("block-mappings")
+    if len(ins) < n_in:
+        raise Defeated("operand-arity")
+
+    hp = _hazard_pass(ctx)
+    kctx = KCtx(grid, hp)
+    total = 1
+    for g in grid:
+        total *= g
+
+    # -- BlockSpec index maps: bounds vs operand shape + revisit detection
+    revisit = [_check_block_mapping(eqn, bm, grid, kctx) and total > 1
+               for bm in bms]
+
+    # -- bind cells ---------------------------------------------------------
+    io_alias = {int(o): int(i)
+                for i, o in (eqn.params.get("input_output_aliases") or ())}
+    kin = jaxpr.invars
+    for i in range(n_in):
+        aval = kin[i].aval
+        kctx.cells[kin[i]] = RefCell(
+            shape=tuple(aval.shape), dtype=np.dtype(aval.dtype), kind="in",
+            origin=getattr(bms[i], "origin", f"in{i}"),
+            av=D.clamp(ins[i], aval.dtype)[0], init=YES, revisit=revisit[i])
+    for o in range(n_out):
+        v = kin[n_in + o]
+        aval = v.aval
+        src = io_alias.get(o)
+        seeded = src is not None and src < len(ins)
+        kctx.cells[v] = RefCell(
+            shape=tuple(aval.shape), dtype=np.dtype(aval.dtype), kind="out",
+            origin=getattr(bms[n_in + o], "origin", f"out{o}"),
+            av=D.clamp(ins[src], aval.dtype)[0] if seeded else None,
+            init=YES if seeded else NO, revisit=revisit[n_in + o])
+    for s in range(n_in + n_out, len(kin)):
+        aval = kin[s].aval
+        try:
+            dt = np.dtype(aval.dtype)
+        except Exception:
+            dt = np.dtype(np.int32)  # semaphores: only DMA prims touch
+            # them, and any such primitive defeats the kernel anyway
+        kctx.cells[kin[s]] = RefCell(
+            shape=tuple(getattr(aval, "shape", ())), dtype=dt,
+            kind="scratch", origin=f"scratch{s - n_in - n_out}",
+            av=None, init=NO)
+
+    # -- grid-revisit accumulators must be declared (audited call site) ----
+    for o in range(n_out):
+        if revisit[n_in + o]:
+            kctx.emit(
+                eqn, "grid-revisit-accumulator", "warn",
+                f"output block {kctx.cells[kin[n_in + o]].origin!r} has a "
+                f"grid-invariant index map over grid {grid}: the block is "
+                f"revisited and accumulated across grid steps — declare "
+                f"the aliasing with layouts.audited(tag) on the "
+                f"pallas_call site (the kernel analogue of the scatter "
+                f"injectivity discipline)")
+
+    out_cells = [kctx.cells[kin[n_in + o]] for o in range(n_out)]
+    out_seed = [c.snapshot() for c in out_cells]
+    out_acc: List[Optional[AbsVal]] = [None] * n_out
+
+    def run_visit():
+        _eval_jaxpr_k(jaxpr, None, ctx, kctx)
+        for o, c in enumerate(out_cells):
+            v = c.out_value()
+            out_acc[o] = v if out_acc[o] is None else D.join(out_acc[o], v)
+
+    # -- phase 1: the first visit, program ids pinned to 0 ------------------
+    kctx.pid = [D.iv(0) for _ in grid]
+    run_visit()
+
+    # -- phase 2: steady state over the whole grid --------------------------
+    if total > 1:
+        kctx.pid = [D.iv(0, max(0, g - 1)) for g in grid]
+        for it in range(4):
+            pre = {v: c.snapshot() for v, c in kctx.cells.items()}
+            for o, c in enumerate(out_cells):
+                if not c.revisit:  # a fresh block every visit
+                    c.restore(out_seed[o])
+            if it == 3:  # widen: unstable revisited cells go dtype-TOP
+                for c in kctx.cells.values():
+                    if c.av is not None:
+                        c.av = D.top(c.dtype)
+            run_visit()
+            stable = True
+            for v, c in kctx.cells.items():
+                joined = _join_snaps(pre[v], c.snapshot())
+                if c.kind == "out" and not c.revisit:
+                    # fresh-block cells don't carry between visits;
+                    # out_acc already folded this visit's value
+                    continue
+                if joined != pre[v]:
+                    stable = False
+                c.restore(joined)
+            if stable:
+                break
+
+    outs = []
+    for o, v in enumerate(eqn.outvars):
+        av = out_acc[o] if out_acc[o] is not None else D.top(v.aval.dtype)
+        outs.append(D.clamp(av, v.aval.dtype)[0])
+    return outs
+
+
+# --------------------------------------------------------------------------
+# BlockSpec index maps
+# --------------------------------------------------------------------------
+
+
+def _check_block_mapping(eqn, bm, grid, kctx) -> bool:
+    """Evaluate one BlockSpec index map over the full grid range; check
+    the produced block indices against the operand shape.  Returns True
+    when the map is grid-invariant (the block revisits)."""
+    imj = bm.index_map_jaxpr
+    try:
+        block_shape = tuple(int(b) for b in bm.block_shape)
+        ashape = tuple(int(s) for s in bm.array_shape_dtype.shape)
+    except Exception:
+        raise Defeated("mapped-block-dims")
+    in_avs = [D.iv(0, max(0, g - 1)) for g in grid]
+    sub = I.Ctx()  # throwaway: index maps never carry findings
+    try:
+        outs = I.eval_jaxpr(imj.jaxpr, in_avs, sub, consts=list(imj.consts))
+    except Exception:
+        raise Defeated("index-map")
+    if len(outs) != len(block_shape) or len(block_shape) != len(ashape):
+        raise Defeated("index-map-arity")
+    ok = True
+    for d, (b_av, bs, asz) in enumerate(zip(outs, block_shape, ashape)):
+        nblk = -(-asz // max(1, bs))
+        if b_av.lo < 0 or b_av.hi > nblk - 1:
+            ok = False
+            kctx.emit(
+                eqn, "blockspec-oob", "error",
+                f"BlockSpec for {getattr(bm, 'origin', '?')!r} dim {d}: "
+                f"index map yields block index {b_av} over grid {grid} "
+                f"but the {asz}-wide operand has only {nblk} blocks of "
+                f"{bs} — out-of-bounds slab")
+    if ok:
+        kctx.proved()
+    return all(o.is_const for o in outs)
+
+
+# --------------------------------------------------------------------------
+# the kernel body walk (mirrors interp.eval_jaxpr + cell semantics)
+# --------------------------------------------------------------------------
+
+
+def _safe_aval(ctx, atom) -> AbsVal:
+    try:
+        return ctx.aval_of(atom)
+    except Exception:
+        return D.iv(0)  # Ref/semaphore placeholder, never used as a value
+
+
+def _eval_jaxpr_k(jaxpr, in_avs: Optional[List[AbsVal]], ctx: I.Ctx,
+                  kctx: KCtx, consts: Optional[list] = None) -> List[AbsVal]:
+    env = ctx.env
+    for v, c in zip(jaxpr.constvars, consts or []):
+        env[v] = D.from_concrete(c)
+    if in_avs is not None:
+        for v, av in zip(jaxpr.invars, in_avs):
+            env[v] = av
+    for eqn in jaxpr.eqns:
+        ctx.n_eqns += 1
+        ins = [_safe_aval(ctx, a) for a in eqn.invars]
+        outs, wrapped = _eval_eqn_k(eqn, ins, ctx, kctx)
+        for p in ctx.passes:
+            p.on_eqn(ctx, eqn, ins, outs, wrapped)
+        for v, av in zip(eqn.outvars, outs):
+            env[v] = av
+            ctx.defs[v] = eqn
+    return [_safe_aval(ctx, a) for a in jaxpr.outvars]
+
+
+def _eval_eqn_k(eqn, ins, ctx, kctx):
+    name = eqn.primitive.name
+    if name == "program_id":
+        return [kctx.pid[int(eqn.params.get("axis", 0))]], False
+    if name == "num_programs":
+        return [D.iv(kctx.grid[int(eqn.params.get("axis", 0))])], False
+    if name in _STATE_PRIMS:
+        return _eval_ref_op(eqn, ins, ctx, kctx), False
+    if name == "cond":
+        return _eval_cond_k(eqn, ins, ctx, kctx), False
+    if name == "scan":
+        return _eval_scan_k(eqn, ins, ctx, kctx), False
+    if name == "while":
+        return _eval_while_k(eqn, ins, ctx, kctx), False
+    if name in I._CALL_JAXPR_PRIMS:
+        inner = eqn.params.get(I._CALL_JAXPR_PRIMS[name])
+        if inner is not None:
+            j, consts = I._as_open(inner)
+            for inner_v, outer_a in zip(j.invars, eqn.invars):
+                ctx.aliases[inner_v] = outer_a
+            outs = _eval_jaxpr_k(j, list(ins), ctx, kctx, consts)
+            for outer_v, inner_a in zip(eqn.outvars, j.outvars):
+                ctx.aliases[outer_v] = inner_a
+            return I._refine_named_call(eqn, ins, outs, ctx), False
+    if any(_is_ref(getattr(v, "aval", None)) for v in eqn.invars):
+        # an effectful primitive outside the cell model (DMA, semaphore
+        # signal, ref view): the cells can no longer be trusted
+        raise Defeated(name)
+    if name == "pallas_call":
+        raise Defeated("nested-pallas_call")
+    fn = I.RULES.get(name)
+    if fn is None:
+        return [D.top(v.aval.dtype) for v in eqn.outvars], False
+    raw = fn(eqn, ins, ctx)
+    outs, wrapped = [], False
+    for v, av in zip(eqn.outvars, raw):
+        c, w = D.clamp(av, v.aval.dtype)
+        outs.append(c)
+        wrapped = wrapped or w
+    return outs, wrapped
+
+
+# -- get / swap / addupdate -------------------------------------------------
+
+
+def _parse_indexers(eqn, idx_atoms):
+    """Unflatten the NDIndexer tree riding the eqn params; returns the
+    indexer tuple or None when the tree shape is not what we model."""
+    tree = eqn.params.get("tree")
+    if tree is None:
+        return None
+    try:
+        import jax
+
+        indexers = jax.tree_util.tree_unflatten(tree, list(idx_atoms))
+    except Exception:
+        return None
+    if not isinstance(indexers, tuple):
+        return None
+    return indexers
+
+
+def _dim_bounds(ctx, idx, dim) -> Optional[Tuple[int, int, bool]]:
+    """(lo, hi, is_full) index bounds one indexer element can reach in a
+    dimension of size ``dim``; None = unparseable."""
+    from jax._src.state import indexing
+
+    if isinstance(idx, indexing.Slice):
+        if not isinstance(idx.size, int) or not isinstance(idx.stride, int):
+            return None
+        span = (idx.size - 1) * idx.stride
+        if isinstance(idx.start, int):
+            full = (idx.start == 0 and idx.stride == 1 and idx.size == dim)
+            return (idx.start, idx.start + span, full)
+        av = ctx.aval_of(idx.start)
+        return (av.lo, av.hi + span, False)
+    if isinstance(idx, int):
+        return (idx, idx, dim == 1 and idx == 0)
+    if isinstance(idx, np.ndarray):
+        return (int(idx.min()), int(idx.max()), False)
+    av = ctx.aval_of(idx)  # scalar or advanced int-array index
+    return (av.lo, av.hi, False)
+
+
+def _indexer_info(ctx, indexers, shape):
+    """(in_bounds, full_block, detail) over every indexer/dim pair."""
+    full = True
+    oob = None
+    for nd in indexers:
+        idxs = getattr(nd, "indices", None)
+        if idxs is None:
+            return None
+        if len(idxs) != len(shape):
+            return None
+        for d, (ix, dim) in enumerate(zip(idxs, shape)):
+            b = _dim_bounds(ctx, ix, dim)
+            if b is None:
+                return None
+            lo, hi, f = b
+            full = full and f
+            if (lo < 0 or hi > dim - 1) and oob is None:
+                oob = (d, lo, hi, dim)
+    return (oob is None, full, oob)
+
+
+def _eval_ref_op(eqn, ins, ctx, kctx):
+    name = eqn.primitive.name
+    cell = kctx.cell_of(ctx, eqn.invars[0])
+    n_val = 0 if name == "get" else 1
+    info = None
+    indexers = _parse_indexers(eqn, eqn.invars[1 + n_val:])
+    if indexers is not None:
+        info = _indexer_info(ctx, indexers, cell.shape)
+    if info is None:
+        raise Defeated(f"{name}:indexer")
+    in_bounds, full, oob = info
+
+    if not in_bounds:
+        d, lo, hi, dim = oob
+        code = "oob-block-load" if name == "get" else "oob-block-store"
+        kctx.emit(
+            eqn, code, "error",
+            f"{name} on {cell.origin!r} dim {d}: index range [{lo}, {hi}] "
+            f"escapes the {dim}-wide block — out-of-bounds {name} inside "
+            f"a kernel is undefined behavior on TPU; bound the index or "
+            f"widen the block")
+    else:
+        kctx.proved()
+
+    # does this op READ the block? (a swap whose old value is dropped is
+    # a pure store; addupdate always reads)
+    reads = (name == "get" or name == "addupdate"
+             or (name == "swap" and not _drop_var(eqn.outvars[0])))
+    if reads:
+        if cell.init != YES:
+            kctx.emit(
+                eqn, "ref-read-before-init", "error",
+                f"{name} on {cell.origin!r} may read uninitialized "
+                f"{cell.kind} memory (init={('no', 'maybe', 'yes')[cell.init]}"
+                f"): initialize the block first (e.g. a pl.when(pid == 0) "
+                f"zero-fill for a revisit-accumulated block)")
+        else:
+            kctx.proved()
+
+    old = cell.read()
+    if name == "get":
+        return [D.clamp(old, eqn.outvars[0].aval.dtype)[0]]
+
+    val = D.clamp(ins[1], cell.dtype)[0]
+    if name == "swap":
+        if full:
+            cell.av, cell.init = val, YES
+        else:
+            cell.av = val if cell.av is None else D.join(cell.av, val)
+            cell.init = max(cell.init, MAYBE)
+        return [D.clamp(old, eqn.outvars[0].aval.dtype)[0]]
+    # addupdate: the block gains val somewhere (full: everywhere)
+    new = D.clamp(D.add(old, val), cell.dtype)[0]
+    cell.av = new if full else D.join(old, new)
+    return []
+
+
+# -- control flow with cell-state joins -------------------------------------
+
+
+def _eval_cond_k(eqn, ins, ctx, kctx):
+    branches = eqn.params["branches"]
+    pred = ins[0]
+    if pred.is_const:  # path-sensitive: pl.when(blk == 0) on visit 0
+        sel = min(max(int(pred.lo), 0), len(branches) - 1)
+        j, consts = I._as_open(branches[sel])
+        for inner_v, outer_a in zip(j.invars, eqn.invars[1:]):
+            ctx.aliases[inner_v] = outer_a
+        return _eval_jaxpr_k(j, list(ins[1:]), ctx, kctx, consts)
+    base = {v: c.snapshot() for v, c in kctx.cells.items()}
+    outs = None
+    joined = None
+    for br in branches:
+        for v, c in kctx.cells.items():
+            c.restore(base[v])
+        j, consts = I._as_open(br)
+        for inner_v, outer_a in zip(j.invars, eqn.invars[1:]):
+            ctx.aliases[inner_v] = outer_a
+        o = _eval_jaxpr_k(j, list(ins[1:]), ctx, kctx, consts)
+        outs = o if outs is None else [D.join(a, b) for a, b in zip(outs, o)]
+        snap = {v: c.snapshot() for v, c in kctx.cells.items()}
+        joined = snap if joined is None else {
+            v: _join_snaps(joined[v], snap[v]) for v in snap}
+    for v, c in kctx.cells.items():
+        c.restore(joined[v])
+    return outs
+
+
+def _induction_bounds(j, nc, ncar, init, length):
+    """Exact bounds for syntactic induction carries: a carry whose body
+    transfer is ``c' = c + k`` (k a literal) or the identity spans
+    ``[init, init + k*(length-1)]`` — what keeps a fori_loop message
+    index provably inside its SMEM block."""
+    from jax.extend.core import Literal
+
+    if not isinstance(length, int) or length <= 0:
+        return [None] * ncar
+    defs = {}
+    for e in j.eqns:
+        for v in e.outvars:
+            defs[v] = e
+    out = []
+    for c in range(ncar):
+        carry_in, carry_out = j.invars[nc + c], j.outvars[c]
+        if isinstance(carry_out, Literal):
+            # the body returns a constant carry (fori_loop's dummy 0):
+            # after the first iteration the carry IS that constant
+            out.append(D.join(init[c], D.from_concrete(carry_out.val)))
+            continue
+        if carry_out is carry_in:
+            out.append(init[c])
+            continue
+        e = defs.get(carry_out)
+        k = None
+        if e is not None and e.primitive.name == "add":
+            a, b = e.invars
+            if a is carry_in and isinstance(b, Literal):
+                k = int(np.asarray(b.val))
+            elif b is carry_in and isinstance(a, Literal):
+                k = int(np.asarray(a.val))
+        if k is None:
+            out.append(None)
+            continue
+        span = k * (length - 1)
+        out.append(AbsVal(init[c].lo + min(0, span),
+                          init[c].hi + max(0, span)))
+    return out
+
+
+def _widen_cells(kctx) -> None:
+    """Last-iteration widening: any cell holding a value may hold ANY
+    dtype value after more iterations (init states form a finite
+    min-join lattice and converge on their own)."""
+    for c in kctx.cells.values():
+        if c.av is not None:
+            c.av = D.top(c.dtype)
+
+
+def _join_cells_pre(kctx, pre) -> bool:
+    """Kleene step for loop-carried cell state: join each cell's
+    post-body state into its pre-body state; True when stable.  Without
+    this the loop fixpoint would check only SSA carries and a
+    ``ref[...] += 1`` accumulation would 'converge' after one body
+    evaluation — an under-approximation the differential sanitizer
+    red-tests (scan-accumulate cell)."""
+    stable = True
+    for v, c in kctx.cells.items():
+        joined = _join_snaps(pre[v], c.snapshot())
+        if joined != pre[v]:
+            stable = False
+        c.restore(joined)
+    return stable
+
+
+def _eval_scan_k(eqn, ins, ctx, kctx):
+    nc = eqn.params.get("num_consts", 0)
+    ncar = eqn.params.get("num_carry", 0)
+    length = eqn.params.get("length")
+    j, jconsts = I._as_open(eqn.params["jaxpr"])
+    for inner_v, outer_a in zip(j.invars[:nc], eqn.invars[:nc]):
+        ctx.aliases[inner_v] = outer_a  # refs ride the consts
+    consts, init, xs = ins[:nc], ins[nc:nc + ncar], ins[nc + ncar:]
+    pinned = _induction_bounds(j, nc, ncar, init, length)
+
+    carry = [p if p is not None else c for p, c in zip(pinned, init)]
+    ys = [D.top(v.aval.dtype) for v in eqn.outvars[ncar:]]
+    for it in range(5):
+        if it == 4:
+            carry = [p if p is not None else
+                     (AbsVal(min(c.lo, -(1 << 63)), max(c.hi, 1 << 63))
+                      if (c.lo, c.hi) != (i.lo, i.hi) else c)
+                     for p, c, i in zip(pinned, carry, init)]
+            _widen_cells(kctx)
+        pre = {v: c.snapshot() for v, c in kctx.cells.items()}
+        o = _eval_jaxpr_k(j, consts + carry + xs, ctx, kctx, jconsts)
+        ys = o[ncar:]
+        cells_stable = _join_cells_pre(kctx, pre)
+        nxt = [p if p is not None else D.join(c, n)
+               for p, c, n in zip(pinned, carry, o[:ncar])]
+        if cells_stable and all(n.lo == c.lo and n.hi == c.hi
+                                for n, c in zip(nxt, carry)):
+            break
+        carry = nxt
+    outs = carry + list(ys)
+    return [D.clamp(a, v.aval.dtype)[0] for a, v in zip(outs, eqn.outvars)]
+
+
+def _eval_while_k(eqn, ins, ctx, kctx):
+    cn = eqn.params.get("cond_nconsts", 0)
+    bn = eqn.params.get("body_nconsts", 0)
+    bj, bconsts = I._as_open(eqn.params["body_jaxpr"])
+    for inner_v, outer_a in zip(bj.invars[:bn], eqn.invars[cn:cn + bn]):
+        ctx.aliases[inner_v] = outer_a
+    bconsts_avs = ins[cn:cn + bn]
+    init = ins[cn + bn:]
+    carry = list(init)
+    for it in range(5):
+        if it == 4:
+            carry = [AbsVal(min(c.lo, -(1 << 63)), max(c.hi, 1 << 63))
+                     if (c.lo, c.hi) != (i.lo, i.hi) else c
+                     for c, i in zip(carry, init)]
+            _widen_cells(kctx)
+        pre = {v: c.snapshot() for v, c in kctx.cells.items()}
+        o = _eval_jaxpr_k(bj, bconsts_avs + carry, ctx, kctx, bconsts)
+        cells_stable = _join_cells_pre(kctx, pre)
+        nxt = [D.join(c, n) for c, n in zip(carry, o)]
+        if cells_stable and all(n.lo == c.lo and n.hi == c.hi
+                                for n, c in zip(nxt, carry)):
+            break
+        carry = nxt
+    return [D.clamp(a, v.aval.dtype)[0] for a, v in zip(carry, eqn.outvars)]

@@ -1,6 +1,6 @@
 """The analyzer's pluggable passes and their finding records.
 
-Four passes ship (ISSUE 3):
+Five passes ship (ISSUE 3 + the ISSUE-8 kernel pass):
 
   * ``BitPackPass`` — every shift/or pack in the traced round must be
     overlap-free and sign-safe under the config-seeded bounds.  A pack
@@ -18,6 +18,13 @@ Four passes ship (ISSUE 3):
   * ``ShardingConsistencyPass`` — collectives name declared mesh axes
     with matching sizes, shard_map meshes agree with the engine's
     declaration, batched programs contain no collectives at all.
+  * ``RefHazardPass`` — kernel Ref discipline inside ``pallas_call``
+    bodies (populated by the sub-interpreter, analysis/pallas.py):
+    every load/store in-bounds against the block shape, no
+    read-before-init, BlockSpec index maps inside the operand,
+    grid-revisit accumulators declared via ``layouts.audited``; a
+    kernel the sub-interpreter cannot model emits ``pallas-skipped``
+    (info) naming what defeated it instead of a silent TOP.
 
 Severity contract (the CI gate, scripts/check_analysis.py):
 
@@ -310,7 +317,43 @@ class ScatterHazardPass(Pass):
 
 
 # --------------------------------------------------------------------------
-# 4. sharding consistency
+# 4. kernel ref hazards (pallas_call bodies)
+# --------------------------------------------------------------------------
+
+
+class RefHazardPass(Pass):
+    """Kernel Ref/block discipline.  The pass itself is the findings
+    channel: the pallas sub-interpreter (analysis/pallas.py) computes
+    the hazards while walking kernel bodies and emits through this pass
+    so the dedup/audit/severity machinery — and the baseline currency —
+    stay identical to every other pass.  Codes:
+
+      * ``oob-block-store`` / ``oob-block-load`` (error) — an index
+        range can escape the block shape;
+      * ``ref-read-before-init`` (error) — a get/swap/addupdate reads an
+        output or scratch block no store has fully initialized;
+      * ``blockspec-oob`` (error) — an index map yields a block index
+        outside the operand;
+      * ``grid-revisit-accumulator`` (warn) — an output block with a
+        grid-invariant index map (revisit-accumulated, like
+        stats_block's ctr/hist) lacks a ``layouts.audited`` declaration
+        on the call site (with one it downgrades to info, tag carried);
+      * ``pallas-skipped`` (info) — the sub-interpreter could not model
+        the kernel; names the defeating primitive/feature.
+    """
+
+    name = "refhazard"
+
+    def note_skipped(self, eqn, what: str) -> None:
+        self.emit(
+            eqn, "pallas-skipped", INFO,
+            f"pallas_call body not interpreted: {what!r} defeated the "
+            f"kernel sub-interpreter — outputs are dtype-TOP and "
+            f"kernel-internal invariants are UNCHECKED for this call")
+
+
+# --------------------------------------------------------------------------
+# 5. sharding consistency
 # --------------------------------------------------------------------------
 
 _COLLECTIVES = ("all_gather", "all_to_all", "psum", "psum2", "pmax", "pmin",
@@ -397,4 +440,5 @@ class ShardingConsistencyPass(Pass):
 
 def default_passes(allow_float: bool = False) -> list:
     return [BitPackPass(), DtypePromotionPass(allow_float=allow_float),
-            ScatterHazardPass(), ShardingConsistencyPass()]
+            ScatterHazardPass(), RefHazardPass(),
+            ShardingConsistencyPass()]

@@ -112,3 +112,20 @@ def seed_round_args(cfg: HermesConfig, has_uval: bool = False) -> tuple:
     builders' arguments."""
     return (seed_fast_state(cfg), seed_stream(cfg, has_uval),
             seed_fast_ctl(cfg))
+
+
+# --------------------------------------------------------------------------
+# kernel argument seeds (the standalone kernel matrix, ISSUE 8)
+# --------------------------------------------------------------------------
+
+
+def seed_stats_block() -> list:
+    """One AbsVal per ``core.kernels.stats_block`` argument (step,
+    sess_op, invoke_step, commit, abort, read_done) — the same bounds
+    the round analysis derives at the kernel's call site.  The step
+    bounds come from the declared SST step field and the counter/
+    histogram accumulators from ``layouts.STATS_CTR``/``state.LAT_BINS``
+    — the one declared source the kernel itself builds its packed
+    outputs from (no bare ``range(6)``)."""
+    stp = iv(0, layouts.MAX_STEPS - 1)  # == step_seed(cfg) for any cfg
+    return [stp, iv(0, 3), stp, BOOL, BOOL, BOOL]

@@ -25,11 +25,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from hermes_tpu.core import layouts
 from hermes_tpu.core import state as st
 from hermes_tpu.core import types as t
 
-# counter row layout in the packed (R, 8) counters output
-CTR_READ, CTR_WRITE, CTR_RMW, CTR_ABORT, CTR_LATSUM, CTR_LATCNT = range(6)
+# counter row indices in the packed (R, STATS_CTR.width) counters output —
+# derived from the declared table (core/layouts.py) so the kernel, the
+# Meta fold in faststep, and the analyzer's kernel seeds cannot drift
+CTR_READ = layouts.STATS_CTR.row("read")
+CTR_WRITE = layouts.STATS_CTR.row("write")
+CTR_RMW = layouts.STATS_CTR.row("rmw")
+CTR_ABORT = layouts.STATS_CTR.row("abort")
+CTR_LATSUM = layouts.STATS_CTR.row("lat_sum")
+CTR_LATCNT = layouts.STATS_CTR.row("lat_cnt")
+CTR_WIDTH = layouts.STATS_CTR.width
 
 
 def _interpret() -> bool:
@@ -65,6 +74,7 @@ def _stats_kernel(step_ref, op_ref, invoke_ref, commit_ref, abort_ref,
     # Mosaic lowers reliably (validated on the target TPU via bench.py)
     red = lambda x: jnp.sum(x, axis=1, keepdims=True)
     zero = jnp.zeros((op.shape[0], 1), jnp.int32)
+    n_pad = CTR_WIDTH - len(layouts.STATS_CTR.rows)
     ctr_ref[:] += jnp.concatenate([
         red(read_done.astype(jnp.int32)),
         red(ci * (1 - is_rmw.astype(jnp.int32))),
@@ -72,8 +82,7 @@ def _stats_kernel(step_ref, op_ref, invoke_ref, commit_ref, abort_ref,
         red(abort.astype(jnp.int32)),
         red(lat),
         red(ci),
-        zero, zero,
-    ], axis=1)
+    ] + [zero] * n_pad, axis=1)
 
     # histogram: one reduction per bin (static unroll; all inside this kernel)
     nbin = st.LAT_BINS
@@ -88,8 +97,8 @@ def stats_block(step, sess_op, invoke_step, commit, abort, read_done):
     """Fused completion codes + counters + latency histogram.
 
     Args: scalar round index + (R, S) session arrays (commit/abort/read_done
-    bool).  Returns (code (R,S) int32, ctr (R,8) int32 packed per CTR_*,
-    hist_add (R, LAT_BINS) int32).
+    bool).  Returns (code (R,S) int32, ctr (R, STATS_CTR.width) int32 packed
+    per the declared CTR_* rows, hist_add (R, LAT_BINS) int32).
     """
     R, S = sess_op.shape
     nbin = st.LAT_BINS
@@ -110,24 +119,32 @@ def stats_block(step, sess_op, invoke_step, commit, abort, read_done):
         commit, abort, read_done = padit(commit), padit(abort), padit(read_done)
     sblk = pl.BlockSpec((R, bs), lambda j: (0, j))
     fixed = lambda shape: pl.BlockSpec(shape, lambda j: (0, 0))
-    code, ctr, hist = pl.pallas_call(
-        _stats_kernel,
-        grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda j: (0, 0), memory_space=pltpu.SMEM),
-            sblk, sblk, sblk, sblk, sblk,
-        ],
-        out_specs=[sblk, fixed((R, 8)), fixed((R, nbin))],
-        out_shape=[
-            jax.ShapeDtypeStruct((R, nblk * bs), jnp.int32),
-            jax.ShapeDtypeStruct((R, 8), jnp.int32),
-            jax.ShapeDtypeStruct((R, nbin), jnp.int32),
-        ],
-        interpret=_interpret(),
-    )(
+    args = (
         jnp.asarray(step, jnp.int32).reshape(1, 1),
         sess_op, invoke_step,
         commit.astype(jnp.int32), abort.astype(jnp.int32),
         read_done.astype(jnp.int32),
     )
+    # The ctr/hist output blocks have grid-invariant index maps: the same
+    # block is revisited and accumulated across grid steps (zeroed on the
+    # first visit under pl.when(blk == 0)).  The analyzer's RefHazardPass
+    # requires that aliasing be declared — the audit tag on the call site
+    # is the declaration, and the pass proves the first-visit init.
+    with layouts.audited("stats-ctr-hist-grid-accumulate"):
+        code, ctr, hist = pl.pallas_call(
+            _stats_kernel,
+            grid=(nblk,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda j: (0, 0),
+                             memory_space=pltpu.SMEM),
+                sblk, sblk, sblk, sblk, sblk,
+            ],
+            out_specs=[sblk, fixed((R, CTR_WIDTH)), fixed((R, nbin))],
+            out_shape=[
+                jax.ShapeDtypeStruct((R, nblk * bs), jnp.int32),
+                jax.ShapeDtypeStruct((R, CTR_WIDTH), jnp.int32),
+                jax.ShapeDtypeStruct((R, nbin), jnp.int32),
+            ],
+            interpret=_interpret(),
+        )(*args)
     return code[:, :S], ctr, hist

@@ -173,10 +173,47 @@ BLOCK_META = Layout("block_meta", "INV block scalars (epoch | alive)", (
 #: trace time — declared here as a NOTE, not a fixed layout: the analyzer
 #: proves it per-config from the traced constants.
 
+class RowTable(NamedTuple):
+    """A packed row layout: named rows inside a fixed-width minor axis
+    (the row analogue of ``Layout`` for arrays like the stats kernel's
+    ``(R, width)`` counter block — declared once so the kernel, the
+    Meta fold in faststep, and the analyzer's kernel seeds all read the
+    same table instead of a bare ``range(6)``)."""
+
+    name: str
+    doc: str
+    rows: Tuple[str, ...]
+    width: int
+
+    def row(self, name: str) -> int:
+        try:
+            return self.rows.index(name)
+        except ValueError:
+            raise KeyError(f"row table {self.name!r} has no row {name!r}")
+
+    def validate(self) -> None:
+        if len(set(self.rows)) != len(self.rows):
+            raise ValueError(f"{self.name}: duplicate row names")
+        if len(self.rows) > self.width:
+            raise ValueError(
+                f"{self.name}: {len(self.rows)} rows exceed the declared "
+                f"width {self.width}")
+
+
+#: Counter rows of the stats_block kernel's packed (R, width) output
+#: (core/kernels.py): the per-round op counters + the commit-latency
+#: sum/count pair, accumulated across grid revisits; rows beyond the
+#: declared ones are zero padding (the width keeps the minor axis a
+#: power of two for the TPU lane tiling).
+STATS_CTR = RowTable("stats_ctr", "stats_block packed counter rows", (
+    "read", "write", "rmw", "abort", "lat_sum", "lat_cnt",
+), width=8)
+
 ALL = (PTS, SST, INV_PKF, ACK_PKF, FUSED_KEY, LANE_WORD, ARB_WORD,
        SLOT_ACK, BLOCK_META)
 for _l in ALL:
     _l.validate()
+STATS_CTR.validate()
 
 # cross-layout consistency: the ACK echoes the INV's key verbatim
 assert ACK_PKF.field("key").bits == INV_PKF.field("key").bits

@@ -1,7 +1,11 @@
 """Static-analysis findings gate (CI): the jaxpr invariant analyzer
 (hermes_tpu/analysis) must report no NEW error/warn findings on the fast
 engines, at the default and bench configs, batched + sharded, fused +
-split sort.
+split sort — and, since ISSUE 8, on the standalone kernel matrix (every
+in-tree Pallas kernel through the sub-interpreter), with the
+differential sanitizer (analysis/diffcheck.py) cross-checking the
+abstract kernel cells against seeded concrete interpret-mode runs.
+Per-cell wall time rides the JSON line into GATES_SUMMARY.json.
 
 Why a gate: the engines' packed int32 words (timestamps, INV headers, the
 fused sort key) are protocol invariants that a refactor can silently
@@ -62,6 +66,8 @@ def main() -> int:
                     help="also export every finding as obs-schema JSONL")
     ap.add_argument("--configs", default=None,
                     help="comma-separated subset of the gate configs")
+    ap.add_argument("--no-kernels", action="store_true",
+                    help="skip the standalone kernel matrix + sanitizer")
     args = ap.parse_args()
 
     from hermes_tpu import analysis as ana
@@ -98,6 +104,34 @@ def main() -> int:
             measured[k] = measured.get(k, 0) + v
         all_reports.extend(reports)
 
+    # the kernel matrix: sub-interpreter findings share the baseline
+    # currency (engine key "kernel/<cell>"); sanitizer violations mean
+    # an UNSOUND transfer rule and fail the gate unconditionally
+    kernel_cells = {}
+    sanitizer_ok = True
+    if not args.no_kernels:
+        print("analyzing kernel matrix + differential sanitizer...",
+              file=sys.stderr)
+        for r in ana.run_kernel_matrix():
+            san = r.pop("sanitizer")
+            kernel_cells[r["engine"]] = dict(
+                seconds=r["seconds"], sanitizer_ok=san["ok"],
+                draws=san["n_draws"])
+            if not san["ok"]:
+                sanitizer_ok = False
+                print(f"SANITIZER VIOLATION in {r['engine']}: "
+                      f"{san['violations']}", file=sys.stderr)
+            for f in r["findings"]:
+                if f.severity == ana.ERROR:
+                    n_err += f.count
+                elif f.severity == ana.WARN:
+                    n_warn += f.count
+                else:
+                    n_info += f.count
+            for k, v in ana.key_counts(r["findings"]).items():
+                measured[k] = measured.get(k, 0) + v
+            all_reports.append(r)
+
     baseline = ana.load_baseline(args.baseline)
     new, stale = ana.diff_baseline(measured, baseline)
 
@@ -128,11 +162,17 @@ def main() -> int:
     if args.out:
         ana.export_findings(args.out, all_reports)
 
-    ok = not new
+    ok = not new and sanitizer_ok
     print(json.dumps(dict(
         ok=ok, configs=sorted(names), errors=n_err, warnings=n_warn,
         infos=n_info, gating_sites=len(measured),
+        sanitizer_ok=sanitizer_ok, kernel_cells=kernel_cells,
         new_findings=sorted(new), stale_baseline=sorted(stale))))
+    if not sanitizer_ok:
+        print("differential sanitizer VIOLATED: a kernel transfer rule "
+              "is unsound (concrete values escape the abstract cells) — "
+              "fix analysis/pallas.py or interp.py before trusting any "
+              "kernel proof", file=sys.stderr)
     if new:
         print("NEW findings (fix, audit with layouts.audited, or "
               "consciously --update the baseline):", file=sys.stderr)

@@ -35,9 +35,18 @@ XLA's scatter already does.  ``onehot`` cannot even materialize its (M, K)
 operand at bench shape (48 GB), and ``vgather`` still fails to lower
 ("Cannot do int indexing on TPU").  The XLA formulation stays.
 
+Since ISSUE 8 every candidate is also run through the static invariant
+analyzer (hermes_tpu/analysis — which now interprets pallas_call bodies):
+each cell carries ``analysis_clean`` (no error/warn findings under
+concrete-seeded bounds) so the mega-round builder knows which candidate
+formulations already pass the passes.  ``--annotate`` re-derives ONLY the
+analysis fields into an existing PALLAS_PROBE.json, preserving the
+on-chip timings (analysis is platform-independent).
+
 Usage (TPU, default env — one process, never kill mid-claim):
 
     python scripts/pallas_probe.py [--json PALLAS_PROBE.json]
+    python scripts/pallas_probe.py --annotate PALLAS_PROBE.json  # CPU ok
 
 On CPU the kernels run interpret=True: functional parity only, timings
 meaningless (the cells are tagged with the platform).
@@ -97,23 +106,129 @@ def _msgs(key, K, M):
     return keys, pts, rows
 
 
+# -- invariant analysis of one candidate step --------------------------------
+
+
+def analyze_step(fn, args, state_idx=()):
+    """Run the jaxpr invariant analyzer (all five passes, kernel bodies
+    interpreted) over one candidate step.  Arguments at ``state_idx``
+    are resident state seeded dtype-TOP (any reachable content); the
+    rest are the probe's message operands, seeded from their concrete
+    values.  Returns the ``analysis_*`` cell fields."""
+    from hermes_tpu.analysis import domain as D
+    from hermes_tpu.analysis import interp as I
+    from hermes_tpu.analysis.passes import default_passes
+    import numpy as np
+
+    jx = jax.make_jaxpr(fn)(*args)
+    avs = [D.top(np.asarray(a).dtype) if i in state_idx
+           else D.from_concrete(np.asarray(a))
+           for i, a in enumerate(args)]
+    ps = default_passes()
+    ctx = I.Ctx(passes=ps)
+    I.eval_jaxpr(jx.jaxpr, avs, ctx, consts=list(jx.consts))
+    fs = [f for p in ps for f in p.results()]
+    gating = [f for f in fs if f.severity in ("error", "warn")]
+    skipped = [f.message for f in fs if f.code == "pallas-skipped"]
+    return dict(
+        analysis_clean=not gating,
+        analysis_findings=[f"{f.severity}:{f.pass_name}/{f.code}@{f.site}"
+                           for f in gating],
+        **({"analysis_skipped": skipped} if skipped else {}))
+
+
+# -- the candidate builders (ONE source for timing cells and --annotate) -----
+
+
+def candidate_step(cand, K, M, interpret=True):
+    """The SAME formulation the timing cells run, shared with
+    ``--annotate`` so re-derived analysis fields can never drift from
+    the formulation that was timed on chip.  Returns
+    ``(fn, args, state_idx)``: the step callable, its concrete
+    arguments (each candidate's canonical message seed), and the
+    argument indices holding resident state (seeded dtype-TOP for
+    analysis; the rest seed from their concrete values)."""
+    if cand == "xla":
+        keys, pts, rows = _msgs(0, K, M)
+        rows8 = jax.lax.bitcast_convert_type(
+            rows, jnp.int8).reshape(M, 4 * W)
+        vpts = jnp.zeros((K,), jnp.int32)
+        bank = jnp.zeros((K, 4 * W), jnp.int8)
+        return _xla_step, (vpts, bank, keys, pts, rows8), (0, 1)
+    if cand == "serial":
+        keys, _pts, rows = _msgs(1, K, M)
+        table = jnp.zeros((K, W), jnp.int32)
+
+        def serial_fn(table, keys, rows):
+            return pl.pallas_call(
+                _serial_kernel,
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec((M, W), lambda: (0, 0)),
+                    pl.BlockSpec((K, W), lambda: (0, 0)),
+                ],
+                out_specs=pl.BlockSpec((K, W), lambda: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((K, W), jnp.int32),
+                input_output_aliases={2: 0},
+                interpret=interpret,
+            )(keys, rows, table)
+
+        return serial_fn, (table, keys, rows), (0,)
+    if cand == "onehot":
+        keys, _pts, rows = _msgs(2, K, M)
+        acc = jnp.zeros((K, W), jnp.int32)
+
+        def onehot_fn(acc, keys, rows):
+            onehot = (keys[:, None]
+                      == jnp.arange(K, dtype=jnp.int32)[None, :])
+            # int8 planes keep the scatter exact through the MXU (bf16
+            # would round) for the 0/1 onehot plane; rows mixes in the
+            # carry so the loop body is not hoistable.  (The analyzer
+            # truthfully flags the rows int8 wrap.)
+            rows = rows + acc[:1, :]
+            return jax.lax.dot_general(
+                onehot.astype(jnp.int8), rows.astype(jnp.int8),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+
+        return onehot_fn, (acc, keys, rows), (0,)
+    if cand == "vgather":
+        keys, _pts, _rows = _msgs(3, K, M)
+        table = jnp.ones((K, W), jnp.int32)
+
+        def vgather_fn(keys, table):
+            out = pl.pallas_call(
+                _vgather_kernel,
+                out_shape=jax.ShapeDtypeStruct((M, W), jnp.int32),
+                interpret=interpret,
+            )(keys, table)
+            return out[:, 0] & (K - 1)  # feed back as keys (no hoisting)
+
+        return vgather_fn, (keys, table), (1,)
+    raise KeyError(cand)
+
+
 # -- A: the production XLA formulation --------------------------------------
 
 
 def _xla_step(vpts, bank, keys, pts, rows8):
     vpts = vpts.at[keys].max(pts, mode="drop")
-    bank = bank.at[keys].set(rows8, mode="drop")
+    # mirrors faststep's audited winner-row site: duplicate keys write
+    # byte-identical rows in the production round (the probe's random
+    # rows don't carry that invariant, but the formulation does)
+    from hermes_tpu.core import layouts
+
+    with layouts.audited("winner-row-dup-writes-identical"):
+        bank = bank.at[keys].set(rows8, mode="drop")
     return vpts, bank
 
 
 def cell_xla(K, M, n_lo=200, n_hi=2000):
-    vpts = jnp.zeros((K,), jnp.int32)
-    bank = jnp.zeros((K, 4 * W), jnp.int8)
-    keys, pts, rows = _msgs(0, K, M)
-    rows8 = jax.lax.bitcast_convert_type(rows, jnp.int8).reshape(M, 4 * W)
-    dt = _time(lambda s, k, p, r: _xla_step(*s, k, p, r),
-               (vpts, bank), (keys, pts, rows8), n_lo=n_lo, n_hi=n_hi)
-    return dict(cand="xla", K=K, M=M, s_per_call=dt, us_per_msg=dt / M * 1e6)
+    fn, args, si = candidate_step("xla", K, M)
+    dt = _time(lambda s, k, p, r: fn(*s, k, p, r),
+               args[:2], args[2:], n_lo=n_lo, n_hi=n_hi)
+    return dict(cand="xla", K=K, M=M, s_per_call=dt, us_per_msg=dt / M * 1e6,
+                **analyze_step(fn, args, state_idx=si))
 
 
 # -- B: serial VMEM apply (Pallas) ------------------------------------------
@@ -134,48 +249,22 @@ def _serial_kernel(keys_ref, rows_ref, tin_ref, tout_ref):
 
 
 def cell_serial(K, M, interpret, n_lo=100, n_hi=1000):
-    keys, _pts, rows = _msgs(1, K, M)
-    table = jnp.zeros((K, W), jnp.int32)
-
-    def f(table, keys, rows):
-        return pl.pallas_call(
-            _serial_kernel,
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec((M, W), lambda: (0, 0)),
-                pl.BlockSpec((K, W), lambda: (0, 0)),
-            ],
-            out_specs=pl.BlockSpec((K, W), lambda: (0, 0)),
-            out_shape=jax.ShapeDtypeStruct((K, W), jnp.int32),
-            input_output_aliases={2: 0},
-            interpret=interpret,
-        )(keys, rows, table)
-
-    dt = _time(f, table, (keys, rows), n_lo=n_lo, n_hi=n_hi)
-    return dict(cand="serial", K=K, M=M, s_per_call=dt, us_per_msg=dt / M * 1e6)
+    fn, args, si = candidate_step("serial", K, M, interpret=interpret)
+    dt = _time(fn, args[0], args[1:], n_lo=n_lo, n_hi=n_hi)
+    return dict(cand="serial", K=K, M=M, s_per_call=dt,
+                us_per_msg=dt / M * 1e6,
+                **analyze_step(fn, args, state_idx=si))
 
 
 # -- C: one-hot MXU scatter --------------------------------------------------
 
 
 def cell_onehot(K, M):
-    keys, _pts, rows = _msgs(2, K, M)
-
-    def f(acc, keys, rows):
-        onehot = (keys[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :])
-        # int8 planes keep the scatter exact through the MXU (bf16 would
-        # round); this is the cheapest exact formulation we found.  rows
-        # mixes in the carry so the loop body is not hoistable.
-        rows = rows + acc[:1, :]
-        return jax.lax.dot_general(
-            onehot.astype(jnp.int8), rows.astype(jnp.int8),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-
-    acc = jnp.zeros((K, W), jnp.int32)
-    dt = _time(f, acc, (keys, rows), n_lo=200, n_hi=2000)
+    fn, args, si = candidate_step("onehot", K, M)
+    dt = _time(fn, args[0], args[1:], n_lo=200, n_hi=2000)
     return dict(cand="onehot", K=K, M=M, s_per_call=dt, us_per_msg=dt / M * 1e6,
-                flops_amplification=K)
+                flops_amplification=K,
+                **analyze_step(fn, args, state_idx=si))
 
 
 # -- D: vectorized dynamic gather inside Pallas ------------------------------
@@ -186,31 +275,53 @@ def _vgather_kernel(keys_ref, table_ref, out_ref):
 
 
 def cell_vgather(K, M, interpret):
-    keys, _pts, _rows = _msgs(3, K, M)
-    table = jnp.ones((K, W), jnp.int32)
-
-    def f(keys, table):
-        out = pl.pallas_call(
-            _vgather_kernel,
-            out_shape=jax.ShapeDtypeStruct((M, W), jnp.int32),
-            interpret=interpret,
-        )(keys, table)
-        return out[:, 0] & (K - 1)  # feed back as next keys (no hoisting)
-
+    f, args, si = candidate_step("vgather", K, M, interpret=interpret)
+    keys, table = args
+    analysis = analyze_step(f, args, state_idx=si)
     try:
         dt = _time(f, keys, (table,), n_lo=40, n_hi=200)
         return dict(cand="vgather", K=K, M=M, s_per_call=dt,
-                    us_per_msg=dt / M * 1e6, compiled=True)
+                    us_per_msg=dt / M * 1e6, compiled=True, **analysis)
     except Exception as e:  # Mosaic lowering rejection is the expected result
         first = str(e).strip().splitlines()
         return dict(cand="vgather", K=K, M=M, compiled=False,
-                    error=(first[0] if first else type(e).__name__)[:300])
+                    error=(first[0] if first else type(e).__name__)[:300],
+                    **analysis)
+
+
+def annotate(path: str) -> None:
+    """Re-derive ONLY the ``analysis_*`` fields of an existing probe
+    artifact, preserving its on-chip timings (the analyzer is abstract
+    and platform-independent; the probe shapes rebuild from each cell's
+    recorded K/M with the candidate's canonical message seed)."""
+    with open(path) as f:
+        doc = json.load(f)
+    for cell in doc["cells"]:
+        cand, K, M = cell["cand"], cell["K"], cell["M"]
+        try:
+            fn, args, si = candidate_step(cand, K, M, interpret=True)
+        except KeyError:
+            continue
+        ana = analyze_step(fn, args, state_idx=si)
+        cell.pop("analysis_skipped", None)
+        cell.update(ana)
+        print(json.dumps(dict(cand=cand, K=K, M=M, **ana)),
+              file=sys.stderr)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
+    ap.add_argument("--annotate", default=None, metavar="PROBE_JSON",
+                    help="update analysis_* fields of an existing probe "
+                    "artifact in place (timings untouched; CPU-safe)")
     args = ap.parse_args()
+
+    if args.annotate:
+        annotate(args.annotate)
+        return
 
     platform = jax.devices()[0].platform
     interpret = platform != "tpu"

@@ -61,6 +61,8 @@ SLOW_TESTS = {
     "test_sharded_matches_batched",
     "test_read_unroll_sharded_matches_batched",
     "test_stats_block_multi_block_grid",
+    "test_sanitizer_passes_kernel_matrix",  # 3-shape diffcheck soak
+    "test_gate_kernel_section_red_on_unsound_rule",  # gate subprocess-ish
     "test_frozen_replica_stall_and_recovery",
     "test_kvs_client_path_at_scale_checked",
     "test_kvs_sparse_keys_end_to_end_checked",

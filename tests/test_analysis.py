@@ -466,9 +466,13 @@ class TestGate:
         baseline = tmp_path / "BASELINE.json"
 
         def run(*argv):
+            # --no-kernels: the kernel matrix + sanitizer path has its
+            # own gate test (tests/test_pallas_analysis.py); this one
+            # stays focused on the engine baseline machinery
             monkeypatch.setattr(
                 "sys.argv",
-                ["check_analysis.py", "--baseline", str(baseline), *argv])
+                ["check_analysis.py", "--baseline", str(baseline),
+                 "--no-kernels", *argv])
             return mod.main()
 
         # pass: clean engines, empty baseline

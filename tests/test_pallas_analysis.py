@@ -1,0 +1,508 @@
+"""Tests for the pallas kernel sub-interpreter (ISSUE 8).
+
+The analyzer no longer skips ``pallas_call`` bodies: stats_block is
+fully interpreted (tight output intervals, kernel-internal finding
+sites), the RefHazard discipline flips red on seeded kernel mutations
+(overlapping pack inside a kernel, out-of-bounds block store, dropped
+``pl.when(blk == 0)`` init, unaudited grid-revisit accumulator,
+out-of-range BlockSpec index map), an unmodeled primitive degrades to a
+``pallas-skipped`` info finding instead of a silent pass, and the
+differential sanitizer both passes on the in-tree kernel matrix and
+catches a deliberately unsound transfer rule.  Mirrors the PR-3
+mutation-test pattern in tests/test_analysis.py.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hermes_tpu import analysis as ana
+from hermes_tpu.analysis import diffcheck
+from hermes_tpu.analysis import domain as D
+from hermes_tpu.analysis import interp as I
+from hermes_tpu.analysis import seeds
+from hermes_tpu.analysis.domain import iv
+from hermes_tpu.analysis.passes import RefHazardPass, default_passes
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import kernels, layouts
+from hermes_tpu.core import state as st
+
+
+def _run(fn, in_avs, shapes, passes=None):
+    jx = jax.make_jaxpr(fn)(*shapes)
+    ps = passes if passes is not None else default_passes()
+    ctx = I.Ctx(passes=ps, mesh_axes=None)
+    outs = I.eval_jaxpr(jx.jaxpr, in_avs, ctx, consts=list(jx.consts))
+    findings = [f for p in ps for f in p.results()]
+    return outs, findings, ctx, ps
+
+
+def _gating(findings):
+    return [f for f in findings if f.severity in ana.GATING]
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _stats_shapes(R, S):
+    return (_sds((), jnp.int32), _sds((R, S), jnp.int32),
+            _sds((R, S), jnp.int32), _sds((R, S), jnp.bool_),
+            _sds((R, S), jnp.bool_), _sds((R, S), jnp.bool_))
+
+
+# --------------------------------------------------------------------------
+# the kernel black box is open
+# --------------------------------------------------------------------------
+
+
+class TestKernelInterp:
+    def test_stats_block_fully_interpreted(self):
+        # pre-ISSUE-8 every pallas output was dtype-TOP; now the code
+        # output carries the exact completion-code interval and the
+        # single-block histogram is bounded by the block width
+        outs, findings, ctx, ps = _run(
+            kernels.stats_block, seeds.seed_stats_block(),
+            _stats_shapes(4, 512))
+        assert _gating(findings) == []
+        code, ctr, hist = outs
+        assert (code.lo, code.hi) == (0, 4)  # C_NONE..C_RMW_ABORT
+        assert not D.is_top(code, np.int32)
+        assert (hist.lo, hist.hi) == (0, 512)
+        hp = next(p for p in ps if p.name == "refhazard")
+        assert hp.n_proved > 0
+
+    def test_multiblock_revisit_audited_visible(self):
+        # the multi-block grid revisits ctr/hist; the declared audit on
+        # the call site surfaces as an info finding carrying the tag
+        outs, findings, _, _ = _run(
+            kernels.stats_block, seeds.seed_stats_block(),
+            _stats_shapes(1024, 600))
+        assert _gating(findings) == []
+        revisit = [f for f in findings
+                   if f.code == "grid-revisit-accumulator"]
+        assert revisit and all(f.severity == "info" for f in revisit)
+        assert all(f.audit == "stats-ctr-hist-grid-accumulate"
+                   for f in revisit)
+        assert (outs[0].lo, outs[0].hi) == (0, 4)
+
+    def test_mutation_drop_revisit_audit_flips_red(self, monkeypatch):
+        # the kernel analogue of PR-3's dropped-scatter-audit mutation.
+        # pallas_call's jit cache would replay the audited trace from
+        # the earlier tests — drop it so the mutation really re-traces
+        jax.clear_caches()
+        monkeypatch.setattr(layouts, "audited",
+                            lambda tag: contextlib.nullcontext())
+        _, findings, _, _ = _run(
+            kernels.stats_block, seeds.seed_stats_block(),
+            _stats_shapes(1024, 600))
+        gating = _gating(findings)
+        assert any(f.code == "grid-revisit-accumulator" for f in gating)
+
+    def test_round_program_polices_kernel(self):
+        # the engine round CONTAINS stats_block: the sub-interpreter now
+        # walks it inside the round analysis (in-bounds + init proofs
+        # counted) and the round stays clean
+        cfg = HermesConfig(n_replicas=3, n_keys=1 << 12, n_sessions=16,
+                           replay_slots=8, ops_per_session=8)
+        reports = ana.analyze_config(cfg, engines=("batched",))
+        assert _gating(ana.findings_of(reports)) == []
+        assert all(r["proved"]["refhazard"] > 0 for r in reports)
+        skipped = [f for f in ana.findings_of(reports)
+                   if f.code == "pallas-skipped"]
+        assert skipped == []  # the kernel is modeled, not skipped
+
+    def test_kernel_internal_finding_site(self):
+        # findings inside a kernel name the kernel function and file,
+        # not the pallas_call call site
+        def _pack_kernel(a_ref, b_ref, o_ref):
+            o_ref[:] = (a_ref[:] << 29) | b_ref[:]
+
+        def f(a, b):
+            return pl.pallas_call(
+                _pack_kernel,
+                out_shape=_sds((8, 128), jnp.int32),
+                interpret=True)(a, b)
+
+        s = _sds((8, 128), jnp.int32)
+        _, findings, _, _ = _run(f, [iv(0, 2), iv(0, 1 << 29)], (s, s))
+        errs = [f_ for f_ in findings if f_.code == "pack-overlap"]
+        assert errs, "overlapping pack inside a kernel body must flag"
+        assert errs[0].severity == "error"
+        assert errs[0].file.endswith("test_pallas_analysis.py")
+        assert errs[0].fn == "_pack_kernel"
+
+    def test_disjoint_kernel_pack_proved(self):
+        def _pack_kernel(a_ref, b_ref, o_ref):
+            o_ref[:] = (a_ref[:] << 29) | b_ref[:]
+
+        def f(a, b):
+            return pl.pallas_call(
+                _pack_kernel,
+                out_shape=_sds((8, 128), jnp.int32),
+                interpret=True)(a, b)
+
+        s = _sds((8, 128), jnp.int32)
+        outs, findings, _, ps = _run(
+            f, [iv(0, 2), iv(0, (1 << 29) - 1)], (s, s))
+        assert _gating(findings) == []
+        assert next(p for p in ps if p.name == "bitpack").n_proved >= 2
+        # and the output keeps the pack's sign-safe hull, not dtype-TOP
+        assert outs[0].lo == 0 and not D.is_top(outs[0], np.int32)
+
+
+# --------------------------------------------------------------------------
+# ref hazards: stores in bounds, init discipline, block specs
+# --------------------------------------------------------------------------
+
+
+def _store_at_idx(idx_av, blk=8):
+    """A kernel storing one row at a dynamic SMEM-scalar index."""
+
+    def _kern(i_ref, v_ref, o_ref):
+        o_ref[:] = jnp.zeros_like(o_ref)
+        i = i_ref[0, 0]
+        o_ref[pl.dslice(i, 1), :] = v_ref[pl.dslice(0, 1), :]
+
+    def f(i, v):
+        return pl.pallas_call(
+            _kern,
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((blk, 128), lambda: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((blk, 128), lambda: (0, 0)),
+            out_shape=_sds((blk, 128), jnp.int32),
+            interpret=True)(i, v)
+
+    shapes = (_sds((1, 1), jnp.int32), _sds((blk, 128), jnp.int32))
+    return _run(f, [idx_av, iv(0, 100)], shapes)
+
+
+class TestRefHazards:
+    def test_oob_block_store_flips_red(self):
+        _, findings, _, _ = _store_at_idx(iv(0, 100), blk=8)
+        errs = [f for f in findings if f.code == "oob-block-store"]
+        assert errs and errs[0].severity == "error"
+
+    def test_in_bounds_store_proved(self):
+        _, findings, _, ps = _store_at_idx(iv(0, 7), blk=8)
+        assert _gating(findings) == []
+        assert next(p for p in ps if p.name == "refhazard").n_proved > 0
+
+    def _acc(self, with_init, audited):
+        """A 2-block grid accumulating into a revisited (8, 1) output."""
+
+        def _kern(x_ref, o_ref):
+            if with_init:
+                @pl.when(pl.program_id(0) == 0)
+                def _init():
+                    o_ref[:] = jnp.zeros_like(o_ref)
+
+            o_ref[:] += jnp.sum(x_ref[:], axis=1, keepdims=True)
+
+        def f(x):
+            scope = (layouts.audited("test-acc-revisit") if audited
+                     else contextlib.nullcontext())
+            with scope:
+                return pl.pallas_call(
+                    _kern,
+                    grid=(2,),
+                    in_specs=[pl.BlockSpec((8, 128), lambda j: (0, j))],
+                    out_specs=pl.BlockSpec((8, 1), lambda j: (0, 0)),
+                    out_shape=_sds((8, 1), jnp.int32),
+                    interpret=True)(x)
+
+        return _run(f, [iv(0, 3)], (_sds((8, 256), jnp.int32),))
+
+    def test_dropped_when_init_flips_red(self):
+        # stats_block's pl.when(blk == 0) zero-fill, removed: the first
+        # visit reads garbage
+        _, findings, _, _ = self._acc(with_init=False, audited=True)
+        errs = [f for f in findings if f.code == "ref-read-before-init"]
+        assert errs and errs[0].severity == "error"
+
+    def test_first_visit_init_proved(self):
+        _, findings, _, _ = self._acc(with_init=True, audited=True)
+        assert not [f for f in findings
+                    if f.code == "ref-read-before-init"]
+        assert _gating(findings) == []
+
+    def test_unaudited_revisit_warns(self):
+        _, findings, _, _ = self._acc(with_init=True, audited=False)
+        ws = [f for f in findings if f.code == "grid-revisit-accumulator"]
+        assert ws and ws[0].severity == "warn"
+
+    def test_blockspec_oob_flips_red(self):
+        # an index map pointing one block past the operand
+        def _kern(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+
+        def f(x):
+            return pl.pallas_call(
+                _kern,
+                grid=(2,),
+                in_specs=[pl.BlockSpec((8, 128), lambda j: (0, j))],
+                out_specs=pl.BlockSpec((8, 128), lambda j: (0, j + 1)),
+                out_shape=_sds((8, 256), jnp.int32),
+                interpret=True)(x)
+
+        _, findings, _, _ = _run(f, [iv(0, 3)],
+                                 (_sds((8, 256), jnp.int32),))
+        errs = [f_ for f_ in findings if f_.code == "blockspec-oob"]
+        assert errs and errs[0].severity == "error"
+
+    def test_serial_scan_store_in_bounds(self):
+        # the pallas_probe serial formulation: a fori_loop (scan) whose
+        # induction index must stay inside the SMEM block and whose
+        # table store is bounded by the seeded key range
+        K, M, W = 64, 32, 10
+
+        def _kern(keys_ref, rows_ref, tin_ref, tout_ref):
+            del tin_ref
+
+            def body(i, _):
+                k = keys_ref[i]
+                tout_ref[pl.dslice(k, 1), :] = rows_ref[pl.dslice(i, 1), :]
+                return 0
+
+            jax.lax.fori_loop(0, keys_ref.shape[0], body, 0)
+
+        def f(table, keys, rows):
+            return pl.pallas_call(
+                _kern,
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec((M, W), lambda: (0, 0)),
+                    pl.BlockSpec((K, W), lambda: (0, 0)),
+                ],
+                out_specs=pl.BlockSpec((K, W), lambda: (0, 0)),
+                out_shape=_sds((K, W), jnp.int32),
+                input_output_aliases={2: 0},
+                interpret=True)(keys, rows, table)
+
+        shapes = (_sds((K, W), jnp.int32), _sds((M,), jnp.int32),
+                  _sds((M, W), jnp.int32))
+        outs, findings, _, _ = _run(
+            f, [iv(0, 100), iv(0, K - 1), iv(0, 1 << 20)], shapes)
+        assert _gating(findings) == []
+        # out aliases the table input: its cell is seeded, so the join
+        # of table and stored rows — not TOP
+        assert outs[0].lo == 0 and outs[0].hi == 1 << 20
+
+    def test_serial_scan_oob_key_flips_red(self):
+        # same kernel, keys seeded past the table: the store can escape
+        K, M, W = 64, 32, 10
+
+        def _kern(keys_ref, rows_ref, tin_ref, tout_ref):
+            del tin_ref
+
+            def body(i, _):
+                k = keys_ref[i]
+                tout_ref[pl.dslice(k, 1), :] = rows_ref[pl.dslice(i, 1), :]
+                return 0
+
+            jax.lax.fori_loop(0, keys_ref.shape[0], body, 0)
+
+        def f(table, keys, rows):
+            return pl.pallas_call(
+                _kern,
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec((M, W), lambda: (0, 0)),
+                    pl.BlockSpec((K, W), lambda: (0, 0)),
+                ],
+                out_specs=pl.BlockSpec((K, W), lambda: (0, 0)),
+                out_shape=_sds((K, W), jnp.int32),
+                input_output_aliases={2: 0},
+                interpret=True)(keys, rows, table)
+
+        shapes = (_sds((K, W), jnp.int32), _sds((M,), jnp.int32),
+                  _sds((M, W), jnp.int32))
+        _, findings, _, _ = _run(
+            f, [iv(0, 100), iv(0, K), iv(0, 1 << 20)], shapes)
+        errs = [f_ for f_ in findings if f_.code == "oob-block-store"]
+        assert errs and errs[0].severity == "error"
+
+
+# --------------------------------------------------------------------------
+# the escape hatch: unmodeled kernels degrade loudly, never silently
+# --------------------------------------------------------------------------
+
+
+class TestEscapeHatch:
+    def test_unmodeled_primitive_emits_pallas_skipped(self):
+        # a DMA kernel: dma_start touches Refs and is outside the cell
+        # model — the finding names it, and outputs fall back to TOP
+        def _kern(x_ref, o_ref, sem):
+            cp = pltpu.make_async_copy(x_ref, o_ref, sem)
+            cp.start()
+            cp.wait()
+
+        def f(x):
+            return pl.pallas_call(
+                _kern,
+                out_shape=_sds((8, 128), jnp.int32),
+                scratch_shapes=[pltpu.SemaphoreType.DMA],
+                interpret=True)(x)
+
+        outs, findings, _, _ = _run(f, [iv(0, 7)],
+                                    (_sds((8, 128), jnp.int32),))
+        skipped = [f_ for f_ in findings if f_.code == "pallas-skipped"]
+        assert skipped, "an unmodeled kernel must NOT pass silently"
+        assert all(f_.severity == "info" for f_ in skipped)
+        assert "dma_start" in skipped[0].message
+        assert D.is_top(outs[0], np.int32)  # sound fallback
+
+    def test_modeled_kernel_not_skipped(self):
+        _, findings, _, _ = _run(
+            kernels.stats_block, seeds.seed_stats_block(),
+            _stats_shapes(4, 512))
+        assert not [f for f in findings if f.code == "pallas-skipped"]
+
+
+# --------------------------------------------------------------------------
+# differential sanitizer
+# --------------------------------------------------------------------------
+
+
+class TestDiffCheck:
+    def test_sanitizer_passes_small_cell(self):
+        # the quick-tier sibling of the full-matrix soak below
+        r = diffcheck.diff_check(
+            diffcheck.cell_by_name("stats_block/r4s512"), n_draws=2)
+        assert r["ok"], r["violations"]
+
+    def test_sanitizer_passes_kernel_matrix(self):
+        # >= 3 seeded shapes per kernel, concrete always inside abstract
+        cells = diffcheck.kernel_cells()
+        assert len(cells) >= 3
+        for cell in cells:
+            r = diffcheck.diff_check(cell, n_draws=3)
+            assert r["ok"], (cell.name, r["violations"])
+
+    def test_loop_accumulation_not_underapproximated(self):
+        # review-caught soundness regression: a fori_loop accumulating
+        # into a ref must NOT 'converge' after one body evaluation —
+        # the scan fixpoint widens loop-carried cell state
+        def _kern(x_ref, o_ref):
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+            def body(i, _):
+                o_ref[:] = o_ref[:] + 1
+                return 0
+
+            jax.lax.fori_loop(0, 10, body, 0)
+
+        def f(x):
+            return pl.pallas_call(
+                _kern, out_shape=_sds((8, 128), jnp.int32),
+                interpret=True)(x)
+
+        outs, findings, _, _ = _run(f, [iv(0, 7)],
+                                    (_sds((8, 128), jnp.int32),))
+        assert _gating(findings) == []
+        conc = int(np.asarray(f(jnp.zeros((8, 128), jnp.int32))).max())
+        assert conc == 10
+        assert outs[0].lo <= 0 and outs[0].hi >= conc
+        # and the registry keeps a sanitizer sentinel for the pattern
+        r = diffcheck.diff_check(
+            diffcheck.cell_by_name("synthetic/scan-accumulate"),
+            n_draws=2)
+        assert r["ok"], r["violations"]
+
+    def test_unsound_rule_mutation_caught(self, monkeypatch):
+        # break a transfer rule on purpose: concrete histogram counts
+        # escape the (now wrongly tight) abstract cell
+        cell = diffcheck.cell_by_name("stats_block/r4s512")
+        monkeypatch.setitem(I.RULES, "reduce_sum",
+                            lambda eqn, ins, ctx: [D.iv(0)])
+        r = diffcheck.diff_check(cell, n_draws=2)
+        assert not r["ok"]
+        assert any(v["kind"] == "interval" for v in r["violations"])
+
+    def test_draws_respect_declared_bounds(self):
+        cell = diffcheck.cell_by_name("stats_block/r4s512")
+        rng = np.random.default_rng(0)
+        for sds, av in zip(cell.shapes, cell.in_avs):
+            a = diffcheck._draw(rng, sds, av)
+            assert a.shape == sds.shape
+            assert int(np.min(a)) >= av.lo and int(np.max(a)) <= av.hi
+
+    def test_ctr_rows_from_declared_table(self):
+        # satellite: no more bare range(6) — the kernel's counter rows
+        # and width derive from the layouts.STATS_CTR table
+        t = layouts.STATS_CTR
+        assert (kernels.CTR_READ, kernels.CTR_WRITE, kernels.CTR_RMW,
+                kernels.CTR_ABORT, kernels.CTR_LATSUM,
+                kernels.CTR_LATCNT) == tuple(
+                    t.row(n) for n in ("read", "write", "rmw", "abort",
+                                       "lat_sum", "lat_cnt"))
+        assert kernels.CTR_WIDTH == t.width
+        t.validate()
+        with pytest.raises(ValueError, match="exceed"):
+            layouts.RowTable("bad", "", ("a", "b", "c"), 2).validate()
+        # and the kernel's packed output really is table-shaped
+        code, ctr, hist = kernels.stats_block(
+            3, jnp.zeros((2, 256), jnp.int32),
+            jnp.zeros((2, 256), jnp.int32), jnp.zeros((2, 256), bool),
+            jnp.zeros((2, 256), bool), jnp.zeros((2, 256), bool))
+        assert ctr.shape == (2, t.width)
+        assert hist.shape == (2, st.LAT_BINS)
+
+
+# --------------------------------------------------------------------------
+# CLI + gate plumbing
+# --------------------------------------------------------------------------
+
+
+class TestKernelsCLI:
+    def test_kernels_flag_runs_matrix(self, monkeypatch, capsys):
+        import json as json_mod
+
+        from hermes_tpu.analysis import __main__ as cli
+
+        small = diffcheck.cell_by_name("stats_block/r4s512")
+        monkeypatch.setattr(diffcheck, "kernel_cells", lambda: [small])
+        rc = cli.main(["--kernels", "--json", "--draws", "2"])
+        assert rc == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        doc = json_mod.loads(line)
+        assert doc["ok"] and doc["config"] == "kernels"
+        (cell_info,) = doc["cells"].values()
+        assert cell_info["sanitizer_ok"] and cell_info["draws"] == 2
+        assert cell_info["seconds"] > 0
+
+    def test_gate_kernel_section_red_on_unsound_rule(self, tmp_path,
+                                                     monkeypatch):
+        import importlib.util
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_analysis_k",
+            os.path.join(repo, "scripts", "check_analysis.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(mod, "gate_configs", lambda: {})
+        small = diffcheck.cell_by_name("stats_block/r4s512")
+        monkeypatch.setattr(diffcheck, "kernel_cells", lambda: [small])
+        baseline = tmp_path / "B.json"
+
+        def run(*argv):
+            monkeypatch.setattr(
+                "sys.argv",
+                ["check_analysis.py", "--baseline", str(baseline), *argv])
+            return mod.main()
+
+        assert run() == 0  # clean kernel matrix passes
+        monkeypatch.setitem(I.RULES, "reduce_sum",
+                            lambda eqn, ins, ctx: [D.iv(0)])
+        assert run() == 1  # sanitizer violation fails the gate
