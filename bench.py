@@ -416,6 +416,48 @@ def run_pipeline_compare(depth: int = 4, rounds: int = 40, warmup: int = 8,
     }
 
 
+def run_fleet_bench(groups: int = 4, rounds: int | None = None,
+                    chunks: int = 2) -> dict:
+    """Fleet scale-out cells (round-13, BENCH_FLEET.json): per-group +
+    aggregate committed writes/s of a ``groups``-group key-sharded fleet
+    (hermes_tpu.fleet.bench.run_fleet_cells), plus the single-group
+    baseline and the concurrent-dispatch cell.
+
+    Shape honesty: on a TPU the per-group shape IS the bench shape (the
+    YCSB-A ``_cfg('a')`` cell — each group would own its chips on the
+    (groups, replicas) grid).  On the host backend the full shape is
+    hours of CPU, so the cells run a reduced per-group shape (recorded in
+    the artifact) and the JSON carries ``tpu_pending`` naming the on-chip
+    rerun — the same carried-over protocol as PIPELINE_COMPARE /
+    CHAOS_BENCH / FUSED_COMPARE."""
+    from hermes_tpu.config import FleetConfig
+    from hermes_tpu.fleet.bench import run_fleet_cells
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        base = _cfg("a")
+        rounds = ROUNDS if rounds is None else rounds
+    else:
+        base = _cfg("a", dict(n_keys=1 << 14, n_sessions=1024,
+                              replay_slots=64, lane_budget_cfg=768,
+                              chain_writes=128))
+        rounds = 10 if rounds is None else rounds
+    r = run_fleet_cells(FleetConfig(groups=groups, base=base),
+                        rounds=rounds, chunks=chunks)
+    r["note"] = (
+        "aggregate = sum of per-group cells, each measured alone — the "
+        "scale-out capacity when every group owns its devices (exactly "
+        "the on-chip deployment); 'concurrent' is the same groups "
+        "timesharing THIS host's cores")
+    if not on_tpu:
+        r["tpu_pending"] = (
+            "host-backend stand-in at reduced per-group shape — rerun "
+            "bench.py --fleet on the chip for the full bench-shape "
+            "cells, alongside the carried-over PIPELINE_COMPARE.json / "
+            "CHAOS_BENCH.json / FUSED_COMPARE.json artifacts")
+    return r
+
+
 def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
                    warmup: int = 8) -> dict:
     """Serving rate under chaos (round-9, CHAOS_BENCH.json): the bench-
@@ -535,6 +577,15 @@ def main() -> None:
                     "schedule vs clean (round-9, hermes_tpu.chaos; "
                     "detector attached, --pipeline-depth/-rounds apply); "
                     "writes CHAOS_BENCH.json")
+    ap.add_argument("--fleet", action="store_true",
+                    help="measure the key-sharded fleet instead "
+                    "(round-13, hermes_tpu.fleet): per-group + aggregate "
+                    "+ concurrent committed-writes/s cells and the "
+                    "single-group baseline; writes BENCH_FLEET.json "
+                    "(host backend runs a reduced per-group shape with a "
+                    "tpu_pending note)")
+    ap.add_argument("--fleet-groups", type=int, default=4,
+                    help="fleet group count for --fleet")
     ap.add_argument("--probe-timeout", type=float, default=float(
         os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
     args = ap.parse_args()
@@ -569,6 +620,22 @@ def main() -> None:
                 "unit": "writes/s", "vs_baseline": 0.0, "error": info})
         out.write(rec)
         sys.exit(1)
+
+    if args.fleet:
+        r = run_fleet_bench(groups=args.fleet_groups)
+        with open("BENCH_FLEET.json", "w") as f:
+            json.dump(r, f, indent=1)
+        cell(r)
+        out.write({
+            "metric": "fleet_aggregate_writes_per_sec",
+            "value": r["aggregate_writes_per_sec"],
+            "unit": "writes/s",
+            "groups": r["groups"],
+            "single_group": r["single_group"]["writes_per_sec"],
+            "scaleout_x": r["scaleout_x"],
+            "concurrent": r["concurrent"]["writes_per_sec"],
+        })
+        return
 
     if args.chaos is not None:
         r = run_chaos_soak(args.chaos, rounds=args.pipeline_rounds,

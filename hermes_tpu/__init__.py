@@ -16,13 +16,14 @@ INV/ACK/VAL message batches move between replicas as XLA collectives
 replica (BASELINE.json:5, ``transport=tpu_ici``).
 """
 
-from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
 from hermes_tpu.core import types
 
 __version__ = "0.2.0"
 
-__all__ = ["HermesConfig", "WorkloadConfig", "types", "KVS", "KeyIndex",
-           "RangeRouter", "FastRuntime", "Runtime", "__version__"]
+__all__ = ["HermesConfig", "WorkloadConfig", "FleetConfig", "types", "KVS",
+           "KeyIndex", "RangeRouter", "Fleet", "FleetRouter", "FastRuntime",
+           "Runtime", "__version__"]
 
 
 def __getattr__(name):
@@ -36,6 +37,10 @@ def __getattr__(name):
         from hermes_tpu.keyindex import KeyIndex as obj
     elif name == "RangeRouter":
         from hermes_tpu.keyindex import RangeRouter as obj
+    elif name == "Fleet":
+        from hermes_tpu.fleet import Fleet as obj
+    elif name == "FleetRouter":
+        from hermes_tpu.fleet.router import FleetRouter as obj
     elif name in ("FastRuntime", "Runtime"):
         from hermes_tpu import runtime
 

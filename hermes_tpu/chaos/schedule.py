@@ -358,6 +358,11 @@ class ChaosRunner:
         # the windows still active (overlapping windows on the same src
         # must not end each other early)
         self._partition_until: List[Tuple[int, int, int, int]] = []
+        # schedule cursor (tick() consumes events; run() drives tick —
+        # round-13 fleet runners drive MANY runners' ticks in lockstep,
+        # one per group, each over its own group-scoped target)
+        self._ev_iter = iter(self.schedule)
+        self._nxt = next(self._ev_iter, None)
         self._check_net_faults_routable()
 
     def _transport_name(self) -> str:
@@ -652,26 +657,37 @@ class ChaosRunner:
 
     # -- the drive -----------------------------------------------------------
 
+    def tick(self, step: int) -> None:
+        """Everything one scheduled round does EXCEPT stepping the
+        target: expire lapsed windows, run the lease rule, apply due
+        events.  ``run`` drives this loop for one target; a fleet runner
+        (hermes_tpu.fleet.chaos) ticks one runner per group in lockstep
+        and steps the groups itself."""
+        self._expire_skews(step)
+        self._expire_partitions(step)
+        if self.kvs is not None and self.wire is not None:
+            # wire windows expire by their own step test: refresh the
+            # diagnostics channel so a stuck op is never blamed on a
+            # window that already ended
+            self._update_net_phase(step)
+        self._lease_rule(step)
+        while self._nxt is not None and self._nxt.step <= step:
+            self._apply(step, self._nxt)
+            self._nxt = next(self._ev_iter, None)
+
     def run(self, steps: int, heal: bool = True, drain_steps: int = 4000,
             check: bool = False) -> dict:
         """Run ``steps`` rounds with the schedule applied, then (``heal``)
         thaw/rejoin everything, clear skews and net windows, drain, and
         optionally run the linearizability gate.  Returns the result dict:
         executed event log, loss accounting, drained/verdict flags."""
-        ev = iter(self.schedule)
-        nxt = next(ev, None)
+        # run() always replays the schedule from its first event (the
+        # pre-tick() contract): reset the cursor so a second run() — or a
+        # run() after standalone tick() driving — is never silently empty
+        self._ev_iter = iter(self.schedule)
+        self._nxt = next(self._ev_iter, None)
         for step in range(steps):
-            self._expire_skews(step)
-            self._expire_partitions(step)
-            if self.kvs is not None and self.wire is not None:
-                # wire windows expire by their own step test: refresh the
-                # diagnostics channel so a stuck op is never blamed on a
-                # window that already ended
-                self._update_net_phase(step)
-            self._lease_rule(step)
-            while nxt is not None and nxt.step <= step:
-                self._apply(step, nxt)
-                nxt = next(ev, None)
+            self.tick(step)
             self._step_target()
             if self.on_step is not None:
                 self.on_step(step)

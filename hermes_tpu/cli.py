@@ -129,6 +129,17 @@ def build_parser() -> argparse.ArgumentParser:
                     "[replica] [k=v...]' lines, chaos.Schedule.parse) "
                     "instead of a seeded one; needs --steps and a fast "
                     "backend")
+    ap.add_argument("--fleet-groups", type=int, default=0,
+                    help="run a key-sharded FLEET (round-13, hermes_tpu."
+                    "fleet): N independent groups of --replicas each "
+                    "behind the routed client facade, a seeded mix "
+                    "spanning every group driven through it; --check "
+                    "gates every group's history plus the fleet "
+                    "invariants (verify_fleet); --steps bounds the "
+                    "drive.  Fast batched backend; needs --value-words "
+                    ">= 3 (the client KVS carries write uids)")
+    ap.add_argument("--fleet-ops", type=int, default=512,
+                    help="ops in the fleet quickstart mix (--fleet-groups)")
     ap.add_argument("--drill", default=None,
                     choices=["rolling", "resize", "migrate"],
                     help="run an elastic drill (round-10, hermes_tpu."
@@ -160,6 +171,45 @@ MIXES = {
     "c": dict(read_frac=1.0, rmw_frac=0.0),
     "f": dict(read_frac=0.5, rmw_frac=1.0),
 }
+
+
+def _run_fleet(args, cfg) -> int:
+    """Fleet quickstart (round-13, hermes_tpu/fleet): N key-sharded
+    groups behind the routed facade, a seeded get/put mix spanning every
+    group's range, per-group + fleet counters as one JSON line; --check
+    runs every group's linearizability gate plus verify_fleet."""
+    import json
+
+    from hermes_tpu.config import FleetConfig
+    from hermes_tpu.fleet import Fleet
+
+    fcfg = FleetConfig(groups=args.fleet_groups, base=cfg)
+    fleet = Fleet(fcfg, record="array" if args.check else False)
+    rng = np.random.default_rng(args.seed)
+    n = args.fleet_ops
+    keys = rng.integers(0, fcfg.total_keys, size=n).astype(np.int64)
+    kinds = np.where(rng.random(n) < cfg.workload.read_frac,
+                     Fleet.GET, Fleet.PUT).astype(np.int32)
+    values = rng.integers(0, 1 << 20,
+                          size=(n, cfg.value_words - 2)).astype(np.int32)
+    t0 = time.perf_counter()
+    fb = fleet.submit_batch(kinds, keys, values)
+    drained = fleet.run_batch(fb, max_steps=args.steps or 50_000)
+    wall = time.perf_counter() - t0
+    summary = dict(fleet_groups=args.fleet_groups, ops=n,
+                   done=fb.done_count(), drained=bool(drained),
+                   wall_s=round(wall, 3),
+                   ranges=fleet.router.owned_ranges(),
+                   counters=fleet.counters())
+    ok = drained
+    if args.check:
+        verdicts = fleet.check()
+        summary["checked_ok"] = verdicts["ok"]
+        summary["group_verdicts"] = verdicts["groups"]
+        ok = ok and verdicts["ok"]
+    summary["ok"] = bool(ok)
+    print(json.dumps(summary, default=str))
+    return 0 if ok else 1
 
 
 def _run_drill(args, cfg, mesh) -> int:
@@ -265,6 +315,21 @@ def main(argv=None) -> int:
         if args.drill in ("resize", "migrate") and args.value_words < 3:
             ap.error(f"--drill {args.drill} drives the client KVS: needs "
                      "--value-words >= 3 (words 0-1 carry the write uid)")
+    if args.fleet_groups:
+        if args.fleet_groups < 1:
+            ap.error("--fleet-groups must be >= 1")
+        if args.backend != "fast":
+            ap.error("--fleet-groups drives the fast batched backend "
+                     "through the KVS facade (hermes_tpu.fleet); sharded "
+                     "fleets are launched via hermes_tpu.launch "
+                     "--fleet-groups")
+        if args.value_words < 3:
+            ap.error("--fleet-groups needs --value-words >= 3 (words 0-1 "
+                     "carry the write uid)")
+        if (args.acceptance or args.drill or args.chaos is not None
+                or args.chaos_schedule or args.freeze):
+            ap.error("--fleet-groups is its own drive; drop --acceptance/"
+                     "--drill/--chaos/--freeze")
     chaos_on = args.chaos is not None or args.chaos_schedule
     if chaos_on:
         if args.backend not in ("fast", "fast-sharded"):
@@ -372,6 +437,9 @@ def main(argv=None) -> int:
             print(f"need {cfg.n_replicas} devices, have {len(devs)}", file=sys.stderr)
             return 2
         mesh = Mesh(np.array(devs), ("replica",))
+
+    if args.fleet_groups:
+        return _run_fleet(args, cfg)
 
     if args.drill:
         return _run_drill(args, cfg, mesh)

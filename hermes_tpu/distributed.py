@@ -44,6 +44,17 @@ def parse_wire_faults(spec: str):
     return out
 
 
+def fleet_base_port(base_port: int, fleet_group: int, n_ranks: int) -> int:
+    """Process-to-group placement for TCP fleets (round-13): each
+    key-sharded group is an independent n_ranks-process mesh, so group g
+    binds a disjoint port window — one listener per rank, strided with
+    headroom so co-hosted groups can never collide even if the native
+    mesh claims a few extra ports per rank."""
+    if fleet_group < 0:
+        raise ValueError("fleet_group must be >= 0")
+    return base_port + fleet_group * 4 * n_ranks
+
+
 def run_replica(
     cfg,
     rank: int,
@@ -54,6 +65,7 @@ def run_replica(
     out_path: str | None = None,
     wire_seed: int = 0,
     wire_faults: str | None = None,
+    fleet_group: int = 0,
 ):
     import jax
     import jax.numpy as jnp
@@ -63,6 +75,7 @@ def run_replica(
     from hermes_tpu.transport.tcp import TcpHostTransport
     from hermes_tpu.workload import ycsb
 
+    base_port = fleet_base_port(base_port, fleet_group, n_ranks)
     tcp_t = TcpHostTransport(cfg, rank, n_ranks, hosts=hosts,
                              base_port=base_port)
     transport = tcp_t
@@ -117,6 +130,7 @@ def run_replica(
     ops = [dataclasses.replace(o, replica=rank) for o in ops]
     result = dict(
         rank=rank,
+        fleet_group=fleet_group,
         ops=ops,
         aborted=recorder.aborted_uids,
         table_state=np.asarray(jax.device_get(rs.table.state)),
@@ -170,6 +184,12 @@ def _main():
     ap.add_argument("--read-frac", type=float, default=0.5)
     ap.add_argument("--rmw-frac", type=float, default=0.2)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fleet-group", type=int, default=0,
+                    help="key-sharded fleet group this replica process "
+                    "belongs to (round-13): groups are independent "
+                    "n-ranks meshes on disjoint port windows "
+                    "(fleet_base_port), so co-hosted groups never share "
+                    "a socket")
     ap.add_argument("--wire-seed", type=int, default=0,
                     help="seed for the adversarial wire interposer")
     ap.add_argument("--wire-faults", type=str, default=None,
@@ -199,6 +219,7 @@ def _main():
         out_path=args.out,
         wire_seed=args.wire_seed,
         wire_faults=args.wire_faults,
+        fleet_group=args.fleet_group,
     )
 
 

@@ -109,13 +109,20 @@ def _normalize_range(rt, lo: int, hi: int) -> None:
 
 def migrate_range(src, dst, lo: int, hi: int, router=None,
                   dst_group: int = 1, path: Optional[str] = None,
-                  drain_steps: int = 2000, force: bool = False) -> dict:
+                  drain_steps: int = 2000, force: bool = False,
+                  dest_slots=None) -> dict:
     """Move dense slots ``[lo, hi)`` from the ``src`` KVS group to ``dst``
     (module docstring: fence → drain → snapshot → transfer → flip →
     release).  ``router`` (keyindex.RangeRouter, optional) carries the
     fleet-level routing flip; ``path`` keeps the transfer archive
     (default: a temp file, removed after restore).  ``force`` salvages
     ops that fail to drain within ``drain_steps`` instead of raising.
+    ``dest_slots`` (dense mode only) places the migrated rows on chosen
+    destination slots instead of mirroring the source slot ids — the
+    round-13 fleet composes groups whose slot spaces are BOTH full of
+    their own keys, so the fleet allocates the destination's spare slots
+    and threads them through here (sparse mode allocates through the
+    destination KeyIndex instead and refuses the argument).
     Returns a summary dict (also traced as ``migrate_out``/``migrate_in``
     obs events on the two runtimes)."""
     src_kvs, src_rt = _kvs_of(src)
@@ -129,6 +136,23 @@ def migrate_range(src, dst, lo: int, hi: int, router=None,
     if not (0 <= lo < hi <= src_rt.cfg.n_keys):
         raise ValueError(f"range [{lo}, {hi}) outside "
                          f"[0, {src_rt.cfg.n_keys})")
+    if dest_slots is not None:
+        if src_kvs.index is not None:
+            raise ValueError(
+                "dest_slots is a dense-mode placement; sparse mode "
+                "allocates destination slots through the KeyIndex")
+        dest_slots = np.asarray(dest_slots, np.int64)
+        if dest_slots.shape != (hi - lo,):
+            raise ValueError(
+                f"dest_slots must place every slot of [{lo}, {hi}) "
+                f"(want shape ({hi - lo},), got {dest_slots.shape})")
+        if np.unique(dest_slots).size != dest_slots.size:
+            raise ValueError("dest_slots must be distinct")
+        if dest_slots.size and not (
+                (dest_slots >= 0) & (dest_slots < dst_rt.cfg.n_keys)).all():
+            raise ValueError(
+                f"dest_slots outside the destination's slot space "
+                f"[0, {dst_rt.cfg.n_keys})")
 
     # -- validate the DESTINATION before any destructive step: a migration
     # that can be refused must be refused BEFORE the fence rejects client
@@ -142,11 +166,17 @@ def migrate_range(src, dst, lo: int, hi: int, router=None,
     fresh_err = ("destination slots are not fresh (committed writes "
                  "present); a key must live in exactly one group")
     if src_kvs.index is None:
-        if hi > dst_rt.cfg.n_keys:
+        if dest_slots is None and hi > dst_rt.cfg.n_keys:
             raise ValueError(
-                f"dense migration needs destination n_keys >= {hi}")
-        dst_vpts = np.asarray(jax.device_get(jax.lax.dynamic_slice_in_dim(
-            dst_rt.fs.table.vpts, dbase + lo, hi - lo)))
+                f"dense migration needs destination n_keys >= {hi} "
+                "(or caller-chosen dest_slots)")
+        if dest_slots is None:
+            dst_vpts = np.asarray(jax.device_get(
+                jax.lax.dynamic_slice_in_dim(
+                    dst_rt.fs.table.vpts, dbase + lo, hi - lo)))
+        else:
+            dst_vpts = np.asarray(jax.device_get(
+                dst_rt.fs.table.vpts))[dbase + dest_slots]
         if (dst_vpts != 0).any():
             raise ValueError(fresh_err)
     else:
@@ -224,8 +254,10 @@ def migrate_range(src, dst, lo: int, hi: int, router=None,
             # pre_keys is the validation pass's key list for these exact
             # slots — nothing stepped either group since
             dest_slots = dst_kvs.index.get_slots(pre_keys).astype(np.int64)
-        else:
+        elif dest_slots is None:
             dest_slots = slots
+        # else: caller-placed dense slots (validated up front; slot i of
+        # the archive — source slot lo + i — lands on dest_slots[i])
         rows32 = rows32.copy()
         mig_hi = -(2 + dst_rt.step_idx)  # migration uid namespace: hi <= -2
         rows32[:, fst.BANK_VAL] = dest_slots.astype(np.int32)

@@ -1,0 +1,39 @@
+"""hermes_tpu.fleet — pod-scale key-sharded protocol groups (round-13;
+ROADMAP item 2, the "millions of users" axis).
+
+Hermes coordinates writes per key (PAPER.md), so aggregate throughput
+scales by running many independent key-sharded replica groups side by
+side.  This package composes G complete single-group stacks — each a
+``kvs.KVS`` over a ``FastRuntime`` with its own membership service,
+chaos scope, and snapshot scope — behind:
+
+  * ``FleetRouter`` (fleet/router.py) — fleet key -> (owning group,
+    local dense slot), boundary-exact through ``keyindex.RangeRouter``,
+    with the migration drain/flip state machine in fleet coordinates;
+  * ``Fleet`` (fleet/core.py) — the routed client facade: sessions and
+    batches routed by key, per-group checker + the fleet-level
+    ``verify_fleet`` harness (routing injectivity, migration-uid
+    namespace disjointness, group-scoped membership), cross-group
+    ``migrate`` through the fleet router flip, per-group snapshot scope;
+  * ``FleetChaosRunner`` / ``fleet_schedules`` (fleet/chaos.py) —
+    group-scoped fault programs driven in lockstep, deterministic
+    replay fleet-wide;
+  * ``run_fleet_cells`` (fleet/bench.py) — per-group + aggregate +
+    concurrent committed-writes/s cells (BENCH_FLEET.json; the eighth CI
+    gate scripts/check_fleet.py asserts the 4-group scale-out floor).
+
+Configuration is ``config.FleetConfig`` (groups, ranges, per-group
+overrides); device layout for sharded groups is
+``launch.fleet_meshes`` — the (groups, replicas) grid, one disjoint
+submesh per group.
+"""
+
+from hermes_tpu.config import FleetConfig
+from hermes_tpu.fleet.chaos import FleetChaosRunner, fleet_schedules, parse_fleet
+from hermes_tpu.fleet.core import Fleet, FleetBatch, verify_fleet
+from hermes_tpu.fleet.router import FleetRouter
+
+__all__ = [
+    "Fleet", "FleetBatch", "FleetChaosRunner", "FleetConfig", "FleetRouter",
+    "fleet_schedules", "parse_fleet", "verify_fleet",
+]

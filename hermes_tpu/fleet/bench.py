@@ -1,0 +1,151 @@
+"""Fleet bench cells (round-13): per-group + aggregate committed writes/s.
+
+Measurement model — stated up front because the host backend cannot fake
+a pod: fleet groups are INDEPENDENT XLA programs with no shared state, so
+on the target hardware (one chip-group per Hermes group on the
+(groups, replicas) grid) they overlap perfectly and the fleet aggregate
+is the sum of per-group rates.  On a shared host the groups timeshare
+the cores instead.  The cells therefore report BOTH numbers honestly:
+
+  * ``per_group`` — each group measured ALONE on the machine (the rate a
+    group sustains on dedicated hardware; this is what the on-chip rerun
+    measures per chip-group) and ``aggregate_writes_per_sec`` = their
+    sum — the fleet's scale-out capacity;
+  * ``concurrent`` — every group's scan chunks dispatched together, one
+    wall for all of them: the host-contention floor (bounded by
+    ``host_cores``; on a pod this equals the aggregate because nothing
+    is shared).
+
+Groups are placed round-robin over the visible devices
+(``jax.default_device``), so under the canonical gate env
+(``--xla_force_host_platform_device_count=8``) the concurrent cell
+genuinely overlaps group programs on separate host devices.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _fleet_cfg(fcfg, g: int):
+    cfg = fcfg.group_cfg(g)
+    if not cfg.device_stream:
+        raise ValueError(
+            "fleet bench cells drive the raw scan round: the group config "
+            "needs device_stream=True (counter-hash op streams)")
+    return cfg
+
+
+def _chunks(cfg, rounds: int, dev):
+    """(state, stream, chunk_fn, ctl_fn) for one group pinned to one
+    device.  The chunk fn is shared across groups of identical shape, so
+    XLA compiles once per device, not once per group."""
+    import jax
+
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.workload import ycsb
+
+    with jax.default_device(dev):
+        fs = jax.device_put(fst.init_fast_state(cfg), dev)
+        stream = jax.device_put(fst.prep_stream(ycsb.stub_stream(cfg)), dev)
+        chunk = fst.build_fast_scan(cfg, rounds, donate=True)
+    return fs, stream, chunk
+
+
+def _commits(fs) -> int:
+    import jax
+
+    m = jax.device_get(fs.meta)
+    return int(m.n_write.sum() + m.n_rmw.sum())
+
+
+def run_fleet_cells(fcfg, rounds: int = 20, chunks: int = 2,
+                    warmup_chunks: int = 1,
+                    devices: Optional[list] = None) -> dict:
+    """Measure the fleet (module docstring): per-group cells alone, a
+    single-group baseline (group 0's config), and the concurrent cell.
+    Returns the BENCH_FLEET.json payload."""
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    G = fcfg.groups
+    states = []
+    for g in range(G):
+        cfg = _fleet_cfg(fcfg, g)
+        dev = devs[g % len(devs)]
+        fs, stream, chunk = _chunks(cfg, rounds, dev)
+        states.append(dict(g=g, cfg=cfg, dev=dev, fs=fs, stream=stream,
+                           chunk=chunk))
+
+    def dispatch(st, c):
+        from hermes_tpu.core import faststep as fst
+
+        with jax.default_device(st["dev"]):
+            st["fs"] = st["chunk"](st["fs"], st["stream"],
+                                   fst.make_fast_ctl(st["cfg"], c * rounds))
+
+    # warm every group (compile + first chunk) and switch the link to
+    # synchronous mode via a counter readback
+    for st in states:
+        for c in range(warmup_chunks):
+            dispatch(st, c)
+    jax.block_until_ready([st["fs"] for st in states])
+    base = [_commits(st["fs"]) for st in states]
+
+    # -- per-group cells: each group measured ALONE -------------------------
+    per_group = []
+    for st in states:
+        t0 = time.perf_counter()
+        for c in range(warmup_chunks, warmup_chunks + chunks):
+            dispatch(st, c)
+        jax.block_until_ready(st["fs"])
+        wall = time.perf_counter() - t0
+        commits = _commits(st["fs"]) - base[st["g"]]
+        per_group.append(dict(
+            group=st["g"], writes_per_sec=round(commits / wall, 1),
+            commits=commits, rounds=chunks * rounds,
+            wall_s=round(wall, 4), device=str(st["dev"])))
+    aggregate = round(sum(c["writes_per_sec"] for c in per_group), 1)
+
+    # -- concurrent cell: all groups' chunks in flight together -------------
+    base = [_commits(st["fs"]) for st in states]
+    t0 = time.perf_counter()
+    for c in range(warmup_chunks + chunks, warmup_chunks + 2 * chunks):
+        for st in states:
+            dispatch(st, c)
+    jax.block_until_ready([st["fs"] for st in states])
+    conc_wall = time.perf_counter() - t0
+    conc_commits = sum(_commits(st["fs"]) - b for st, b in zip(states, base))
+
+    # -- single-group baseline (the scale-out denominator): group 0's own
+    # cell IS a single group measured alone at the same shape (vary_seed
+    # adds +0 to group 0's seed), so re-measuring it would only pay a
+    # duplicate build + warmup + timed window
+    cfg0 = _fleet_cfg(fcfg, 0)
+    single = {k: per_group[0][k]
+              for k in ("writes_per_sec", "commits", "rounds", "wall_s")}
+
+    return dict(
+        groups=G,
+        per_group=per_group,
+        aggregate_writes_per_sec=aggregate,
+        single_group=single,
+        scaleout_x=round(aggregate / max(1e-9, single["writes_per_sec"]), 2),
+        concurrent=dict(
+            writes_per_sec=round(conc_commits / conc_wall, 1),
+            commits=conc_commits, wall_s=round(conc_wall, 4),
+            note="all groups' chunks in flight on this host at once — "
+                 "bounded by host_cores; equals the aggregate on "
+                 "dedicated per-group hardware"),
+        host_cores=os.cpu_count(),
+        devices=len(devs),
+        shape=dict(
+            n_replicas=cfg0.n_replicas, n_keys=cfg0.n_keys,
+            n_sessions=cfg0.n_sessions, value_words=cfg0.value_words,
+            rounds_per_dispatch=rounds),
+        platform=devs[0].platform,
+    )

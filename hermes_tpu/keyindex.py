@@ -231,7 +231,33 @@ class RangeRouter:
         s = np.asarray(slot)
         return (self._owner[s] == group) & ~self._drain[s]
 
+    def owned_ranges(self):
+        """The routing table as maximal contiguous ``(lo, hi, group)``
+        runs — the human/report form of the dense per-slot array (fleet
+        summaries, boundary tests).  Exact by construction: derived from
+        the same array lookups consult."""
+        out = []
+        if self.n_keys == 0:
+            return out
+        edges = np.flatnonzero(np.diff(self._owner)) + 1
+        lo = 0
+        for hi in list(edges) + [self.n_keys]:
+            out.append((int(lo), int(hi), int(self._owner[lo])))
+            lo = hi
+        return out
+
     # -- migration state machine --------------------------------------------
+
+    def assign(self, lo: int, hi: int, group: int) -> None:
+        """Initial ownership assignment (fleet construction): like
+        ``flip`` but refuses to touch a draining slot — assignment is a
+        build-time act, never a way around an in-flight migration."""
+        self._check_range(lo, hi)
+        if self._drain[lo:hi].any():
+            raise RuntimeError(
+                f"assign [{lo}, {hi}) overlaps a draining range; finish "
+                "or release the migration first")
+        self._owner[lo:hi] = group
 
     def begin_drain(self, lo: int, hi: int) -> None:
         self._check_range(lo, hi)

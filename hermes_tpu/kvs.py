@@ -720,6 +720,11 @@ class KVS:
                     age_rounds=int(age[r, s]),
                     at_step=self.rt.step_idx,
                 )
+                if self.rt.group is not None:
+                    # fleet deployments (round-13): the diagnostic names
+                    # its group, so a fleet-wide soak triages stuck ops
+                    # without cross-referencing which KVS raised
+                    diag["group"] = self.rt.group
                 if self.drill_phase is not None:
                     # an elastic drill (fence/drain/flip) is active: a
                     # wedged op must be attributable to it from the

@@ -54,6 +54,53 @@ def replica_mesh(n_replicas: Optional[int] = None):
     return Mesh(np.array(devs[:n]), ("replica",))
 
 
+def fleet_meshes(n_groups: int, n_replicas: Optional[int] = None):
+    """The (groups, replicas) fleet grid (round-13, hermes_tpu/fleet):
+    the global device list reshaped into ``n_groups`` rows of
+    ``n_replicas`` devices, ONE disjoint ``Mesh(('replica',))`` per row.
+    Groups are independent protocol instances, so each gets its own mesh
+    over its own chips — the mesh-at-call-site pattern, with group
+    isolation enforced by device DISJOINTNESS rather than by a shared
+    2-D mesh's axis discipline.
+
+    Process-to-group placement falls out of the row-major reshape: with
+    one host per slice and devices enumerated host-major
+    (jax.distributed), a host's addressable devices land in contiguous
+    rows — ``group_of_process`` names the group(s) a process serves."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_replicas is None:
+        if len(devs) % n_groups:
+            raise RuntimeError(
+                f"{len(devs)} devices do not split into {n_groups} equal "
+                "groups; pass n_replicas explicitly")
+        n_replicas = len(devs) // n_groups
+    need = n_groups * n_replicas
+    if len(devs) < need:
+        raise RuntimeError(
+            f"need {need} devices for a {n_groups}x{n_replicas} fleet "
+            f"grid, have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(n_groups, n_replicas)
+    return [Mesh(grid[g], ("replica",)) for g in range(n_groups)]
+
+
+def group_of_process(n_groups: int, n_replicas: Optional[int] = None) -> list:
+    """The fleet groups THIS process holds devices of (multi-host
+    process-to-group placement): the rows of the fleet grid containing
+    at least one locally-addressable device."""
+    import jax
+
+    devs = jax.devices()
+    if n_replicas is None:
+        n_replicas = len(devs) // n_groups
+    local = {d.id for d in jax.local_devices()}
+    return sorted({g for g in range(n_groups)
+                   for d in devs[g * n_replicas:(g + 1) * n_replicas]
+                   if d.id in local})
+
+
 def run(cfg, steps: int, coordinator=None, num_hosts=1, host_id=0):
     """Boot (multi-host if asked), build the mesh, run the sharded fast
     round for ``steps`` rounds; returns the runtime for inspection."""
@@ -66,6 +113,28 @@ def run(cfg, steps: int, coordinator=None, num_hosts=1, host_id=0):
     return rt
 
 
+def run_fleet(fcfg, steps: int, coordinator=None, num_hosts=1, host_id=0):
+    """Boot (multi-host if asked) and run a sharded FLEET: G independent
+    group runtimes on the (groups, replicas) grid, one disjoint submesh
+    each (fleet_meshes), stepped in lockstep — dispatches are
+    independent XLA programs, so group rounds overlap on the grid.
+    Returns the per-group runtimes (group g = rts[g])."""
+    init_distributed(coordinator, num_hosts, host_id)
+    from hermes_tpu.runtime import FastRuntime
+
+    meshes = fleet_meshes(fcfg.groups, fcfg.base.n_replicas)
+    rts = []
+    for g in range(fcfg.groups):
+        rt = FastRuntime(fcfg.group_cfg(g), backend="sharded",
+                         mesh=meshes[g])
+        rt.group = g
+        rts.append(rt)
+    for _ in range(steps):
+        for rt in rts:
+            rt.step_once()
+    return rts
+
+
 def _main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--coordinator", type=str, default=None,
@@ -74,6 +143,11 @@ def _main():
     ap.add_argument("--host-id", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=None,
                     help="default: one per global device")
+    ap.add_argument("--fleet-groups", type=int, default=1,
+                    help="run a key-sharded FLEET (round-13, hermes_tpu/"
+                    "fleet): G groups of --replicas each on the "
+                    "(groups, replicas) device grid, one disjoint submesh "
+                    "per group; prints one counters dict per group")
     ap.add_argument("--keys", type=int, default=1 << 16)
     ap.add_argument("--sessions", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
@@ -82,7 +156,22 @@ def _main():
     init_distributed(args.coordinator, args.num_hosts, args.host_id)
     import jax
 
-    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.config import FleetConfig, HermesConfig
+
+    if args.fleet_groups > 1:
+        n = args.replicas or len(jax.devices()) // args.fleet_groups
+        fcfg = FleetConfig(
+            groups=args.fleet_groups,
+            base=HermesConfig(n_replicas=n, n_keys=args.keys,
+                              n_sessions=args.sessions,
+                              ops_per_session=256, wrap_stream=True))
+        rts = run_fleet(fcfg, args.steps)
+        for g, rt in enumerate(rts):
+            counters = rt.counters()  # collective — every process joins
+            if jax.process_index() == 0:
+                print({"group": g, **{k: int(v) for k, v in counters.items()
+                                      if np.ndim(v) == 0}})
+        return
 
     n = args.replicas or len(jax.devices())
     cfg = HermesConfig(n_replicas=n, n_keys=args.keys, n_sessions=args.sessions,

@@ -60,6 +60,11 @@ class MembershipEvent:
     kind: str
     replica: int
     live_mask: int
+    # fleet group this event belongs to (round-13, hermes_tpu/fleet):
+    # -1 = single-group deployment.  Membership state is GROUP-SCOPED —
+    # one service instance per group, over that group's replicas only —
+    # and the label keeps a merged fleet membership log attributable.
+    group: int = -1
 
 
 class MembershipService:
@@ -68,12 +73,16 @@ class MembershipService:
     ``Runtime.attach_membership`` or call ``poll`` manually between steps."""
 
     def __init__(self, cfg: HermesConfig, poll_interval: int = 1,
-                 confirm_steps: int = 0):
+                 confirm_steps: int = 0, group: int = -1):
         if confirm_steps < 0:
             raise ValueError("confirm_steps must be >= 0")
         self.cfg = cfg
         self.poll_interval = poll_interval
         self.confirm_steps = confirm_steps
+        # fleet group this service watches (round-13): a label only —
+        # the service itself is group-scoped by construction (it polls
+        # ONE runtime's heartbeat ages and drives ONE live mask)
+        self.group = group
         self.events: List[MembershipEvent] = []
         # replica -> step the current suspicion began (cleared on recovery)
         self.suspects: Dict[int, int] = {}
@@ -214,7 +223,8 @@ class MembershipService:
                 del self.suspects[r]
                 rt.remove(r)
                 live = int(rt.live[0])
-                evt = MembershipEvent(rt.step_idx, "remove", r, live)
+                evt = MembershipEvent(rt.step_idx, "remove", r, live,
+                                      group=self.group)
                 self.events.append(evt)
         return evt
 
@@ -222,7 +232,8 @@ class MembershipService:
         self.suspects.pop(replica, None)
         self._joined_at[replica] = rt.step_idx
         self.events.append(
-            MembershipEvent(rt.step_idx, "join", replica, int(rt.live[0]))
+            MembershipEvent(rt.step_idx, "join", replica, int(rt.live[0]),
+                            group=self.group)
         )
 
     def note_shrink(self, rt, replica: int) -> None:
@@ -233,5 +244,6 @@ class MembershipService:
         self.suspects.pop(replica, None)
         self._joined_at.pop(replica, None)
         self.events.append(
-            MembershipEvent(rt.step_idx, "shrink", replica, int(rt.live[0]))
+            MembershipEvent(rt.step_idx, "shrink", replica, int(rt.live[0]),
+                            group=self.group)
         )

@@ -65,6 +65,41 @@ def interval_throughput(records: List[dict]) -> List[dict]:
     return out
 
 
+def fleet_totals(records: List[dict]) -> Optional[dict]:
+    """Per-group + fleet-wide aggregation over group-labeled records
+    (round-13, hermes_tpu/fleet): the fleet facade emits interval/summary
+    records and trace events carrying ``group``; this folds each group's
+    LAST cumulative counters plus its event census into one table, with
+    the fleet aggregate as the counter sums.  Returns None when no record
+    carries a group label (single-group runs keep their old report)."""
+    last: dict = {}   # group -> last group-labeled metrics/summary record
+    events: dict = {}  # group -> event-name census
+    for r in records:
+        g = r.get("group")
+        if g is None or g == "fleet":
+            continue
+        if r.get("kind") in ("metrics", "summary"):
+            last[g] = r
+        elif r.get("kind") == "event":
+            events.setdefault(g, {})
+            name = r.get("name", "?")
+            events[g][name] = events[g].get(name, 0) + 1
+    if not last and not events:
+        return None
+    counter_keys = ("n_read", "n_write", "n_rmw", "n_abort", "commits")
+    groups = {}
+    agg: dict = {}
+    for g in sorted(set(last) | set(events)):
+        row = {k: last[g][k] for k in counter_keys
+               if g in last and k in last[g]}
+        row["events"] = events.get(g, {})
+        groups[g] = row
+        for k, v in row.items():
+            if k != "events":
+                agg[k] = agg.get(k, 0) + v
+    return dict(groups=groups, fleet=agg)
+
+
 def _fmt_fields(r: dict, skip=("t", "kind", "name", "_src")) -> str:
     return " ".join(f"{k}={v}" for k, v in r.items()
                     if k not in skip and not isinstance(v, list))
@@ -162,6 +197,22 @@ def render_report(records: List[dict], max_timeline: Optional[int] = None
                f" of its time)" if tot > 0 else "")
             + (f" ring depth={last_reg['pipeline_depth']}"
                if "pipeline_depth" in last_reg else ""))
+
+    # round-13 fleet aggregation: when records carry group labels, render
+    # the per-group counter table and the fleet-wide sums
+    ft = fleet_totals(records)
+    if ft is not None:
+        lines.append("")
+        lines.append(f"-- fleet (per-group / aggregate, "
+                     f"{len(ft['groups'])} group(s)) --")
+        for g, row in ft["groups"].items():
+            ev = " ".join(f"{k}={v}" for k, v in sorted(row["events"].items()))
+            cts = " ".join(f"{k}={v}" for k, v in row.items()
+                           if k != "events")
+            lines.append(f"  group {g}: {cts}"
+                         + (f"  [{ev}]" if ev else ""))
+        lines.append("  fleet:   " + " ".join(
+            f"{k}={v}" for k, v in ft["fleet"].items()))
 
     last_hists = None
     for r in records:

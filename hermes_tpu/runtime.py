@@ -44,6 +44,11 @@ class _ObsHooks:
     Everything is a no-op while no obs context is attached."""
 
     obs = None
+    # fleet group label (round-13, hermes_tpu/fleet): set by the fleet
+    # facade at construction; when set, every trace event this runtime
+    # emits carries it, so one shared obs sink stays attributable
+    # per group (scripts/obs_report.py aggregates fleet-wide)
+    group = None
 
     def attach_obs(self, obs):
         self.obs = obs
@@ -51,6 +56,8 @@ class _ObsHooks:
 
     def _trace(self, name: str, **fields) -> None:
         if self.obs is not None:
+            if self.group is not None and "group" not in fields:
+                fields["group"] = self.group
             self.obs.tracer.event(name, step=self.step_idx, **fields)
 
     def healthy_replicas(self) -> list:

@@ -1,0 +1,256 @@
+"""Round-13 fleet gate (CI, the EIGHTH gate): pod-scale key-sharded
+protocol groups must hold their contracts on every change.
+
+Four assertions, CPU-smoke sized (joins the census / obs-overhead /
+analysis / pipeline / chaos / elastic / netchaos gates in
+scripts/run_gates.py — the EIGHT gates run SERIALLY, never beside
+pytest: the obs-overhead gate is contention-sensitive):
+
+  1. fleet soak — a 4-group fleet at pipeline depth 2 serves a standing
+     client mix spanning every group's range on BOTH engines (batched:
+     groups round-robin over the host devices; sharded: 4 groups x 2
+     replicas on DISJOINT submeshes of the 8-device grid —
+     launch.fleet_meshes), every op resolves exactly once (totals
+     conservation), the linearizability checker is green in EVERY group,
+     and verify_fleet proves the cross-group invariants (routing
+     injectivity, migration-uid namespaces, group-scoped membership);
+  2. one-group rolling drill — group 0 is rolling-crash-restarted under
+     fleet-wide load while groups 1-3 must stay untouched (never frozen,
+     never removed) AND keep committing in every sampled window; the
+     per-group dip is recorded;
+  3. deterministic replay — the same seed + FleetConfig replays a
+     fleet-wide seeded chaos schedule to byte-identical per-group
+     executed logs and final state trees;
+  4. scale-out floor — a 4-group fleet's aggregate committed-writes/s
+     (sum of per-group cells, each measured alone — the dedicated-
+     hardware capacity the on-chip rerun measures) sustains >= 3x the
+     single-group cell at the same per-group shape; the honest
+     concurrent-dispatch cell is recorded alongside (this host has ~2
+     cores; on the (groups, replicas) pod grid concurrent == aggregate).
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_fleet.py
+
+Prints one JSON line (also written to FLEET_SOAK.json); exit non-zero on
+any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+SEED = 13
+GROUPS = 4
+
+
+def _fcfg(n_replicas=4, **over):
+    from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
+
+    kw = dict(
+        n_replicas=n_replicas, n_keys=64, n_sessions=4, replay_slots=6,
+        ops_per_session=96, value_words=6, replay_age=6,
+        replay_scan_every=4, rebroadcast_every=2, lease_steps=6,
+        pipeline_depth=2,
+        workload=WorkloadConfig(read_frac=0.4, rmw_frac=0.2, seed=SEED),
+    )
+    kw.update(over)
+    return FleetConfig(groups=GROUPS, base=HermesConfig(**kw))
+
+
+def _mix(fcfg, n, seed=SEED):
+    import numpy as np
+
+    from hermes_tpu.fleet import Fleet
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, fcfg.total_keys, size=n).astype(np.int64)
+    kinds = np.where(rng.random(n) < 0.4, Fleet.GET, Fleet.PUT).astype(
+        np.int32)
+    values = rng.integers(0, 1 << 20, size=(
+        n, fcfg.base.value_words - 2)).astype(np.int32)
+    return kinds, keys, values
+
+
+def check_soak(report: dict) -> None:
+    import numpy as np
+
+    from hermes_tpu import launch
+    from hermes_tpu.fleet import Fleet, verify_fleet
+
+    for backend in ("batched", "sharded"):
+        if backend == "batched":
+            fcfg = _fcfg()
+            fleet = Fleet(fcfg, record=True, detect=3)
+        else:
+            fcfg = _fcfg(n_replicas=2)
+            fleet = Fleet(fcfg, backend="sharded",
+                          meshes=launch.fleet_meshes(GROUPS, 2),
+                          record=True, detect=3)
+        n = 400
+        kinds, keys, values = _mix(fcfg, n)
+        fb = fleet.submit_batch(kinds, keys, values)
+        spanned = sorted({int(g) for g in fb.group if g >= 0})
+        assert spanned == list(range(GROUPS)), (
+            f"{backend}: mix spanned only groups {spanned}")
+        assert fleet.run_batch(fb), f"{backend}: fleet mix stranded " \
+            f"{n - fb.done_count()} op(s)"
+        assert fb.done_count() == n  # totals conservation
+        from hermes_tpu.kvs import C_LOST, C_REJECTED
+
+        codes = np.asarray(fb.code)
+        assert not ((codes == C_LOST) | (codes == C_REJECTED)).any(), (
+            f"{backend}: clean soak lost/rejected ops")
+        v = fleet.check()
+        assert v["ok"], f"{backend}: checker FAIL {v}"
+        ev = verify_fleet(fleet)
+        report[f"{backend}_soak"] = dict(
+            ops=n, groups=GROUPS, checked_ok=True,
+            group_verdicts=v["groups"], fleet_invariants=ev)
+
+
+def check_group_drill(report: dict) -> None:
+    import numpy as np
+
+    from hermes_tpu import chaos
+    from hermes_tpu.fleet import Fleet, FleetChaosRunner
+
+    fcfg = _fcfg()
+    fleet = Fleet(fcfg, record=True, detect=3)
+    cfg0 = fcfg.group_cfg(0)
+    start, spacing = 4, 10
+    sched0 = chaos.Schedule.rolling_restart(cfg0, start=start,
+                                            spacing=spacing)
+    steps = start + spacing * cfg0.n_replicas + spacing
+    n_ops = steps * GROUPS * cfg0.n_replicas * cfg0.n_sessions
+    kinds, keys, values = _mix(fcfg, n_ops)
+    fb = fleet.submit_batch(kinds, keys, values)
+    runner = FleetChaosRunner(
+        fleet, [sched0] + [chaos.Schedule([])] * (GROUPS - 1),
+        spec=chaos.ChaosSpec(min_healthy=2))
+
+    window = spacing
+    others_fenced = []
+    samples = []  # per window: per-group cumulative commits
+
+    def commits():
+        return [int(c["n_write"] + c["n_rmw"])
+                for c in fleet.counters()["groups"]]
+
+    def on_step(step):
+        others_fenced.append(any(
+            fleet.groups[g].rt.frozen.any()
+            or int(fleet.groups[g].rt.live[0])
+            != fleet.groups[g].cfg.full_mask
+            for g in range(1, GROUPS)))
+        if (step + 1) % window == 0:
+            samples.append(commits())
+
+    runner.on_step = on_step
+    res = runner.run(steps, heal=True, check=True)
+    fleet.run_batch(fb)
+
+    assert not any(others_fenced), (
+        "the group-0 drill fenced a replica in another group")
+    restarts = sum(1 for e in runner.runners[0].log
+                   if e["kind"] == "crash_restart")
+    assert restarts == cfg0.n_replicas, (
+        f"only {restarts}/{cfg0.n_replicas} group-0 restarts applied")
+    assert res["checked_ok"], res.get("group_verdicts")
+    deltas = np.diff(np.asarray(samples), axis=0)  # (windows, groups)
+    assert (deltas[:, 1:] > 0).all(), (
+        "a non-drilled group stopped committing during the drill: "
+        f"{deltas.tolist()}")
+    per_group_dip = []
+    for g in range(GROUPS):
+        best = int(deltas[:, g].max())
+        worst = int(deltas[:, g].min())
+        per_group_dip.append(dict(
+            group=g, worst_window_commits=worst, best_window_commits=best,
+            dip_pct=round(100.0 * (1 - worst / max(1, best)), 1)))
+    report["group0_rolling_drill"] = dict(
+        restarts=restarts, steps=steps, checked_ok=True,
+        lost_ops=res["lost_ops"], per_group_dip=per_group_dip,
+        others_never_fenced=True)
+
+
+def check_replay(report: dict) -> None:
+    import jax
+    import numpy as np
+
+    from hermes_tpu import chaos
+    from hermes_tpu.fleet import Fleet, FleetChaosRunner, fleet_schedules
+
+    fcfg = _fcfg()
+    outs = []
+    for _ in range(2):
+        fleet = Fleet(fcfg, record=True, detect=2)
+        kinds, keys, values = _mix(fcfg, 120, seed=SEED + 1)
+        fb = fleet.submit_batch(kinds, keys, values)
+        runner = FleetChaosRunner(
+            fleet, fleet_schedules(fcfg, seed=SEED, steps=20),
+            spec=chaos.ChaosSpec(min_healthy=2))
+        res = runner.run(20, check=True)
+        assert res["checked_ok"], res
+        fleet.run_batch(fb)
+        states = [jax.tree.leaves(jax.device_get(g.rt.fs))
+                  for g in fleet.groups]
+        outs.append((runner.log_json(), states))
+    assert outs[0][0] == outs[1][0], "fleet executed logs differ"
+    for ga, gb in zip(outs[0][1], outs[1][1]):
+        for a, b in zip(ga, gb):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    report["deterministic_replay"] = True
+
+
+def check_scaleout(report: dict) -> None:
+    from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
+    from hermes_tpu.fleet.bench import run_fleet_cells
+
+    base = HermesConfig(
+        n_replicas=8, n_keys=1 << 14, n_sessions=1024, replay_slots=64,
+        ops_per_session=256, value_words=8, wrap_stream=True,
+        device_stream=True, arb_mode="sort", chain_writes=128,
+        lane_budget_cfg=768, read_unroll=2, rebroadcast_every=4,
+        replay_scan_every=32, workload=WorkloadConfig(read_frac=0.5))
+    cells = run_fleet_cells(FleetConfig(groups=GROUPS, base=base),
+                            rounds=10, chunks=3)
+    assert cells["scaleout_x"] >= 3.0, (
+        f"4-group aggregate is only {cells['scaleout_x']}x the "
+        f"single-group cell "
+        f"({cells['aggregate_writes_per_sec']} vs "
+        f"{cells['single_group']['writes_per_sec']} writes/s)")
+    report["scaleout"] = cells
+
+
+def main() -> int:
+    report: dict = {"gate": "fleet"}
+    try:
+        check_soak(report)
+        check_group_drill(report)
+        check_replay(report)
+        check_scaleout(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report, default=str))
+        return 1
+    report["ok"] = True
+    out = os.path.join(os.path.dirname(__file__), "..", "FLEET_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    print(json.dumps(report, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
 """One serial runner for every CI gate (round-11 satellite).
 
-The seven gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
-netchaos — MUST run serially and never beside a pytest run: the
+The eight gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
+netchaos, fleet — MUST run serially and never beside a pytest run: the
 obs-overhead gate measures per-round wall time against an ablation
 baseline and is contention-sensitive (a parallel pytest's CPU load turns a
 behavior-identical change into a spurious overhead failure).  That rule
@@ -39,6 +39,7 @@ GATES = (
     ("chaos", "check_chaos.py"),
     ("elastic", "check_elastic.py"),
     ("netchaos", "check_netchaos.py"),
+    ("fleet", "check_fleet.py"),
 )
 
 

@@ -98,6 +98,16 @@ SLOW_TESTS = {
     "test_scan_matches_step_loop",
     "test_sim_backend_lockstep_equivalence",
     "test_rmw_retry_bounded_then_aborts",
+    # round-13 fleet: each keeps a quick sibling — routing/batch edges and
+    # the group-0-isolation red test stay quick on the shared fixture;
+    # migration keeps its refusal + dest_slots-validation branches quick,
+    # membership scoping keeps the chaos-isolation sibling
+    "test_fleet_chaos_deterministic_replay",
+    "test_fleet_snapshot_scope_roundtrip",
+    "test_fleet_routed_sessions_roundtrip",
+    "test_fleet_sharded_groups_on_submeshes",
+    "test_fleet_migration_smoke",
+    "test_membership_and_healthy_set_group_scoped",
 }
 
 

@@ -121,6 +121,19 @@ def test_harvested_ages_ride_the_ring_per_round():
     assert rt.drain(1500) and rt.check().ok
 
 
+def test_runner_second_run_replays_schedule():
+    """run() replays the schedule from its first event every call (the
+    round-13 tick() refactor moved the cursor onto the instance — a
+    second run() must not silently apply nothing)."""
+    cfg = _cfg(n_replicas=4, pipeline_depth=1)
+    rt = FastRuntime(cfg)
+    sched = chaos.Schedule.parse("@2 freeze 1\n@6 thaw 1\n")
+    runner = chaos.ChaosRunner(rt, sched)
+    runner.run(10, heal=True)
+    runner.run(10, heal=True)
+    assert [e["kind"] for e in runner.log].count("freeze") >= 2
+
+
 def test_runner_remove_floor_and_heal_without_donor():
     """An all-remove declarative schedule must degrade at the healthy
     floor (skipped events in the log), never crash the runner or empty

@@ -1,0 +1,458 @@
+"""Round-13 fleet: key-sharded protocol groups, routed sessions, fleet
+gating (hermes_tpu/fleet).
+
+Covers the fleet routing edges (boundary-exact ownership at range lo and
+hi-1, batches spanning >= 3 groups with completion-order and totals
+conservation, rejected ops on a draining fleet range, deterministic
+replay of a fleet-wide seeded chaos schedule), the cross-group migration
+smoke (through the fleet router flip, dest-group ``_ver_base`` re-anchor
+asserted), and the per-group isolation contracts (a fault schedule
+targeting group 0 never fences a group 1 replica; ``healthy_replicas()``
+and the membership service are group-scoped).
+"""
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
+
+
+def _base(**over):
+    kw = dict(n_replicas=3, n_keys=32, n_sessions=4, replay_slots=4,
+              ops_per_session=64, value_words=6, replay_scan_every=4,
+              rebroadcast_every=2, lease_steps=4,
+              workload=WorkloadConfig(read_frac=0.4, seed=3))
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _fleet(groups=3, record=True, detect=None, **over):
+    from hermes_tpu.fleet import Fleet
+
+    return Fleet(FleetConfig(groups=groups, base=_base(**over)),
+                 record=record, detect=detect)
+
+
+# -- config + router ---------------------------------------------------------
+
+
+def test_fleet_config_validation():
+    FleetConfig(groups=2, base=_base())  # default even split
+    with pytest.raises(ValueError, match="tile the fleet keyspace"):
+        FleetConfig(groups=2, base=_base(), ranges=((0, 16), (17, 32)))
+    with pytest.raises(ValueError, match="dense table holds"):
+        FleetConfig(groups=2, base=_base(), ranges=((0, 40), (40, 80)))
+    with pytest.raises(ValueError, match="one entry per group"):
+        FleetConfig(groups=2, base=_base(), overrides=({},))
+    f = FleetConfig(groups=2, base=_base(),
+                    overrides=({"n_sessions": 8}, None))
+    assert f.group_cfg(0).n_sessions == 8
+    assert f.group_cfg(1).n_sessions == 4
+    # vary_seed: per-group streams are distinct but deterministic
+    assert f.group_cfg(1).workload.seed == f.base.workload.seed + 1
+
+
+def test_router_boundary_exact_ownership():
+    from hermes_tpu.fleet import FleetRouter
+
+    r = FleetRouter.from_config(FleetConfig(groups=3, base=_base()))
+    assert r.owned_ranges() == [(0, 32, 0), (32, 64, 1), (64, 96, 2)]
+    for g, (lo, hi) in enumerate(((0, 32), (32, 64), (64, 96))):
+        assert r.owner(lo) == g          # lo is IN the range
+        assert r.owner(hi - 1) == g      # hi-1 is the last key in
+        if lo > 0:
+            assert r.owner(lo - 1) == g - 1
+        if hi < 96:
+            assert r.owner(hi) == g + 1
+        assert r.locate(lo) == (g, 0)
+        assert r.locate(hi - 1) == (g, hi - 1 - lo)
+    with pytest.raises(ValueError, match="outside"):
+        r.owner(96)
+    with pytest.raises(ValueError, match="outside"):
+        r.owner(-1)
+
+
+def test_router_flip_needs_dest_slots_and_updates_local():
+    from hermes_tpu.fleet import FleetRouter
+
+    # group 0 owns 24 fleet keys on a 32-slot table: slots 24+ are spare,
+    # so a flip into them keeps the (group, slot) map injective
+    r = FleetRouter(64, [(0, 24), (24, 64)])
+    r.begin_drain(40, 44)
+    assert r.draining(40) and r.draining(43) and not r.draining(44)
+    with pytest.raises(ValueError, match="dest_slots"):
+        r.flip(40, 44, 0)
+    with pytest.raises(ValueError, match="every key"):
+        r.flip(40, 44, 0, dest_slots=[1, 2])
+    r.flip(40, 44, 0, dest_slots=[28, 29, 30, 31])
+    assert r.locate(41) == (0, 29)
+    assert not r.draining(41)
+    r.check_injective()
+
+
+def test_router_injectivity_detects_aliasing():
+    from hermes_tpu.fleet import FleetRouter
+
+    r = FleetRouter.from_config(FleetConfig(groups=2, base=_base()))
+    r.begin_drain(40, 41)
+    r.flip(40, 41, 0, dest_slots=[7])  # fleet key 40 -> group 0 slot 7
+    with pytest.raises(AssertionError, match="alias"):
+        r.check_injective()  # fleet key 7 also maps to group 0 slot 7
+
+
+# -- routed sessions + batch fan-out ----------------------------------------
+
+
+def test_fleet_routed_sessions_roundtrip(fleet3):
+    f = fleet3
+    keys = [1, 31, 32, 63, 64, 95]  # both boundary keys of every group
+    futs = [f.put(i, k, [k, 9]) for i, k in enumerate(keys)]
+    assert f.run_until(futs)
+    assert all(x.result().kind == "put" for x in futs)
+    gets = [f.get(i, k) for i, k in enumerate(keys)]
+    assert f.run_until(gets)
+    for k, g in zip(keys, gets):
+        assert g.result().value[:2] == [k, 9]
+        assert g.result().key == k  # completions echo the FLEET key
+
+
+def test_fleet_batch_spans_groups_totals_conserved(fleet3):
+    f = fleet3
+    n = 24
+    rng = np.random.default_rng(7)
+    keys = rng.permutation(np.arange(96))[:n].astype(np.int64)
+    kinds = np.where(np.arange(n) % 3 == 0, f.GET, f.PUT).astype(np.int32)
+    vals = np.arange(n, dtype=np.int32)[:, None] * np.ones((1, 4), np.int32)
+    fb = f.submit_batch(kinds, keys, vals)
+    spanned = {int(g) for g in fb.group}
+    assert len(spanned) >= 3, "mix must span >= 3 groups"
+    assert f.run_batch(fb)
+    # totals conservation: every op resolved exactly once, across exactly
+    # the owning groups' sub-batches
+    assert fb.done_count() == n
+    assert sum(len(bf) for _g, bf, _gix in fb._subs) == n
+    for g, bf, gix in fb._subs:
+        assert bf.all_done()
+        # completion order: a group's share preserves FLEET submission
+        # order (sub index i is fleet op gix[i], gix strictly increasing)
+        assert (np.diff(gix) > 0).all()
+        # ... and routing was by key: every op in this share is owned here
+        assert (np.asarray(f.router.owner(keys[gix])) == g).all()
+    # per-kind conservation
+    for kind, code in ((f.GET, 1), (f.PUT, 2)):
+        want = int((kinds == kind).sum())
+        from hermes_tpu.core import types as t
+
+        c = t.C_READ if kind == f.GET else t.C_WRITE
+        assert int((fb.code[kinds == kind] == c).sum()) == want
+
+
+def test_fleet_draining_range_rejects(fleet3):
+    f = fleet3
+    before = f.rejected_ops
+    f.router.begin_drain(32, 48)  # half of group 1's range
+    fut = f.put(0, 40, [1])
+    assert fut.done() and fut.result().kind == "rejected"
+    ok = f.put(0, 50, [1])  # the other half still serves
+    kinds = np.full(6, f.PUT, np.int32)
+    keys = np.array([33, 40, 47, 48, 2, 70], np.int64)
+    fb = f.submit_batch(kinds, keys, np.ones((6, 1), np.int32))
+    from hermes_tpu.kvs import C_REJECTED
+
+    assert (fb.code[:3] == C_REJECTED).all()   # draining: 33, 40, 47
+    assert (fb.group[:3] == -1).all()
+    assert f.run_batch(fb) and f.run_until([ok])
+    assert ok.result().kind == "put"
+    assert fb.completion(3).kind == "put"      # 48 is OUTSIDE the drain
+    assert f.rejected_ops == before + 4
+    f.router.release(32, 48)
+    again = f.put(0, 40, [2])
+    assert f.run_until([again]) and again.result().kind == "put"
+
+
+# -- cross-group migration (through the fleet router flip) -------------------
+
+
+def test_fleet_migration_smoke():
+    from hermes_tpu.fleet import Fleet, verify_fleet
+
+    # groups sized past their ranges (n_keys 48, ranges 32): the spare 16
+    # slots are the destination capacity cross-group migration lands in
+    f = Fleet(FleetConfig(groups=2, base=_base(n_keys=48),
+                          ranges=((0, 32), (32, 64))), record=True)
+    # two writes per key so versions reach 2, then a source rebase so the
+    # source carries nonzero _ver_base deltas the migration must re-anchor
+    futs = [f.put(i % 4, k, [k, r]) for r in range(2) for i, k in
+            enumerate(range(34, 40))]
+    assert f.run_until(futs)
+    src_rt = f.groups[1].rt
+    assert src_rt.rebase_versions() > 0
+    deltas = src_rt._ver_base.copy()
+    s = f.migrate(34, 40, dst_group=0)
+    assert s["src_group"] == 1 and s["dst_group"] == 0
+    # ownership flipped atomically, boundary-exact
+    assert f.router.owner(34) == 0 and f.router.owner(39) == 0
+    assert f.router.owner(33) == 1 and f.router.owner(40) == 1
+    assert not f.router.draining(np.arange(34, 40)).any()
+    # dest slots came from group 0's SPARE capacity (its own keys 0..31
+    # keep their slots; nothing aliases)
+    assert (np.asarray(s["dest_slots"]) >= 32).all()
+    # dest-group _ver_base re-anchor: the destination adopted the
+    # source's cumulative per-key deltas for the migrated slots
+    dst_rt = f.groups[0].rt
+    src_local = np.arange(34 - 32, 40 - 32)
+    assert dst_rt._ver_base is not None
+    np.testing.assert_array_equal(dst_rt._ver_base[s["dest_slots"]],
+                                  deltas[src_local])
+    # post-flip service: reads route to the destination and see the values
+    gets = [f.get(0, k) for k in range(34, 40)]
+    assert f.run_until(gets)
+    assert [g.result().value[:2] for g in gets] == [[k, 1] for k in
+                                                    range(34, 40)]
+    v = f.check()
+    assert v["ok"], v
+    ev = verify_fleet(f)
+    assert ev["migration_uids"] == 6
+
+
+def test_fleet_migration_refused_without_capacity():
+    f = _fleet(groups=2, record=False)  # ranges == n_keys: zero spare
+    with pytest.raises(ValueError, match="spare slot"):
+        f.migrate(32, 40, dst_group=0)
+    # refusal happened BEFORE the fence: the range still serves
+    fut = f.put(0, 33, [1])
+    assert f.run_until([fut]) and fut.result().kind == "put"
+
+
+def test_migrate_range_dest_slots_validation():
+    from hermes_tpu.elastic import migrate_range
+    from hermes_tpu.kvs import KVS
+
+    cfg = _base()
+    src, dst = KVS(cfg, record=False), KVS(cfg, record=False)
+    with pytest.raises(ValueError, match="every slot"):
+        migrate_range(src, dst, 0, 4, dest_slots=[1, 2])
+    with pytest.raises(ValueError, match="distinct"):
+        migrate_range(src, dst, 0, 4, dest_slots=[1, 1, 2, 3])
+    with pytest.raises(ValueError, match="slot space"):
+        migrate_range(src, dst, 0, 4, dest_slots=[1, 2, 3, 99])
+    sp = KVS(cfg, record=False, sparse_keys=True)
+    sp2 = KVS(cfg, record=False, sparse_keys=True)
+    with pytest.raises(ValueError, match="dense-mode"):
+        migrate_range(sp, sp2, 0, 1, dest_slots=[0])
+
+
+# -- per-group isolation (the round-13 fix + red tests) ----------------------
+
+
+def test_chaos_on_group0_never_fences_group1():
+    """The red isolation test: a fault schedule targeting group 0 (freeze,
+    crash-restart, detector ejection) must never fence a group 1 replica
+    — there is no shared live mask, frozen set, or detector to leak
+    through."""
+    from hermes_tpu import chaos
+    from hermes_tpu.fleet import FleetChaosRunner
+
+    f = _fleet(groups=2, record=False, detect=1)
+    sched0 = chaos.Schedule.parse(
+        "@2 freeze 1\n@6 crash_restart 2\n@14 thaw 1\n")
+    runner = FleetChaosRunner(
+        f, [sched0, chaos.Schedule([])],
+        spec=chaos.ChaosSpec(min_healthy=1))
+    g1 = f.groups[1].rt
+    touched = []
+    runner.on_step = lambda s: touched.append(
+        g1.frozen.any() or int(g1.live[0]) != g1.cfg.full_mask)
+    res = runner.run(20, heal=True)
+    applied = [e["kind"] for e in runner.runners[0].log]
+    assert "freeze" in applied and "crash_restart" in applied
+    assert not any(touched), "a group-0 fault fenced a group-1 replica"
+    assert not runner.runners[1].log  # the empty schedule applied nothing
+    assert g1.healthy_replicas() == list(range(g1.cfg.n_replicas))
+
+
+def test_membership_and_healthy_set_group_scoped():
+    f = _fleet(groups=2, record=False, detect=0)
+    g0, g1 = f.groups[0].rt, f.groups[1].rt
+    # distinct service instances, group-labeled
+    assert g0.membership is not g1.membership
+    assert (g0.membership.group, g1.membership.group) == (0, 1)
+    g0.freeze(1)
+    assert g0.healthy_replicas() == [0, 2]
+    assert g1.healthy_replicas() == [0, 1, 2]  # group-scoped healthy set
+    # drive group 0 until its detector ejects the frozen replica; group
+    # 1's membership log must stay empty
+    for _ in range(3 * f.cfg.base.lease_steps):
+        f.step()
+    assert any(e.kind == "remove" and e.group == 0
+               for e in g0.membership.events)
+    assert g1.membership.events == []
+    assert int(g1.live[0]) == g1.cfg.full_mask
+
+
+def test_verify_fleet_catches_cross_group_uid_aliasing():
+    from hermes_tpu.fleet import verify_fleet
+
+    f = _fleet(groups=2, record=True)
+    verify_fleet(f)  # clean fleet passes
+    # forge the SAME migration-namespace uid into both groups' histories
+    for grp in f.groups:
+        grp.rt.recorder.record_migration(
+            np.array([1]), np.array([[5, -7]]), np.array([1]),
+            np.array([0]), step=grp.rt.step_idx + 1)
+    with pytest.raises(AssertionError, match="aliasing"):
+        verify_fleet(f)
+
+
+# -- fleet-wide chaos: deterministic replay ---------------------------------
+
+
+def test_fleet_chaos_deterministic_replay():
+    import jax
+
+    from hermes_tpu import chaos
+    from hermes_tpu.fleet import Fleet, FleetChaosRunner, fleet_schedules
+
+    fcfg = FleetConfig(groups=2, base=_base(n_replicas=4))
+
+    def one():
+        f = Fleet(fcfg, record=True, detect=2)
+        kinds = np.full(30, Fleet.PUT, np.int32)
+        keys = (np.arange(30) * 5) % fcfg.total_keys
+        fb = f.submit_batch(kinds, keys, np.ones((30, 1), np.int32))
+        runner = FleetChaosRunner(
+            f, fleet_schedules(fcfg, seed=11, steps=18),
+            spec=chaos.ChaosSpec(min_healthy=2))
+        res = runner.run(18, check=True)
+        assert res["checked_ok"] and res["drained"], res
+        f.run_batch(fb)
+        states = [jax.tree.leaves(jax.device_get(g.rt.fs))
+                  for g in f.groups]
+        return runner.log_json(), states
+
+    log1, st1 = one()
+    log2, st2 = one()
+    assert log1 == log2, "fleet executed logs differ across replays"
+    for ga, gb in zip(st1, st2):
+        for a, b in zip(ga, gb):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_parse_fleet_schedule_routing():
+    from hermes_tpu.fleet import parse_fleet
+
+    scheds = parse_fleet(
+        "@2 freeze 1\n"         # unprefixed -> group 0
+        "g1@4 freeze 0\n"
+        "g2@6 thaw 0  # comment\n", groups=3)
+    assert [len(s) for s in scheds] == [1, 1, 1]
+    assert scheds[1].events[0].step == 4
+    with pytest.raises(ValueError, match="group 7"):
+        parse_fleet("g7@1 freeze 0\n", groups=3)
+
+
+# -- obs: per-group labels + fleet aggregation -------------------------------
+
+
+def test_fleet_obs_group_labels_and_aggregation(fleet3):
+    from hermes_tpu.obs import Observability
+    from hermes_tpu.obs.report import fleet_totals, render_report
+
+    f = fleet3
+    obs = Observability()
+    f.attach_obs(obs)
+    f.groups[1].rt.freeze(0)
+    f.groups[1].rt.thaw(0)
+    futs = [f.put(i, k, [k]) for i, k in enumerate((2, 40, 70))]
+    assert f.run_until(futs)
+    f.interval_report(obs)
+    evs = [r for r in obs.records if r.get("kind") == "event"
+           and r.get("name") == "freeze"]
+    assert evs and evs[0]["group"] == 1  # trace events carry the group
+    ft = fleet_totals(obs.records)
+    assert set(ft["groups"]) == {0, 1, 2}
+    assert ft["fleet"]["n_write"] == sum(
+        r["n_write"] for r in ft["groups"].values())
+    report = render_report(obs.records)
+    assert "-- fleet (per-group / aggregate, 3 group(s)) --" in report
+
+
+# -- device layout: the (groups, replicas) grid ------------------------------
+
+
+def test_fleet_meshes_disjoint_grid(cpu_devices):
+    from hermes_tpu import launch
+
+    meshes = launch.fleet_meshes(4, 2)
+    assert len(meshes) == 4
+    seen = set()
+    for m in meshes:
+        ids = {d.id for d in m.devices.ravel()}
+        assert len(ids) == 2 and not (ids & seen)
+        seen |= ids
+    assert launch.group_of_process(4, 2) == [0, 1, 2, 3]  # single process
+    with pytest.raises(RuntimeError, match="do not split"):
+        launch.fleet_meshes(3)
+
+
+def test_fleet_base_port_windows_disjoint():
+    from hermes_tpu.distributed import fleet_base_port
+
+    ports = [fleet_base_port(29500, g, n_ranks=4) for g in range(3)]
+    assert ports == sorted(set(ports))
+    # a group's window (4 ports per rank of headroom) never overlaps the
+    # next group's base
+    for a, b in zip(ports, ports[1:]):
+        assert a + 4 * 4 <= b
+
+
+# -- sharded fleet: disjoint submeshes ---------------------------------------
+
+
+def test_fleet_sharded_groups_on_submeshes(cpu_devices):
+    from hermes_tpu import launch
+    from hermes_tpu.fleet import Fleet
+
+    fcfg = FleetConfig(groups=2, base=_base(n_replicas=2, n_sessions=2))
+    f = Fleet(fcfg, backend="sharded", meshes=launch.fleet_meshes(2, 2),
+              record=True)
+    futs = [f.put(i, k, [k, 3]) for i, k in enumerate((1, 31, 32, 63))]
+    assert f.run_until(futs)
+    gets = [f.get(i, k) for i, k in enumerate((1, 31, 32, 63))]
+    assert f.run_until(gets)
+    assert [g.result().value[:2] for g in gets] == [
+        [1, 3], [31, 3], [32, 3], [63, 3]]
+    assert f.check()["ok"]
+
+
+def test_fleet_snapshot_scope_roundtrip(tmp_path):
+    import jax
+
+    f = _fleet(groups=2, record=False)
+    futs = [f.put(i, k, [k, 4]) for i, k in enumerate((3, 40))]
+    assert f.run_until(futs)
+    f.drain()
+    manifest = f.save(str(tmp_path / "fleet"))
+    assert manifest["groups"] == 2 and len(manifest["archives"]) == 2
+    before = [jax.tree.leaves(jax.device_get(g.rt.fs)) for g in f.groups]
+    # a fresh fleet restores per-group state AND router scope
+    f2 = _fleet(groups=2, record=False)
+    f2.load(str(tmp_path / "fleet"))
+    after = [jax.tree.leaves(jax.device_get(g.rt.fs)) for g in f2.groups]
+    for ga, gb in zip(before, after):
+        for a, b in zip(ga, gb):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    g = f2.get(0, 40)
+    assert f2.run_until([g]) and g.result().value[:2] == [40, 4]
+    # a wrong-shape fleet refuses the archive
+    f3 = _fleet(groups=3, record=False)
+    with pytest.raises(ValueError, match="not a fleet snapshot"):
+        f3.load(str(tmp_path / "fleet"))
+
+
+@pytest.fixture(scope="module")
+def fleet3():
+    """One recorded 3-group fleet shared by the read-only routing tests
+    (each KVS construction compiles its group's round — sharing keeps the
+    quick tier quick).  Tests that mutate fleet topology build their own."""
+    return _fleet(groups=3, record=True)
