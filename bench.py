@@ -45,7 +45,6 @@ vs_baseline = value / 1e7 (the north-star aggregate target).
 import argparse
 import dataclasses
 import json
-import math
 import os
 import sys
 import time
@@ -259,11 +258,8 @@ def run_latency(n_sessions: int = 1024) -> dict:
     times = sorted(one(warm + i) for i in range(samples))
     m = jax.device_get(fs.meta)
     commits = int(m.n_write.sum() + m.n_rmw.sum())
-    # nearest-rank percentiles (ceil(q*n)-th order statistic): with 100
-    # samples p99 is the 99th value, not the max — one outlier dispatch no
-    # longer defines the reported tail
-    pctl = lambda q: times[min(len(times) - 1,
-                               max(0, math.ceil(q * len(times)) - 1))]
+    from hermes_tpu.stats import percentile_nearest_rank
+    pctl = lambda q: percentile_nearest_rank(times, q)
     p50 = pctl(0.50)
     p99 = pctl(0.99)
 
@@ -577,6 +573,17 @@ def main() -> None:
                     "schedule vs clean (round-9, hermes_tpu.chaos; "
                     "detector attached, --pipeline-depth/-rounds apply); "
                     "writes CHAOS_BENCH.json")
+    ap.add_argument("--serve", action="store_true",
+                    help="measure the round-14 serving front-end instead: "
+                    "end-to-end p50/p99 FROM THE CLIENT SOCKET (framed "
+                    "RPC over localhost TCP) for the latency operating "
+                    "point (small dispatches, pipeline_depth>=2, donated "
+                    "state) and the windowed closed-loop throughput "
+                    "point, plus the uniform/zipfian/hot-key scenario "
+                    "matrix; writes BENCH_LATENCY.json (host cells carry "
+                    "a tpu_pending note)")
+    ap.add_argument("--serve-ops", type=int, default=None,
+                    help="ops per --serve cell (default: platform-sized)")
     ap.add_argument("--fleet", action="store_true",
                     help="measure the key-sharded fleet instead "
                     "(round-13, hermes_tpu.fleet): per-group + aggregate "
@@ -620,6 +627,31 @@ def main() -> None:
                 "unit": "writes/s", "vs_baseline": 0.0, "error": info})
         out.write(rec)
         sys.exit(1)
+
+    if args.serve:
+        from hermes_tpu.serving.bench import run_serve_bench
+
+        r = run_serve_bench(n=args.serve_ops)
+        with open("BENCH_LATENCY.json", "w") as f:
+            json.dump(r, f, indent=1)
+        cell(r)
+        lat, thr = r["cells"]["latency"], r["cells"]["throughput"]
+        errs = r.get("errors")
+        out.write({
+            "metric": "serve_latency_p50_us",
+            "value": lat["p50_us"],
+            "p99_us": lat["p99_us"],
+            "throughput_ops_per_sec": thr["ops_per_sec"],
+            "throughput_p50_us": thr["p50_us"],
+            "dispatch_loop_p50_ms": r["dispatch_loop_p50_ms"],
+            "improves_dispatch_loop": r["latency_p50_improves"],
+            **({"errors": errs} if errs else {}),
+        })
+        # a cell that lost its server or part of its answers is NOT a
+        # pass, however good the answered-prefix percentiles look
+        if errs or not r["latency_p50_improves"]:
+            sys.exit(1)
+        return
 
     if args.fleet:
         r = run_fleet_bench(groups=args.fleet_groups)

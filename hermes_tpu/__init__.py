@@ -23,7 +23,7 @@ __version__ = "0.2.0"
 
 __all__ = ["HermesConfig", "WorkloadConfig", "FleetConfig", "types", "KVS",
            "KeyIndex", "RangeRouter", "Fleet", "FleetRouter", "FastRuntime",
-           "Runtime", "__version__"]
+           "Runtime", "Frontend", "ServingConfig", "__version__"]
 
 
 def __getattr__(name):
@@ -45,6 +45,10 @@ def __getattr__(name):
         from hermes_tpu import runtime
 
         obj = getattr(runtime, name)
+    elif name in ("Frontend", "ServingConfig"):
+        from hermes_tpu.serving import server as _serving_server
+
+        obj = getattr(_serving_server, name)
     else:
         raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
     globals()[name] = obj

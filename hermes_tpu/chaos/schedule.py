@@ -55,7 +55,11 @@ EVENT_KINDS = ("freeze", "thaw", "remove", "join", "crash_restart",
                # round-11 wire-adversary verbs (chaos/net.py interposer;
                # partition also drives the fast engines' detector oracle)
                "netdrop", "netdelay", "netdup", "netreorder", "netcorrupt",
-               "partition", "heal")
+               "partition", "heal",
+               # round-14 overload adversary: multiply the attached load
+               # shaper's open-loop arrival rate by x for a window — the
+               # serving front-end's first-class, seeded failure mode
+               "overload", "overload_clear")
 
 # round-11 verb -> FaultingTransport wire op.  The legacy net_* verbs keep
 # their NetChaos routing (sim-transport schedule windows) but fall back to
@@ -81,6 +85,7 @@ class ChaosEvent:
     dst: int = -1
     skew: int = 0
     until: int = -1
+    x: float = 0.0  # overload rate multiplier (round-14)
     u: float = 0.0  # pre-drawn uniform for run-time target resolution
 
     def format(self) -> str:
@@ -92,6 +97,8 @@ class ChaosEvent:
             v = getattr(self, f)
             if v != dflt:
                 parts.append(f"{f}={v}")
+        if self.x:
+            parts.append(f"x={self.x!r}")
         if self.u:
             parts.append(f"u={self.u!r}")
         return " ".join(parts)
@@ -175,9 +182,9 @@ class Schedule:
                 if "=" not in tok:
                     raise ValueError(f"line {ln}: want key=value, got {tok!r}")
                 k, v = tok.split("=", 1)
-                if k not in ("donor", "dst", "skew", "until", "u"):
+                if k not in ("donor", "dst", "skew", "until", "u", "x"):
                     raise ValueError(f"line {ln}: unknown field {k!r}")
-                kw[k] = float(v) if k == "u" else int(v)
+                kw[k] = float(v) if k in ("u", "x") else int(v)
             try:
                 events.append(ChaosEvent(**kw))
             except ValueError as e:
@@ -220,6 +227,33 @@ class Schedule:
             events.append(ChaosEvent(step=step + window + 2, kind="heal"))
             step += spacing
             i += 1
+        return cls(events)
+
+    @classmethod
+    def overload_storm(cls, seed: int, steps: int, n_windows: int = 2,
+                       x_range: Tuple[float, float] = (2.0, 6.0),
+                       window: Tuple[int, int] = (8, 24)) -> "Schedule":
+        """Seeded overload windows (round-14): ``n_windows`` bursts, each
+        multiplying the attached load shaper's open-loop arrival rate by
+        a drawn ``x`` for a drawn window length — the serving analogue of
+        ``Schedule.random``'s fault draws.  Same seed => identical
+        program => (with the seeded Poisson schedule) byte-identical
+        executed arrivals; the runner REFUSES the program when no load
+        shaper is attached (the net-fault routability rule)."""
+        rng = np.random.default_rng(
+            (int(seed) * 0xD1B54A32D192ED03 + 3) & 0xFFFFFFFFFFFFFFFF)
+        events = []
+        if n_windows <= 0:
+            return cls(events)
+        span = max(1, steps // n_windows)
+        for i in range(n_windows):
+            lo = i * span + 1
+            w = int(rng.integers(window[0], window[1] + 1))
+            start = lo + int(rng.integers(0, max(1, span - w)))
+            xval = round(float(x_range[0] + (x_range[1] - x_range[0])
+                               * rng.random()), 3)
+            events.append(ChaosEvent(step=start, kind="overload", x=xval,
+                                     until=min(steps - 1, start + w)))
         return cls(events)
 
     @classmethod
@@ -333,6 +367,7 @@ class ChaosRunner:
                  spec: Optional[ChaosSpec] = None,
                  net: Optional[NetChaos] = None,
                  wire=None,
+                 load=None,
                  snapshot_path: Optional[str] = None,
                  on_step: Optional[Callable[[int], None]] = None):
         self.kvs = target if (hasattr(target, "rt")
@@ -345,6 +380,10 @@ class ChaosRunner:
         # round-11: the transport-generic fault interposer
         # (chaos.net.FaultingTransport wrapping the target's HostTransport)
         self.wire = wire
+        # round-14: the open-loop load shaper (workload.ShapedArrivals or
+        # anything with set_rate_x) the overload verbs act on
+        self.load = load
+        self._overload_until: Optional[int] = None
         self.snapshot_path = snapshot_path
         self.on_step = on_step
         self.log: List[dict] = []
@@ -381,7 +420,16 @@ class ChaosRunner:
         legacy_lines = [e for e in self.schedule
                         if e.kind in LEGACY_NET_EVENTS]
         part_lines = [e for e in self.schedule if e.kind == "partition"]
+        over_lines = [e for e in self.schedule
+                      if e.kind in ("overload", "overload_clear")]
         name = self._transport_name()
+        if over_lines and self.load is None:
+            ls = ", ".join(e.format() for e in over_lines[:3])
+            raise ValueError(
+                f"schedule contains overload events ({ls}) but no load "
+                "shaper is attached: pass the open-loop arrival schedule "
+                "(workload.ShapedArrivals, or anything with set_rate_x) "
+                "as ChaosRunner(..., load=...)")
         if wire_lines and self.wire is None:
             ls = ", ".join(e.format() for e in wire_lines[:3])
             raise ValueError(
@@ -549,6 +597,26 @@ class ChaosRunner:
             self._heal_cluster(step)
             self._note(step, "heal")
             self._update_net_phase(step)
+        elif e.kind == "overload":
+            x = e.x or 2.0
+            self.load.set_rate_x(x)
+            self._overload_until = e.until if e.until >= 0 else None
+            rt._trace("overload", x=x, until=e.until)
+            self._note(step, "overload", x=x, until=e.until)
+        elif e.kind == "overload_clear":
+            self.load.set_rate_x(1.0)
+            self._overload_until = None
+            rt._trace("overload_clear")
+            self._note(step, "overload_clear")
+
+    def _expire_overload(self, step: int) -> None:
+        """Close an overload window whose ``until`` elapsed (explicit
+        ``overload_clear`` events also close it)."""
+        if self._overload_until is not None and step >= self._overload_until:
+            self.load.set_rate_x(1.0)
+            self._overload_until = None
+            self.rt._trace("overload_clear")
+            self._note(step, "overload_clear")
 
     def _expire_skews(self, step: int) -> None:
         svc = self.rt.membership
@@ -610,6 +678,12 @@ class ChaosRunner:
                 rt.membership.skew[r] = 0
         self._skew_until.clear()
         self._partition_until.clear()
+        # unconditional, like skews/partitions: an `overload x=N` with no
+        # until= (open window awaiting an overload_clear) must not outlive
+        # a heal
+        if self.load is not None:
+            self.load.set_rate_x(1.0)
+            self._overload_until = None
 
     def _heal_cluster(self, step: int) -> None:
         """Thaw every frozen replica and rejoin every non-live one through
@@ -665,6 +739,8 @@ class ChaosRunner:
         and steps the groups itself."""
         self._expire_skews(step)
         self._expire_partitions(step)
+        if self.load is not None:
+            self._expire_overload(step)
         if self.kvs is not None and self.wire is not None:
             # wire windows expire by their own step test: refresh the
             # diagnostics channel so a stuck op is never blamed on a

@@ -151,6 +151,30 @@ def build_parser() -> argparse.ArgumentParser:
                     "and the measured worst-window throughput dip is "
                     "reported (dip_pct).  Fast backends only; resize/"
                     "migrate need --value-words >= 3")
+    ap.add_argument("--serve", type=int, default=None, metavar="N",
+                    help="serving-front-end quickstart (round-14, hermes_"
+                    "tpu/serving): drive N open-loop Poisson ops through "
+                    "the byte-honest loopback RPC server over the KVS "
+                    "(admission control, deadlines, backpressure, shed "
+                    "ladder) and print one JSON summary line; --check "
+                    "additionally gates the linearizability checker AND "
+                    "the serving invariants (response conservation, "
+                    "admission accounting exactness).  Needs "
+                    "--value-words >= 3; fast batched backend")
+    ap.add_argument("--serve-rate", type=float, default=8000.0,
+                    help="open-loop arrival rate (ops per virtual second) "
+                    "for --serve")
+    ap.add_argument("--serve-deadline-us", type=int, default=50_000,
+                    metavar="US",
+                    help="client deadline for --serve ops (virtual "
+                    "microseconds; 0 = none)")
+    ap.add_argument("--bench-latency", action="store_true",
+                    help="measure the serving latency operating point "
+                    "end-to-end from a real client socket (round-14: "
+                    "small dispatches at pipeline_depth>=2, donated "
+                    "state, framed RPC over localhost TCP) and print one "
+                    "JSON line with p50/p99 vs the 28 ms dispatch-loop "
+                    "figure")
     ap.add_argument("--profile-out", type=str, default=None,
                     metavar="PROFILE_JSONL",
                     help="write the run config's round op census + cost-model"
@@ -210,6 +234,73 @@ def _run_fleet(args, cfg) -> int:
     summary["ok"] = bool(ok)
     print(json.dumps(summary, default=str))
     return 0 if ok else 1
+
+
+def _run_serve(args, cfg) -> int:
+    """Serving quickstart (round-14, hermes_tpu/serving): N open-loop
+    Poisson ops through the loopback RPC path over the KVS — admission,
+    deadlines, backpressure, shedding — as one JSON summary line.
+    --check gates the checker plus the serving invariants."""
+    import json
+
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.serving import ServingConfig, run_open_loop
+    from hermes_tpu.workload.openloop import MixSpec
+
+    kvs = KVS(cfg, record="array" if args.check else False)
+    scfg = ServingConfig()
+    spec = MixSpec(name=cfg.workload.distribution,
+                   distribution=cfg.workload.distribution,
+                   zipf_theta=cfg.workload.zipf_theta,
+                   read_frac=cfg.workload.read_frac)
+    res = run_open_loop(
+        kvs, scfg, spec,
+        rate_per_s=args.serve_rate, n=args.serve, seed=args.seed,
+        deadline_us=args.serve_deadline_us)
+    summary = {k: v for k, v in res.items() if not k.startswith("_")}
+    # the serving invariants (response conservation, per-tenant admission
+    # accounting exactness) are asserted by verify_serving INSIDE
+    # run_open_loop — reaching here means they held
+    ok = True
+    if args.check:
+        v = kvs.rt.check(max_keys=args.check_keys)
+        summary["checked_ok"] = bool(v.ok)
+        ok = ok and v.ok
+    summary["ok"] = bool(ok)
+    print(json.dumps(summary, default=str))
+    return 0 if ok else 1
+
+
+def _run_bench_latency(args, cfg) -> int:
+    """One-cell serving latency quickstart: the latency operating point
+    measured end-to-end from a real client socket."""
+    import json
+
+    from hermes_tpu.serving.bench import (DISPATCH_LOOP_P50_MS, host_cfg,
+                                          improves_dispatch_loop,
+                                          run_socket_cell)
+    from hermes_tpu.serving.server import ServingConfig
+    from hermes_tpu.workload.openloop import MixSpec
+
+    scfg = ServingConfig(tenant_rate_per_s=1e6, tenant_burst=1e5,
+                         tenant_quota=64, queue_cap=256)
+    # probe capacity closed-loop first and open-loop at 0.2x it (the
+    # run_serve_bench discipline): a fixed rate above this box's service
+    # rate would measure queueing delay, not service latency
+    probe = run_socket_cell(host_cfg("latency"), scfg, MixSpec(),
+                            n=32, mode="closed", window=8, seed=args.seed)
+    cell = run_socket_cell(host_cfg("latency"), scfg, MixSpec(),
+                           n=64, mode="open",
+                           rate_per_s=max(10.0, 0.2 * probe["ops_per_sec"]),
+                           seed=args.seed)
+    cell["capacity_probe_ops_per_sec"] = probe["ops_per_sec"]
+    cell["dispatch_loop_p50_ms"] = DISPATCH_LOOP_P50_MS
+    cell["improves_dispatch_loop"] = improves_dispatch_loop(cell["p50_us"])
+    # a cell that lost its server or part of its answers is NOT a pass,
+    # however good the answered-prefix percentiles look
+    cell["ok"] = bool(cell["improves_dispatch_loop"]) and cell["error"] is None
+    print(json.dumps(cell, default=str))
+    return 0 if cell["ok"] else 1
 
 
 def _run_drill(args, cfg, mesh) -> int:
@@ -330,6 +421,29 @@ def main(argv=None) -> int:
                 or args.chaos_schedule or args.freeze):
             ap.error("--fleet-groups is its own drive; drop --acceptance/"
                      "--drill/--chaos/--freeze")
+    if args.serve is not None:
+        if args.serve < 1:
+            ap.error("--serve wants a positive op count")
+        if args.bench_latency:
+            ap.error("--serve and --bench-latency are separate drives; "
+                     "pick one")
+        if args.backend != "fast":
+            ap.error("--serve drives the fast batched backend through the "
+                     "KVS facade (hermes_tpu/serving)")
+        if args.value_words < 3:
+            ap.error("--serve needs --value-words >= 3 (words 0-1 carry "
+                     "the write uid)")
+        if (args.acceptance or args.drill or args.fleet_groups
+                or args.chaos is not None or args.chaos_schedule
+                or args.freeze):
+            ap.error("--serve is its own drive; drop --acceptance/--drill/"
+                     "--fleet-groups/--chaos/--freeze")
+    if args.bench_latency and (args.acceptance or args.drill
+                               or args.fleet_groups
+                               or args.chaos is not None
+                               or args.chaos_schedule or args.freeze):
+        ap.error("--bench-latency is its own drive; drop --acceptance/"
+                 "--drill/--fleet-groups/--chaos/--freeze")
     chaos_on = args.chaos is not None or args.chaos_schedule
     if chaos_on:
         if args.backend not in ("fast", "fast-sharded"):
@@ -440,6 +554,12 @@ def main(argv=None) -> int:
 
     if args.fleet_groups:
         return _run_fleet(args, cfg)
+
+    if args.serve is not None:
+        return _run_serve(args, cfg)
+
+    if args.bench_latency:
+        return _run_bench_latency(args, cfg)
 
     if args.drill:
         return _run_drill(args, cfg, mesh)

@@ -224,20 +224,37 @@ class Fleet:
         s = (session // grp.cfg.n_replicas) % grp.cfg.n_sessions
         return r, s
 
-    def _route(self, kind: str, session: int, key: int, value):
+    def route_op(self, kind: str, session: int, key: int, value=None):
+        """Route one op and ALSO report the (group, replica, session)
+        lane it landed on — the round-14 frontend needs the lane for its
+        stuck-op diag tags, and calling this instead of get/put/rmw
+        avoids repeating the locate + lane computation per op.  The lane
+        is None for an op refused at the router (draining range)."""
         g, slot = self.router.locate(int(key))
         if self.router.draining(int(key)):
             self.rejected_ops += 1
             fut = Future()
             fut._result = Completion(kind="rejected", key=int(key),
                                      found=False)
-            return fut
+            return fut, None
         grp = self.groups[g]
         r, s = self._lane(grp, session)
         with grp.ctx():
             fut = getattr(grp.kvs, kind)(r, s, slot, *(
                 (value,) if value is not None else ()))
-        return _RoutedFuture(fut, int(key))
+        return _RoutedFuture(fut, int(key)), (int(g), r, s)
+
+    def _route(self, kind: str, session: int, key: int, value):
+        return self.route_op(kind, session, key, value)[0]
+
+    def degraded(self, key: Optional[int] = None) -> bool:
+        """Quorum-loss degraded mode, fleet view (round-14 serving
+        ladder): with ``key``, whether the OWNING group cannot commit
+        writes right now; without, whether any group is degraded."""
+        if key is not None:
+            g, _slot = self.router.locate(int(key))
+            return self.groups[int(g)].kvs.degraded()
+        return any(grp.kvs.degraded() for grp in self.groups)
 
     def get(self, session: int, key: int) -> Future:
         return self._route("get", session, key, None)

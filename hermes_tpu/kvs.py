@@ -74,6 +74,9 @@ class StuckOpError(RuntimeError):
                 f"phase={d['phase']}"
                 + (f" drill={d['drill']}" if "drill" in d else "")
                 + (f" net={d['net']}" if "net" in d else "")
+                + (f" tenant={d['tenant']}" if "tenant" in d else "")
+                + (f" deadline_left_us={d['deadline_left_us']}"
+                   if "deadline_left_us" in d else "")
                 + f" age={d['age_rounds']}"
                 for d in diagnostics[:4]))
 
@@ -279,6 +282,13 @@ class KVS:
         # loudly (kind='rejected') instead of wedging; shed_writes counts
         # them and the transition lands on the obs timeline.
         self.net_phase: Optional[dict] = None
+        # serving front-end tags (round-14, hermes_tpu/serving): when a
+        # Frontend drives this KVS it installs a per-op diagnostics hook
+        # — the watchdog calls it with the stuck (replica, session) and
+        # merges whatever it returns (tenant id, remaining deadline
+        # budget) into the diagnostic, the per-op generalization of the
+        # drill_phase / net_phase tags
+        self.diag_hook = None
         self._retry_next: Dict[Tuple[int, int], int] = {}
         self._retry_k: Dict[Tuple[int, int], int] = {}
         self.retried_ops = 0
@@ -366,6 +376,13 @@ class KVS:
             self.rt._trace("degraded" if degraded else "degraded_clear",
                            healthy=len(healthy), floor=floor)
         return degraded
+
+    def degraded(self) -> bool:
+        """Public view of the quorum-loss degraded mode (round-14: the
+        serving front-end's shed ladder composes with it — degraded =>
+        writes shed at the front door instead of entering the store just
+        to be rejected)."""
+        return self._degraded_now()
 
     def _rejected_future(self, client_key: int) -> Future:
         self.rejected_ops += 1
@@ -735,6 +752,12 @@ class KVS:
                     # carries the partition/drop spec and affected peer
                     # pairs, so soak triage needs no log cross-referencing
                     diag["net"] = self.net_phase
+                if self.diag_hook is not None:
+                    # serving front-end attached (round-14): tag the op's
+                    # tenant + remaining deadline budget
+                    extra = self.diag_hook(r, s)
+                    if extra:
+                        diag.update(extra)
                 new_diags.append(diag)
                 self.stuck_ops.append(diag)
                 self.rt._trace("stuck_op", **diag)

@@ -12,7 +12,12 @@ from __future__ import annotations
 import json
 from typing import Iterable, List, Optional
 
-FAULT_EVENTS = ("freeze", "thaw", "remove", "join", "suspect")
+FAULT_EVENTS = ("freeze", "thaw", "remove", "join", "suspect",
+                # round-14 serving envelope: shed-ladder transitions and
+                # overload windows are fault-class events — an operator
+                # reading the timeline sees WHEN the front door closed
+                "shed", "shed_clear", "degraded", "degraded_clear",
+                "overload", "overload_clear")
 
 
 def load_records(paths: Iterable[str]) -> List[dict]:

@@ -1,0 +1,25 @@
+"""Overload-hardened serving front-end (round-14).
+
+The networked RPC path between clients and the replicated store:
+CRC-framed request/response wire (serving/wire.py) over real sockets
+(serving/rpc.py TcpRpcServer/RpcClient) or the byte-honest in-process
+loopback, admission control + deadlines + backpressure + the load-shed
+ladder (serving/server.py Frontend over kvs.KVS or fleet.Fleet), and
+deterministic open-loop soaks (serving/soak.py with
+workload.openloop's seeded Poisson arrivals).
+"""
+
+from hermes_tpu.serving import wire
+from hermes_tpu.serving.admission import AdmissionControl, TokenBucket
+from hermes_tpu.serving.rpc import LoopbackServer, RpcClient, TcpRpcServer
+from hermes_tpu.serving.server import (Frontend, ServingConfig, VirtualClock,
+                                       verify_serving)
+from hermes_tpu.serving.soak import (committed_uids, measure_capacity,
+                                     run_open_loop)
+
+__all__ = [
+    "wire", "AdmissionControl", "TokenBucket", "LoopbackServer",
+    "RpcClient", "TcpRpcServer", "Frontend", "ServingConfig",
+    "VirtualClock", "verify_serving", "committed_uids",
+    "measure_capacity", "run_open_loop",
+]

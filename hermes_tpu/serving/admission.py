@@ -1,0 +1,168 @@
+"""Admission control of the serving front-end (round-14).
+
+Three rungs stand between a request and the intake queue, each refusing
+LOUDLY (wire.S_RETRY_AFTER with a reason + retry hint) instead of
+buffering silently:
+
+  1. the overload ladder — a queue-occupancy staircase that composes
+     with the store's quorum-loss degraded mode: rung 1 sheds NEW
+     writes (reads still serve — exactly the round-11
+     ``min_healthy_for_writes`` policy pulled forward to the front
+     door, where refusing is cheaper than admitting a doomed op), rung
+     2 additionally sheds non-hot-key reads (the hot set keeps serving:
+     under a zipfian storm that preserves the bulk of the offered read
+     value at a fraction of the lane cost);
+  2. the per-tenant session quota — a cap on client-visible in-flight
+     ops, the serving analogue of the reference's per-worker session
+     arrays (SURVEY.md §1 L5) — and the bounded intake queue
+     (R_QUEUE_FULL);
+  3. the per-tenant token bucket — sustained rate + burst, refilled on
+     the SERVING clock (virtual in deterministic soaks, monotonic wall
+     time on sockets), so one tenant cannot starve the rest.  Charged
+     LAST: a quota/queue refusal never burns the tenant's rate budget.
+All state is plain floats/ints driven by a caller-supplied ``now``:
+given the same arrival schedule the whole admission path replays
+byte-identically (the chaos-schedule discipline applied to overload).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from hermes_tpu.serving import wire
+
+
+class TokenBucket:
+    """Deterministic token bucket on a caller-supplied clock."""
+
+    def __init__(self, rate_per_s: float, burst: float):
+        if rate_per_s <= 0 or burst <= 0:
+            raise ValueError("rate_per_s and burst must be > 0")
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._t_last: Optional[float] = None
+
+    def _refill(self, now: float) -> None:
+        if self._t_last is not None and now > self._t_last:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._t_last) * self.rate)
+        self._t_last = now if self._t_last is None else max(self._t_last, now)
+
+    def take(self, now: float) -> bool:
+        self._refill(now)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def wait_s(self, now: float) -> float:
+        """Seconds until one token accrues (the retry_after hint)."""
+        self._refill(now)
+        if self.tokens >= 1.0:
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
+@dataclasses.dataclass
+class TenantState:
+    """Per-tenant admission + accounting state."""
+
+    bucket: TokenBucket
+    inflight: int = 0       # client-visible in-flight ops (quota unit)
+    admitted: int = 0
+    completed: int = 0      # S_OK + S_RMW_ABORT
+    retry_after: int = 0    # all front-door refusals
+    shed: int = 0           # refusals by the overload ladder specifically
+    deadline: int = 0
+    rejected: int = 0       # store-level definitive rejects
+    lost: int = 0
+
+    def counters(self) -> dict:
+        return dict(admitted=self.admitted, completed=self.completed,
+                    retry_after=self.retry_after, shed=self.shed,
+                    deadline=self.deadline, rejected=self.rejected,
+                    lost=self.lost, inflight=self.inflight)
+
+
+class AdmissionControl:
+    """The front door: ladder + bucket + quota + queue bound.
+
+    ``admit`` returns ``(reason, retry_after_s)`` — reason ``R_NONE``
+    means admitted (the caller enqueues and calls ``note_admitted``).
+    """
+
+    def __init__(self, scfg):
+        self.scfg = scfg
+        self.tenants: Dict[int, TenantState] = {}
+
+    def tenant(self, t: int) -> TenantState:
+        ts = self.tenants.get(t)
+        if ts is None:
+            ts = self.tenants[t] = TenantState(TokenBucket(
+                self.scfg.tenant_rate_per_s, self.scfg.tenant_burst))
+        return ts
+
+    # -- the overload ladder -------------------------------------------------
+
+    def ladder_level(self, queue_len: int, degraded: bool) -> int:
+        """Rung for the CURRENT pressure: 2 past the read watermark, 1
+        past the write watermark OR while the store is in quorum-loss
+        degraded mode (writes cannot commit — refuse at the door rather
+        than admit a doomed op), else 0."""
+        cap = self.scfg.queue_cap
+        if queue_len >= int(cap * self.scfg.shed_read_frac):
+            return 2
+        if degraded or queue_len >= int(cap * self.scfg.shed_write_frac):
+            return 1
+        return 0
+
+    def admit(self, kind: str, key: int, tenant: int, now: float,
+              queue_len: int, degraded: bool) -> Tuple[int, float]:
+        level = self.ladder_level(queue_len, degraded)
+        ts = self.tenant(tenant)
+        retry_s = self.scfg.retry_after_floor_s
+        if level >= 1 and kind != "get":
+            ts.shed += 1
+            ts.retry_after += 1
+            return wire.R_SHED_WRITE, retry_s
+        if level >= 2 and kind == "get" \
+                and key not in self.scfg.hot_key_set:
+            ts.shed += 1
+            ts.retry_after += 1
+            return wire.R_SHED_READ, retry_s
+        if ts.inflight >= self.scfg.tenant_quota:
+            ts.retry_after += 1
+            return wire.R_QUOTA, retry_s
+        if queue_len >= self.scfg.queue_cap:
+            ts.retry_after += 1
+            return wire.R_QUEUE_FULL, retry_s
+        # the bucket is charged LAST: a quota/queue refusal must not also
+        # burn the tenant's rate budget, or a backed-up tenant re-emerges
+        # from the jam rate-starved by its own refused retries
+        if not ts.bucket.take(now):
+            ts.retry_after += 1
+            return wire.R_RATE, max(retry_s, ts.bucket.wait_s(now))
+        return wire.R_NONE, 0.0
+
+    def note_admitted(self, tenant: int) -> None:
+        ts = self.tenant(tenant)
+        ts.admitted += 1
+        ts.inflight += 1
+
+    def note_resolved(self, tenant: int, status: int) -> None:
+        ts = self.tenant(tenant)
+        ts.inflight -= 1
+        assert ts.inflight >= 0, "tenant inflight went negative"
+        if status in (wire.S_OK, wire.S_RMW_ABORT):
+            ts.completed += 1
+        elif status == wire.S_DEADLINE:
+            ts.deadline += 1
+        elif status == wire.S_REJECTED:
+            ts.rejected += 1
+        elif status == wire.S_LOST:
+            ts.lost += 1
+
+    def counters(self) -> dict:
+        return {t: ts.counters() for t, ts in sorted(self.tenants.items())}

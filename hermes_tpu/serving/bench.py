@@ -1,0 +1,254 @@
+"""Serving-path benchmark cells (round-14, BENCH_LATENCY.json).
+
+End-to-end honesty: every latency here is measured AT THE CLIENT SOCKET
+(t_send just before the framed request hits the socket, t_recv when the
+framed response decodes) through a real localhost ``TcpRpcServer`` —
+not a dispatch-loop estimate.  Two operating points:
+
+  * ``latency`` — small dispatches at ``pipeline_depth >= 2`` with
+    donated state (the round-8 serving pipeline's latency end), open
+    loop at moderate rate: what one op costs the client wall-to-wall.
+    The acceptance bar: its p50 must beat the 28 ms dispatch-loop
+    figure (BENCH_r05's rounds_per_dispatch=50 p50 commit) on the host
+    backend.
+  * ``throughput`` — windowed closed loop (W ops in flight), larger
+    session count: the serving rate the socket path sustains, with the
+    same client-side percentiles.
+
+The scenario matrix runs the latency point over the uniform / zipfian /
+hot-key mixes (seed anchored to CHECKED_ZIPFIAN.json).  Host cells run
+reduced shapes and carry a ``tpu_pending`` note naming the on-chip
+rerun — the PIPELINE_COMPARE / CHAOS_BENCH / FUSED_COMPARE / BENCH_FLEET
+protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from hermes_tpu.serving import wire
+from hermes_tpu.serving.rpc import RpcClient, TcpRpcServer
+from hermes_tpu.serving.server import Frontend, ServingConfig
+from hermes_tpu.workload.openloop import (MixSpec, make_mix, poisson_arrivals,
+                                          scenario_matrix, scenario_seed)
+
+
+# the BENCH_r05 rounds_per_dispatch=50 p50 commit figure the latency
+# operating point is gated against — the ONE source for every drive
+# (run_serve_bench here, cli --bench-latency, bench.py --serve)
+DISPATCH_LOOP_P50_MS = 28.0
+
+
+def improves_dispatch_loop(p50_us: Optional[float]) -> bool:
+    return p50_us is not None and p50_us < DISPATCH_LOOP_P50_MS * 1e3
+
+
+def host_cfg(mode: str, on_tpu: bool = False):
+    """Operating-point store shapes.  Host cells are reduced (the full
+    bench shape is hours of CPU); on a TPU the throughput point should
+    use the bench shape (run there for the artifact refresh)."""
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    kw = dict(value_words=8, replay_slots=16, ops_per_session=64,
+              pipeline_depth=2, op_timeout_rounds=64,
+              workload=WorkloadConfig(read_frac=0.5, seed=0))
+    if mode == "latency":
+        kw.update(n_replicas=8 if on_tpu else 4, n_keys=1 << 10,
+                  n_sessions=8)
+    else:
+        kw.update(n_replicas=8 if on_tpu else 4,
+                  n_keys=1 << (20 if on_tpu else 12),
+                  n_sessions=4096 if on_tpu else 64)
+    return HermesConfig(**kw)
+
+
+def _pctl(sorted_vals: List[float], q: float) -> Optional[float]:
+    from hermes_tpu.stats import percentile_nearest_rank
+
+    return percentile_nearest_rank(sorted_vals, q)
+
+
+def _mk_reqs(client: RpcClient, mix: dict, n: int,
+             deadline_us: int) -> List[wire.Request]:
+    return [wire.Request(
+        kind=("get", "put", "rmw")[int(mix["kind"][i])],
+        req_id=client.next_id(), tenant=int(mix["tenant"][i]),
+        key=int(mix["key"][i]), deadline_us=deadline_us,
+        value=mix["value"][i].tolist()) for i in range(n)]
+
+
+def run_socket_cell(cfg, scfg: ServingConfig, spec: MixSpec, n: int,
+                    mode: str, rate_per_s: float = 0.0, window: int = 16,
+                    deadline_us: int = 0, seed: int = 14,
+                    warmup: int = 16) -> dict:
+    """One measured socket cell: spin a TcpRpcServer over a fresh KVS,
+    drive ``n`` ops (open-loop at ``rate_per_s``, or closed-loop with
+    ``window`` in flight), return client-socket percentiles."""
+    from hermes_tpu.kvs import KVS
+
+    kvs = KVS(cfg)
+    fe = Frontend(kvs, scfg)
+    server = TcpRpcServer(fe)
+    lat_by_status: Dict[str, List[float]] = {}
+    statuses: Dict[str, int] = {}
+    try:
+        client = RpcClient(server.addr, fe.u)
+        warm_mix = make_mix(spec, fe.n_keys, warmup, seed ^ 0xBEEF,
+                            value_words=fe.u)
+        for req in _mk_reqs(client, warm_mix, warmup, 0):
+            client.send(req)
+            client.recv_next()
+        mix = make_mix(spec, fe.n_keys, n, seed, value_words=fe.u)
+        reqs = _mk_reqs(client, mix, n, deadline_us)
+        t_send: Dict[int, float] = {}
+        t_recv: Dict[int, float] = {}
+        rsp_of: Dict[int, wire.Response] = {}
+
+        def recv_loop():
+            # daemon thread: the socket may be closed under it when the
+            # main thread gives up (join timeout on a slow host) — exit
+            # quietly and let the cell report partial counts
+            try:
+                while len(t_recv) < n:
+                    rsp = client.recv_next()
+                    if rsp is None:
+                        return
+                    rsp_of[rsp.req_id] = rsp
+                    t_recv[rsp.req_id] = time.perf_counter()
+            except OSError:
+                return
+
+        t0 = time.perf_counter()
+        if mode == "open":
+            arr = poisson_arrivals(rate_per_s, n, seed)
+            rx = threading.Thread(target=recv_loop, daemon=True)
+            rx.start()
+            for i, req in enumerate(reqs):
+                lead = t0 + arr[i] - time.perf_counter()
+                if lead > 0:
+                    time.sleep(lead)
+                t_send[req.req_id] = time.perf_counter()
+                try:
+                    client.send(req)
+                except OSError:
+                    break  # stream died: the error field reports the loss
+            rx.join(timeout=60.0)
+        else:  # closed loop, window in flight
+            inflight = 0
+            cursor = 0
+            try:
+                while len(t_recv) < n:
+                    while inflight < window and cursor < n:
+                        req = reqs[cursor]
+                        cursor += 1
+                        t_send[req.req_id] = time.perf_counter()
+                        client.send(req)
+                        inflight += 1
+                    rsp = client.recv_next()
+                    if rsp is None:
+                        break
+                    t_recv[rsp.req_id] = time.perf_counter()
+                    rsp_of[rsp.req_id] = rsp
+                    inflight -= 1
+            except OSError:
+                pass  # timeout / reset mid-run: report the partial cell
+                # through the error field instead of crashing the bench
+        wall = time.perf_counter() - t0
+        client.close()
+    finally:
+        server.close()
+    # a cell that lost its server mid-run must say so — percentiles over
+    # an answered prefix would otherwise pass for a clean measurement
+    err = None
+    if server.pump_error is not None:
+        err = f"server pump died: {server.pump_error!r}"
+    elif len(t_recv) < n:
+        err = f"answered {len(t_recv)}/{n} ops (stream died or client gave up)"
+    for rid, t1 in list(t_recv.items()):
+        rsp = rsp_of[rid]
+        statuses[rsp.status_name] = statuses.get(rsp.status_name, 0) + 1
+        lat_by_status.setdefault(rsp.status_name, []).append(
+            (t1 - t_send[rid]) * 1e6)
+    served = sorted(lat_by_status.get("ok", [])
+                    + lat_by_status.get("rmw_abort", []))
+    every = sorted(x for v in lat_by_status.values() for x in v)
+    return dict(
+        mode=mode, scenario=spec.name, ops=n, answered=len(t_recv),
+        wall_s=round(wall, 4),
+        ops_per_sec=round(len(t_recv) / max(wall, 1e-9), 1),
+        statuses=statuses,
+        p50_us=None if not served else round(_pctl(served, 0.5), 1),
+        p99_us=None if not served else round(_pctl(served, 0.99), 1),
+        p50_all_us=None if not every else round(_pctl(every, 0.5), 1),
+        p99_all_us=None if not every else round(_pctl(every, 0.99), 1),
+        rate_per_s=rate_per_s if mode == "open" else None,
+        window=window if mode != "open" else None,
+        pipeline_depth=cfg.pipeline_depth,
+        error=err,
+    )
+
+
+def run_serve_bench(n: Optional[int] = None, seed: Optional[int] = None,
+                    scenarios: bool = True) -> dict:
+    """The BENCH_LATENCY.json payload: latency + throughput operating
+    points (client-socket truth) and the scenario matrix on the latency
+    point."""
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    seed = scenario_seed() if seed is None else seed
+    n = (400 if on_tpu else 200) if n is None else n
+    scfg = ServingConfig(tenant_rate_per_s=1e6, tenant_burst=1e5,
+                         tenant_quota=64, queue_cap=256)
+    lat_cfg = host_cfg("latency", on_tpu)
+    thr_cfg = host_cfg("throughput", on_tpu)
+    # moderate open-loop rate for the latency point: well under the
+    # closed-loop capacity so queueing delay does not pollute the
+    # service-latency number (overload truth lives in the serving gate)
+    cells = {}
+    probe = run_socket_cell(lat_cfg, scfg, MixSpec(name="uniform"),
+                            max(32, n // 4), mode="closed", window=8,
+                            seed=seed)
+    cap = probe["ops_per_sec"]
+    cells["latency"] = run_socket_cell(
+        lat_cfg, scfg, MixSpec(name="uniform"), n, mode="open",
+        rate_per_s=max(10.0, 0.2 * cap), seed=seed)
+    cells["throughput"] = run_socket_cell(
+        thr_cfg, scfg, MixSpec(name="uniform"), 2 * n, mode="closed",
+        window=64, seed=seed)
+    out = dict(
+        cells=cells, capacity_probe=probe,
+        dispatch_loop_p50_ms=DISPATCH_LOOP_P50_MS,
+        latency_p50_improves=improves_dispatch_loop(
+            cells["latency"]["p50_us"]),
+        platform=jax.devices()[0].platform,
+        device=getattr(jax.devices()[0], "device_kind", "?"),
+        seed=seed,
+        note="p50/p99 measured from the client socket (framed RPC over "
+             "localhost TCP), NOT dispatch-loop estimates; "
+             "dispatch_loop_p50_ms is the BENCH_r05 rounds_per_dispatch="
+             "50 figure the latency point is gated against",
+    )
+    if scenarios:
+        mat = {}
+        for spec in scenario_matrix():
+            mat[spec.name] = run_socket_cell(
+                lat_cfg, scfg, spec, max(64, n // 2), mode="open",
+                rate_per_s=max(10.0, 0.2 * cap), seed=seed)
+        out["scenarios"] = mat
+    bad = {name: c["error"]
+           for name, c in [("capacity_probe", probe), *cells.items(),
+                           *out.get("scenarios", {}).items()]
+           if c.get("error")}
+    if bad:
+        out["errors"] = bad
+    if not on_tpu:
+        out["tpu_pending"] = (
+            "host-backend stand-in at reduced shapes — rerun bench.py "
+            "--serve on the chip (throughput point at the bench shape) "
+            "alongside the carried-over PIPELINE_COMPARE.json / "
+            "CHAOS_BENCH.json / FUSED_COMPARE.json / BENCH_FLEET.json "
+            "artifacts")
+    return out

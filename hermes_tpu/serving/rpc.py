@@ -1,0 +1,331 @@
+"""The networked RPC path of the serving front-end (round-14).
+
+Two servers share one ``Frontend``:
+
+  * ``LoopbackServer`` — in-process, byte-honest: every request and
+    response round-trips through the full wire codec (encode -> CRC
+    frame -> unframe -> decode), but no socket or thread exists, so
+    soaks are single-threaded and byte-identically replayable on a
+    ``VirtualClock`` (the CI gate / test path).
+  * ``TcpRpcServer`` — real localhost sockets: one accept thread, one
+    reader thread per connection feeding a locked intake, and one pump
+    thread driving ``Frontend.pump`` — the honest end-to-end path
+    ``bench.py --serve`` measures client-socket p50/p99 on.  Frames ride
+    ``transport.tcp.FramedSocket`` (the round-11 CRC frame layer over a
+    stream socket).
+
+``RpcClient`` is the matching blocking client: ``call`` for one op,
+``send``/``recv_next`` for open-loop pacing (requests in flight while
+more are sent — the Poisson load shape needs a non-lockstep client).
+"""
+
+from __future__ import annotations
+
+import select
+import socket
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from hermes_tpu.serving import wire
+from hermes_tpu.serving.server import Frontend
+
+
+class LoopbackServer:
+    """Byte-honest in-process server: the deterministic soak path."""
+
+    def __init__(self, frontend: Frontend):
+        self.fe = frontend
+        self.u = frontend.u
+        self.wire_rx = 0
+        self.wire_tx = 0
+        self._out: List[bytes] = []
+
+    def _roundtrip_req(self, req: wire.Request) -> wire.Request:
+        from hermes_tpu.transport import codec
+
+        raw = codec.frame_unpack(codec.frame_pack(np.frombuffer(
+            wire.encode_request(req, self.u), np.uint8))).tobytes()
+        self.wire_rx += len(raw) + codec.FRAME_OVERHEAD
+        return wire.decode_request(raw, self.u)
+
+    def submit(self, req: wire.Request) -> Optional[wire.Response]:
+        """One client request through the wire codec + admission.
+        Immediate refusals come back decoded; admitted ops resolve via
+        ``pump``."""
+        rsp = self.fe.submit(self._roundtrip_req(req))
+        if rsp is None:
+            return None
+        return self._encode_out([rsp])[0]
+
+    def pump(self) -> List[wire.Response]:
+        return self._encode_out(self.fe.pump())
+
+    def drain(self, max_rounds: int = 10_000) -> bool:
+        """Pump until the frontend envelope is empty, keeping every
+        response in the byte log (``Frontend.drain`` queues them for
+        ``pop_responses``; this encodes them in emission order)."""
+        ok = self.fe.drain(max_rounds)
+        self._encode_out(self.fe.pop_responses())
+        return ok
+
+    def _encode_out(self, rsps) -> List[wire.Response]:
+        out = []
+        for rsp in rsps:
+            raw = wire.encode_response(rsp, self.u)
+            self.wire_tx += len(raw)
+            self._out.append(raw)
+            out.append(wire.decode_response(raw, self.u))
+        return out
+
+    def response_log(self) -> bytes:
+        """Concatenated response bytes in emission order — the
+        determinism witness (same seed + config => byte-identical)."""
+        return b"".join(self._out)
+
+
+class TcpRpcServer:
+    """Threaded localhost RPC server over CRC-framed sockets."""
+
+    def __init__(self, frontend: Frontend, host: str = "127.0.0.1",
+                 port: int = 0, pump_sleep_s: float = 0.0002):
+        from hermes_tpu.transport.tcp import FramedSocket
+
+        self.fe = frontend
+        self.u = frontend.u
+        self._FramedSocket = FramedSocket
+        self._lock = threading.Lock()
+        # client req_ids are only unique PER CONNECTION (wire.py): the
+        # server re-mints each into a globally unique internal id before
+        # submit, and maps it back on send — two connections using the
+        # same req_id can never collide in the frontend's pending map or
+        # steal each other's responses
+        self._next_iid = 1
+        self._conn_of: Dict[int, tuple] = {}  # iid -> (FramedSocket, rid)
+        self.undecodable = 0  # frame-valid requests refused undecoded
+        self._stop = threading.Event()
+        self.pump_error: Optional[BaseException] = None
+        self._pump_sleep = pump_sleep_s
+        self._threads: List[threading.Thread] = []
+        self._conns: List = []
+        self._listener = socket.create_server((host, port))
+        self.addr = self._listener.getsockname()
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._pump_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- server side ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._listener.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                sock, _peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # bound SENDS only (SO_SNDTIMEO, not settimeout — the reader
+            # thread must keep blocking on recv indefinitely): a client
+            # that stops reading fills its kernel buffer, and an
+            # unbounded sendall would wedge the pump thread's send pass.
+            # Sends happen OUTSIDE the frontend lock, so a stalled send
+            # never blocks intake or other connections' submits; it can
+            # still delay the pump's send pass by up to this bound once,
+            # after which the send raises and the slow client's stream
+            # dies — server-wide service survives one non-reading client.
+            import struct as _struct
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            _struct.pack("ll", 1, 0))
+            # CRC failures on implausible frame lengths tear the stream
+            # down instead of desyncing it: requests are fixed-size
+            fsock = self._FramedSocket(
+                sock, expect_lens={wire.req_nbytes(self.u)})
+            self._conns.append(fsock)
+            t = threading.Thread(target=self._reader_loop, args=(fsock,),
+                                 daemon=True)
+            t.start()
+            # prune finished reader threads so a long-lived server's
+            # thread list doesn't grow with every connection ever made
+            self._threads = [th for th in self._threads if th.is_alive()]
+            self._threads.append(t)
+
+    def _reader_loop(self, fsock) -> None:
+        try:
+            self._reader_body(fsock)
+        finally:
+            fsock.close()
+            try:
+                self._conns.remove(fsock)
+            except ValueError:
+                pass
+
+    def _reader_body(self, fsock) -> None:
+        while not self._stop.is_set():
+            # batch intake: one blocking recv, then drain everything the
+            # socket already buffered, and submit the whole batch under
+            # ONE lock acquisition — the pump thread holds the lock for a
+            # full store round at a time, so per-message locking would
+            # throttle intake to ~1 request per round
+            try:
+                raw = fsock.recv()
+            except Exception:
+                return
+            if raw is None:
+                return
+            raws = [raw]
+            while select.select([fsock.sock], [], [], 0)[0]:
+                try:
+                    more = fsock.recv()
+                except Exception:
+                    more = None
+                if more is None:
+                    break
+                raws.append(more)
+            reqs = []
+            for raw in raws:
+                try:
+                    reqs.append(wire.decode_request(raw, self.u))
+                except ValueError:
+                    # frame-valid but undecodable (payload-width/magic
+                    # mismatch): refuse LOUDLY when the header still
+                    # yields a req_id — never leave the client to time
+                    # out on silence.  No lock needed: FramedSocket.send
+                    # serializes itself, so the pump thread's concurrent
+                    # sends on this socket can't splice frames.
+                    rid = wire.peek_req_id(raw)
+                    self.undecodable += 1
+                    if rid is not None:
+                        try:
+                            fsock.send(wire.encode_response(
+                                wire.Response(
+                                    status=wire.S_REJECTED, req_id=rid,
+                                    found=False), self.u))
+                        except OSError:
+                            fsock.close()
+                            return
+            outs = []
+            with self._lock:
+                for req in reqs:
+                    iid, self._next_iid = self._next_iid, self._next_iid + 1
+                    self._conn_of[iid] = (fsock, req.req_id)
+                    req.req_id = iid
+                    rsp = self.fe.submit(req)
+                    if rsp is not None:  # immediate refusal
+                        out = self._resolve_locked(rsp)
+                        if out is not None:
+                            outs.append(out)
+            # send OUTSIDE the lock: a non-reading client stalls only
+            # its own reader thread here, never the frontend
+            for conn, rsp in outs:
+                self._send_out(conn, rsp)
+
+    def _resolve_locked(self, rsp: wire.Response):
+        """Swap the internal id back for the client's req_id; returns
+        ``(fsock, rsp)`` ready to send, or None for an unknown (already
+        torn down) connection.  Caller holds ``self._lock``."""
+        ent = self._conn_of.pop(rsp.req_id, None)
+        if ent is None:
+            return None
+        fsock, client_rid = ent
+        rsp.req_id = client_rid
+        return fsock, rsp
+
+    def _send_out(self, fsock, rsp: wire.Response) -> None:
+        try:
+            fsock.send(wire.encode_response(rsp, self.u))
+        except OSError:
+            # send timed out or failed mid-frame: the stream boundary is
+            # gone, so the connection is unusable — tear it down
+            fsock.close()
+
+    def _pump_loop(self) -> None:
+        import time as _time
+
+        fe = self.fe
+        while not self._stop.is_set():
+            with self._lock:
+                busy = bool(fe._intake or fe._pending or fe._abandoned)
+            if not busy:
+                _time.sleep(0.001)  # idle: don't spin the store
+                continue
+            try:
+                with self._lock:
+                    outs = [out for out in map(self._resolve_locked,
+                                               fe.pump()) if out]
+            except Exception as e:  # noqa: BLE001 — store died (e.g.
+                # StuckOpError): a silently dead pump thread would leave
+                # every connected client hanging on its socket timeout.
+                # Fail LOUDLY instead: record, stop, and close every
+                # stream so clients see EOF now.
+                self.pump_error = e
+                self._stop.set()
+                for fsock in list(self._conns):
+                    fsock.close()
+                raise
+            # sends OUTSIDE the lock: a stalled client blocks this send
+            # pass (bounded by SO_SNDTIMEO) but never the reader
+            # threads' intake path
+            for fsock, rsp in outs:
+                self._send_out(fsock, rsp)
+            # ALWAYS yield between pumps: Python locks are unfair, and a
+            # tight re-acquire starves the reader threads' submit path —
+            # requests would sit unsubmitted for whole pump generations
+            _time.sleep(self._pump_sleep)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        # close every accepted connection: reader threads blocked in
+        # fsock.recv() only exit when their socket dies
+        for fsock in list(self._conns):
+            fsock.close()
+        for t in list(self._threads):
+            t.join(timeout=2.0)
+
+
+class RpcClient:
+    """Blocking client over one CRC-framed socket."""
+
+    def __init__(self, addr, u: int, timeout_s: float = 30.0):
+        from hermes_tpu.transport.tcp import FramedSocket
+
+        sock = socket.create_connection(addr, timeout=timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.fsock = FramedSocket(sock,
+                                  expect_lens={wire.rsp_nbytes(u)})
+        self.u = u
+        self._next_id = 1
+
+    def next_id(self) -> int:
+        rid, self._next_id = self._next_id, self._next_id + 1
+        return rid
+
+    def send(self, req: wire.Request) -> None:
+        self.fsock.send(wire.encode_request(req, self.u))
+
+    def recv_next(self) -> Optional[wire.Response]:
+        raw = self.fsock.recv()
+        if raw is None:
+            return None
+        return wire.decode_response(raw, self.u)
+
+    def call(self, kind: str, key: int, value=None, tenant: int = 0,
+             deadline_us: int = 0) -> wire.Response:
+        req = wire.Request(kind=kind, req_id=self.next_id(), tenant=tenant,
+                           key=key, deadline_us=deadline_us, value=value)
+        self.send(req)
+        rsp = self.recv_next()
+        if rsp is None:
+            raise ConnectionError("server closed mid-call")
+        return rsp
+
+    def close(self) -> None:
+        self.fsock.close()

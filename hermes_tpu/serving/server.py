@@ -1,0 +1,442 @@
+"""The serving front-end (round-14): the robustness envelope between
+clients and the replicated store.
+
+``Frontend`` owns one ``kvs.KVS`` (single group) or ``fleet.Fleet``
+(key-routed groups — the fleet-aware serving front-end of ROADMAP item
+2) and drives client RPCs through it:
+
+  * ADMISSION (serving/admission.py): overload ladder -> per-tenant
+    session quota -> bounded intake queue -> per-tenant token bucket
+    (charged last — refusals never burn rate budget).
+    Every refusal is a loud ``S_RETRY_AFTER`` with a reason and a retry
+    hint — queue-full is an explicit wire signal, never silent
+    buffering.
+  * DEADLINES: the client's relative deadline is stamped absolute at
+    intake; an op that expires in the intake queue resolves
+    ``S_DEADLINE`` WITHOUT being injected, and an admitted op that
+    out-ages its deadline resolves ``S_DEADLINE`` at the completion
+    scan (for updates a deadline is a MAYBE — the broadcast may still
+    commit, exactly the crash-'lost' semantics; the abandoned future is
+    kept until the store resolves it so quota accounting stays exact).
+  * SHED LADDER: rung transitions land on the obs timeline as
+    ``shed``/``shed_clear`` events and per-tenant counters; rung 1
+    composes with the store's ``min_healthy_for_writes`` degraded mode
+    (degraded => writes shed at the front door).
+  * WATCHDOG TAGS: the round-9 stuck-op diagnostics (and
+    ``StuckOpError``) carry the op's tenant id and remaining deadline
+    budget through ``kvs.diag_hook`` — the ``drill=``/``net_phase``
+    pattern, per op.
+
+The clock is caller-supplied: ``VirtualClock`` for deterministic soaks
+(the driver advances it by ``scfg.round_us`` per pump — same-seed runs
+replay byte-identically, the chaos-schedule discipline applied to
+serving), ``time.monotonic`` under real sockets (serving/rpc.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hermes_tpu.serving import wire
+from hermes_tpu.serving.admission import AdmissionControl
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Front-end envelope knobs (one frozen dataclass, config.py style)."""
+
+    tenant_rate_per_s: float = 4000.0   # sustained per-tenant admission rate
+    tenant_burst: float = 64.0          # token-bucket burst
+    tenant_quota: int = 32              # client-visible in-flight cap/tenant
+    queue_cap: int = 128                # bounded intake queue
+    shed_write_frac: float = 0.6        # ladder rung 1 at this queue fill
+    shed_read_frac: float = 0.9         # ladder rung 2 at this queue fill
+    hot_keys: Tuple[int, ...] = ()      # reads on these survive rung 2
+    default_deadline_us: int = 0        # applied when a request carries 0
+    round_us: int = 1000                # virtual microseconds per pump
+    retry_after_floor_s: float = 0.001  # minimum retry hint
+    store_inflight_cap: Optional[int] = None  # ops handed to the store at
+    # once (None = one per store session lane); the intake queue holds the
+    # rest — THAT bound is what makes backpressure observable
+    resp_meta_cap: int = 1 << 17  # per-response (tenant, status, latency)
+    # retention ring: exact for the finite soak/bench drivers (which size
+    # well under it), bounded for a long-lived TCP server — the always-on
+    # exact accounting is AdmissionControl's counters, not this ring
+
+    def __post_init__(self) -> None:
+        if self.tenant_quota < 1 or self.queue_cap < 1:
+            raise ValueError("tenant_quota and queue_cap must be >= 1")
+        if not (0.0 < self.shed_write_frac <= self.shed_read_frac <= 1.0):
+            raise ValueError(
+                "want 0 < shed_write_frac <= shed_read_frac <= 1 (writes "
+                "shed first, then non-hot reads)")
+        if self.round_us <= 0:
+            raise ValueError("round_us must be > 0")
+        if self.resp_meta_cap < 1:
+            raise ValueError("resp_meta_cap must be >= 1")
+        object.__setattr__(self, "hot_key_set", frozenset(
+            int(k) for k in self.hot_keys))
+
+
+class VirtualClock:
+    """Deterministic serving clock: the soak driver advances it."""
+
+    def __init__(self, t0: float = 0.0):
+        self.t = float(t0)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, ds: float) -> None:
+        self.t += ds
+
+
+class Frontend:
+    """One serving front-end over a KVS or Fleet facade."""
+
+    def __init__(self, store, scfg: Optional[ServingConfig] = None,
+                 clock=None):
+        self.store = store
+        self.scfg = scfg or ServingConfig()
+        self.is_fleet = hasattr(store, "router") and hasattr(store, "groups")
+        base = store.cfg.base if self.is_fleet else store.cfg
+        self.u = base.value_words - 2
+        if self.u < 1:
+            raise ValueError("serving needs value_words >= 3 (the store "
+                             "carries write uids in words 0-1)")
+        self.n_keys = (store.cfg.total_keys if self.is_fleet
+                       else base.n_keys)
+        self.clock = clock if clock is not None else time.monotonic
+        self.adm = AdmissionControl(self.scfg)
+        self._intake: collections.deque = collections.deque()
+        self._pending: Dict[int, dict] = {}   # req_id -> entry (admit order)
+        self._abandoned: List[dict] = []      # RPC resolved, store op open
+        self._responses: List[wire.Response] = []
+        self._resp_meta: collections.deque = collections.deque(
+            maxlen=self.scfg.resp_meta_cap)   # (tenant, status, latency_s)
+        self._lane_seq: Dict[int, int] = collections.defaultdict(int)
+        self.requests = 0
+        self.responses = 0
+        self.shed_level = 0
+        self._fleet_deg: Optional[bool] = None  # any-group scan, per round
+        self._lanes: List[tuple] = []
+        if self.is_fleet:
+            cap = sum(g.cfg.n_replicas * g.cfg.n_sessions
+                      for g in store.groups)
+            for g in store.groups:
+                g.kvs.diag_hook = (
+                    lambda r, s, _g=g.gid: self._diag_for(_g, r, s))
+        else:
+            cfg = store.cfg
+            cap = cfg.n_replicas * cfg.n_sessions
+            self._lanes = [(r, s) for r in range(cfg.n_replicas)
+                           for s in range(cfg.n_sessions)]
+            store.diag_hook = lambda r, s: self._diag_for(None, r, s)
+        self._store_cap = (self.scfg.store_inflight_cap
+                           if self.scfg.store_inflight_cap is not None
+                           else cap)
+        self._store_inflight = 0
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _rt(self):
+        return (self.store.groups[0].rt if self.is_fleet else self.store.rt)
+
+    def _trace(self, name: str, **fields) -> None:
+        rt = self._rt()
+        rt._trace(name, **fields)
+        if rt.obs is not None:
+            rt.obs.registry.counter(f"serving_{name}").inc()
+
+    def _count(self, name: str, n: int = 1) -> None:
+        rt = self._rt()
+        if rt.obs is not None:
+            rt.obs.registry.counter(f"serving_{name}").inc(n)
+
+    def _degraded_for_key(self, key: int) -> bool:
+        if self.is_fleet:
+            return self.store.degraded(key)
+        return self.store.degraded()
+
+    def _diag_for(self, group, r, s) -> Optional[dict]:
+        """Watchdog tag lookup: the oldest un-resolved op on lane
+        (group, r, s) names its tenant + remaining deadline budget.
+        Abandoned entries (RPC already resolved S_DEADLINE, store op
+        still open) are scanned too — a long-stuck op has usually
+        out-aged its deadline by the time the watchdog fires."""
+        now = self.clock()
+        for entry in list(self._pending.values()) + self._abandoned:
+            if entry.get("lane") == (group, r, s):
+                d = dict(tenant=entry["req"].tenant)
+                if entry["deadline"] is not None:
+                    d["deadline_left_us"] = int(
+                        round((entry["deadline"] - now) * 1e6))
+                return d
+        return None
+
+    def _update_level(self, degraded: Optional[bool] = None,
+                      fresh: bool = True) -> None:
+        # non-fleet degradation is key-independent, so submit can hand us
+        # the value it already computed; fleet ladder pressure is the
+        # any-group scan regardless of the op's key — and that scan can
+        # only change when membership does (once per store round), so the
+        # per-request path (fresh=False) reuses the last pump's scan
+        # instead of walking every group's healthy set per request
+        if self.is_fleet:
+            if fresh or self._fleet_deg is None:
+                self._fleet_deg = any(g.kvs.degraded()
+                                      for g in self.store.groups)
+            degraded = self._fleet_deg
+        elif degraded is None:
+            degraded = self._degraded_for_key(0)
+        level = self.adm.ladder_level(len(self._intake), degraded)
+        if level != self.shed_level:
+            if level > 0:
+                self._trace("shed", level=level, queue=len(self._intake))
+            else:
+                self._trace("shed_clear", queue=len(self._intake))
+            self.shed_level = level
+
+    def _respond(self, rsp: wire.Response, tenant: int,
+                 latency_s: Optional[float] = None,
+                 queue: bool = True) -> wire.Response:
+        # queue=False: an immediate refusal submit() hands straight back
+        # to its caller — accounted here, but NOT queued for pump(), or
+        # the transport would deliver it a second time (and on the TCP
+        # path the re-send would carry the restored CLIENT req_id, which
+        # can collide with another connection's pending internal id)
+        if queue:
+            self._responses.append(rsp)
+        self._resp_meta.append((tenant, rsp.status, latency_s))
+        self.responses += 1
+        return rsp
+
+    def pop_responses(self) -> List[wire.Response]:
+        out, self._responses = self._responses, []
+        return out
+
+    # -- intake --------------------------------------------------------------
+
+    def submit(self, req: wire.Request) -> Optional[wire.Response]:
+        """Run one request through admission.  Returns an immediate
+        refusal Response, or None when admitted (the resolution arrives
+        from a later ``pump``)."""
+        now = self.clock()
+        self.requests += 1
+        if req.kind not in ("get", "put", "rmw") \
+                or not (0 <= req.key < self.n_keys):
+            return self._respond(wire.Response(
+                status=wire.S_REJECTED, req_id=req.req_id), req.tenant,
+                queue=False)
+        degraded = self._degraded_for_key(req.key)
+        self._update_level(degraded, fresh=False)
+        reason, wait = self.adm.admit(req.kind, req.key, req.tenant, now,
+                                      len(self._intake), degraded)
+        if reason != wire.R_NONE:
+            self._count("retry_after")
+            return self._respond(wire.Response(
+                status=wire.S_RETRY_AFTER, req_id=req.req_id, reason=reason,
+                retry_after_us=int(math.ceil(wait * 1e6))), req.tenant,
+                queue=False)
+        self.adm.note_admitted(req.tenant)
+        dl_us = req.deadline_us or self.scfg.default_deadline_us
+        self._intake.append(dict(
+            req=req, t_admit=now,
+            deadline=(now + dl_us * 1e-6) if dl_us else None))
+        return None
+
+    # -- the pump ------------------------------------------------------------
+
+    def _issue(self, entry: dict) -> None:
+        """Hand one admitted op to the store on a deterministic lane."""
+        req = entry["req"]
+        seq = self._lane_seq[req.tenant]
+        self._lane_seq[req.tenant] = seq + 1
+        value = req.value if req.kind != "get" else None
+        if self.is_fleet:
+            session = req.tenant * 7919 + seq
+            fut, lane = self.store.route_op(req.kind, session, req.key,
+                                            value)
+            entry["lane"] = lane
+        else:
+            r, s = self._lanes[(req.tenant * 7919 + seq) % len(self._lanes)]
+            entry["lane"] = (None, r, s)
+            fut = getattr(self.store, req.kind)(r, s, req.key, *(
+                (value,) if value is not None else ()))
+        entry["fut"] = fut
+        self._pending[req.req_id] = entry
+        self._store_inflight += 1
+
+    _STATUS = {"get": wire.S_OK, "put": wire.S_OK, "rmw": wire.S_OK,
+               "rmw_abort": wire.S_RMW_ABORT, "lost": wire.S_LOST,
+               "rejected": wire.S_REJECTED}
+
+    def _result_response(self, entry: dict) -> wire.Response:
+        req = entry["req"]
+        c = entry["fut"].result()
+        rsp = wire.Response(status=self._STATUS[c.kind], req_id=req.req_id,
+                            found=c.found, step=c.step)
+        if c.value is not None:
+            rsp.value = c.value
+        if c.uid is not None:
+            rsp.uid = c.uid
+        return rsp
+
+    def pump(self) -> List[wire.Response]:
+        """One serving round: issue from the intake queue (deadline-
+        checked), run one store round, harvest completions and expired
+        deadlines.  Returns the responses produced this round."""
+        now = self.clock()
+        # intake expiry FIRST, over the whole queue — an op stuck behind a
+        # full store must still resolve S_DEADLINE on time, not wait for
+        # its pop turn
+        if self._intake:
+            keep = collections.deque()
+            for entry in self._intake:
+                req = entry["req"]
+                if entry["deadline"] is not None and now > entry["deadline"]:
+                    self.adm.note_resolved(req.tenant, wire.S_DEADLINE)
+                    self._count("deadline")
+                    self._respond(wire.Response(
+                        status=wire.S_DEADLINE, req_id=req.req_id,
+                        found=False), req.tenant, now - entry["t_admit"])
+                else:
+                    keep.append(entry)
+            self._intake = keep
+        # intake -> store (expired ops were resolved above, never injected)
+        while self._intake and self._store_inflight < self._store_cap:
+            self._issue(self._intake.popleft())
+        self.store.step()
+        now = self.clock()
+        # harvest completions + completion-side deadline enforcement
+        done_ids = []
+        for rid, entry in self._pending.items():
+            fut = entry["fut"]
+            late = (entry["deadline"] is not None
+                    and now > entry["deadline"])
+            if fut.done():
+                rsp = (wire.Response(status=wire.S_DEADLINE, req_id=rid,
+                                     found=False) if late
+                       else self._result_response(entry))
+                if late:
+                    self._count("deadline")
+                self.adm.note_resolved(entry["req"].tenant, rsp.status)
+                self._respond(rsp, entry["req"].tenant,
+                              now - entry["t_admit"])
+                self._store_inflight -= 1
+                done_ids.append(rid)
+            elif late:
+                # the RPC resolves NOW; the store op stays abandoned until
+                # the protocol finishes it (quota freed, lane not yet)
+                self.adm.note_resolved(entry["req"].tenant, wire.S_DEADLINE)
+                self._count("deadline")
+                self._respond(wire.Response(
+                    status=wire.S_DEADLINE, req_id=rid, found=False),
+                    entry["req"].tenant, now - entry["t_admit"])
+                self._abandoned.append(entry)
+                done_ids.append(rid)
+        for rid in done_ids:
+            del self._pending[rid]
+        still = []
+        for entry in self._abandoned:
+            if entry["fut"].done():
+                self._store_inflight -= 1
+            else:
+                still.append(entry)
+        self._abandoned = still
+        self._update_level()
+        return self.pop_responses()
+
+    def flush(self) -> List[wire.Response]:
+        """Force the store's deferred (pipelined) completions out and
+        harvest them."""
+        if self.is_fleet:
+            self.store.flush()
+        else:
+            self.store.flush()
+            self.store.rt.flush_pipeline()
+        return self.pump()
+
+    def drain(self, max_rounds: int = 10_000) -> bool:
+        """Pump until every admitted op (including abandoned deadline
+        maybes) resolves.  True when fully drained.  The responses
+        produced while draining stay queued for ``pop_responses`` — a
+        drained op resolved loudly, so its Response must remain
+        observable, not vanish into the drain loop."""
+        kept: List[wire.Response] = []
+        done = False
+        for _ in range(max_rounds):
+            if not (self._intake or self._pending or self._abandoned):
+                # a drained envelope is the ladder's floor: re-evaluate so
+                # a pressure-driven rung emits its shed_clear even when no
+                # further request arrives to observe it
+                self._update_level()
+                done = True
+                break
+            kept.extend(self.pump())
+        if not done:
+            kept.extend(self.flush())
+        self._responses = kept + self._responses
+        return not (self._intake or self._pending or self._abandoned)
+
+    # -- accounting ----------------------------------------------------------
+
+    def latencies(self, statuses=(wire.S_OK, wire.S_RMW_ABORT,
+                                  wire.S_DEADLINE, wire.S_REJECTED,
+                                  wire.S_LOST)) -> List[float]:
+        """Admission-to-resolution latency (serving clock, seconds) of
+        every ADMITTED op whose terminal status is in ``statuses``."""
+        return [lat for _t, st, lat in self._resp_meta
+                if st in statuses and lat is not None]
+
+    def counters(self) -> dict:
+        per = self.adm.counters()
+        agg: Dict[str, int] = {}
+        for row in per.values():
+            for k, v in row.items():
+                agg[k] = agg.get(k, 0) + v
+        return dict(requests=self.requests, responses=self.responses,
+                    shed_level=self.shed_level, queue=len(self._intake),
+                    store_inflight=self._store_inflight,
+                    tenants=per, fleet=self.is_fleet, totals=agg)
+
+
+def verify_serving(fe: Frontend) -> dict:
+    """Serving envelope invariants (run after a drained soak):
+
+      1. response conservation — every request produced exactly ONE
+         response (refusal or resolution; nothing silently buffered or
+         dropped);
+      2. admission accounting exactness — per tenant,
+         admitted == completed + deadline + rejected + lost and the
+         in-flight count is back to zero;
+      3. the envelope is empty — intake queue, pending map, and
+         abandoned list all drained.
+
+    Raises AssertionError on the first violation; returns evidence.
+    """
+    assert fe.requests == fe.responses, (
+        f"response conservation broken: {fe.requests} requests but "
+        f"{fe.responses} responses")
+    for t, row in fe.adm.counters().items():
+        assert row["inflight"] == 0, (
+            f"tenant {t} still shows {row['inflight']} in flight")
+        resolved = (row["completed"] + row["deadline"] + row["rejected"]
+                    + row["lost"])
+        assert row["admitted"] == resolved, (
+            f"tenant {t} admission accounting broken: "
+            f"admitted={row['admitted']} != resolved={resolved} ({row})")
+    assert not fe._intake and not fe._pending and not fe._abandoned, (
+        "serving envelope not empty after drain")
+    agg = fe.counters()["totals"]
+    return dict(requests=fe.requests, responses=fe.responses,
+                admitted=agg.get("admitted", 0),
+                completed=agg.get("completed", 0),
+                deadline=agg.get("deadline", 0),
+                retry_after=agg.get("retry_after", 0),
+                shed=agg.get("shed", 0),
+                rejected=agg.get("rejected", 0), lost=agg.get("lost", 0))

@@ -1,0 +1,191 @@
+"""Deterministic open-loop serving soaks (round-14).
+
+One driver for the CI gate, the CLI quickstart, and the tests: a seeded
+Poisson arrival schedule (optionally shaped by chaos ``overload``
+windows) drives a byte-honest ``LoopbackServer`` on a ``VirtualClock``
+that advances ``scfg.round_us`` per pump — so a soak is a pure function
+of (store config, serving config, mix spec, rate, seed): the executed
+response byte log replays IDENTICALLY, the chaos-schedule determinism
+contract applied to overload.
+
+Capacity measurement (``measure_capacity``) is closed-loop: every store
+lane kept full, throughput service-bound — the honest denominator for
+"soak at >= 2x capacity".
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from hermes_tpu.serving import wire
+from hermes_tpu.serving.rpc import LoopbackServer
+from hermes_tpu.stats import percentile_nearest_rank
+from hermes_tpu.serving.server import (Frontend, ServingConfig, VirtualClock,
+                                       verify_serving)
+from hermes_tpu.workload.openloop import (ClosedLoop, MixSpec, ShapedArrivals,
+                                          make_mix)
+
+
+def measure_capacity(store, scfg: ServingConfig, spec: MixSpec, n: int,
+                     seed: int) -> dict:
+    """Closed-loop service rate through the full serving path: ``n`` ops
+    offered as fast as admission refills, every refusal retried next
+    round (closed-loop clients wait, they don't walk away).  Returns
+    ops/virtual-second + ops/round."""
+    clock = VirtualClock()
+    fe = Frontend(store, scfg, clock=clock)
+    lb = LoopbackServer(fe)
+    cl = ClosedLoop(spec, fe.n_keys, n, seed, value_words=fe.u)
+    round_s = scfg.round_us * 1e-6
+    resolved, retry = 0, []
+    rounds = 0
+    next_rid = 1
+    while resolved < n and rounds < 100_000:
+        # closed-loop offer: retries first, then fresh ops, until the
+        # front door refuses (rate/quota/queue) or the mix runs dry
+        offer = retry
+        retry = []
+        while True:
+            if offer:
+                req = offer.pop(0)
+            else:
+                op = cl.next_op()
+                if op is None:
+                    break
+                req = wire.Request(
+                    kind=op["kind"], req_id=next_rid, tenant=op["tenant"],
+                    key=op["key"], value=op["value"])
+                next_rid += 1
+            rsp = lb.submit(req)
+            if rsp is not None:
+                if rsp.status == wire.S_RETRY_AFTER:
+                    # the door is closed this round: stash this op AND
+                    # everything still waiting behind it for the next one
+                    retry.append(req)
+                    retry.extend(offer)
+                    break
+                resolved += 1
+        resolved += len(lb.pump())
+        clock.advance(round_s)
+        rounds += 1
+    lb.drain()
+    done = fe.counters()["totals"]
+    served = (done.get("completed", 0) + done.get("deadline", 0)
+              + done.get("rejected", 0) + done.get("lost", 0))
+    ops_per_round = served / max(1, rounds)
+    return dict(ops=served, rounds=rounds,
+                ops_per_round=round(ops_per_round, 3),
+                ops_per_vs=round(ops_per_round / round_s, 1))
+
+
+def run_open_loop(store, scfg: ServingConfig, spec: MixSpec,
+                  rate_per_s: float, n: int, seed: int, deadline_us: int,
+                  chaos_runner=None, arrivals: Optional[ShapedArrivals] = None,
+                  max_rounds: int = 200_000) -> dict:
+    """The open-loop Poisson soak: arrivals fire on THEIR schedule (the
+    client does not wait for the server), every request resolves loudly,
+    and the whole run replays byte-identically from the seed.
+
+    ``chaos_runner``: an already-constructed ``chaos.ChaosRunner`` over
+    ``store`` (its ``load=`` may be the arrival schedule for overload
+    verbs); it is TICKED each round — the frontend pump is what steps
+    the store.  Returns the summary dict (responses stay on the
+    LoopbackServer for byte-log comparison).
+    """
+    clock = VirtualClock()
+    fe = Frontend(store, scfg, clock=clock)
+    lb = LoopbackServer(fe)
+    if chaos_runner is not None and chaos_runner.load is not None:
+        # the runner's shaper and the soak's arrival schedule must be ONE
+        # object, or the overload verbs shape a schedule nobody consumes
+        # (the silent-skip failure mode the net-fault routability rule
+        # exists to prevent)
+        if arrivals is None:
+            arrivals = chaos_runner.load
+        elif arrivals is not chaos_runner.load:
+            raise ValueError("chaos_runner.load and arrivals= are "
+                             "different objects: the overload storm would "
+                             "shape a schedule this soak never consumes")
+    if arrivals is None:
+        arrivals = ShapedArrivals(rate_per_s, n, seed)
+    if chaos_runner is not None and chaos_runner.load is None \
+            and any(e.kind.startswith("overload")
+                    for e in chaos_runner.schedule):
+        raise ValueError("chaos schedule has overload verbs: construct "
+                         "ChaosRunner(..., load=arrivals) and pass the "
+                         "same arrivals here")
+    mix = make_mix(spec, fe.n_keys, n, seed, value_words=fe.u)
+    round_s = scfg.round_us * 1e-6
+    sent = 0
+    rounds = 0
+    while rounds < max_rounds:
+        if chaos_runner is not None:
+            chaos_runner.tick(rounds)
+        k = arrivals.due(clock.t)
+        for _ in range(k):
+            if sent >= n:
+                break
+            i = sent
+            req = wire.Request(
+                kind=("get", "put", "rmw")[int(mix["kind"][i])],
+                req_id=i + 1, tenant=int(mix["tenant"][i]),
+                key=int(mix["key"][i]), deadline_us=deadline_us,
+                value=mix["value"][i].tolist())
+            sent += 1
+            lb.submit(req)
+        lb.pump()
+        clock.advance(round_s)
+        rounds += 1
+        if sent >= n and not (fe._intake or fe._pending or fe._abandoned):
+            break
+    lb.drain()
+    # one authoritative status census off the response meta (covers both
+    # submit()-time refusals and pump()-time resolutions)
+    statuses: dict = {}
+    for _t, st, _lat in fe._resp_meta:
+        name = wire.STATUS_NAMES[st]
+        statuses[name] = statuses.get(name, 0) + 1
+    lat = sorted(fe.latencies())
+    pctl = lambda q: percentile_nearest_rank(lat, q)
+    ev = verify_serving(fe)
+    totals = fe.counters()["totals"]
+    return dict(
+        ops_offered=n, sent=sent, rounds=rounds,
+        statuses=statuses, admitted=ev["admitted"],
+        retry_after=ev["retry_after"], shed=ev["shed"],
+        deadline=ev["deadline"], lost=ev["lost"],
+        completed=ev["completed"], rejected=ev["rejected"],
+        p50_latency_us=(None if pctl(0.5) is None
+                        else round(pctl(0.5) * 1e6, 1)),
+        p99_latency_us=(None if pctl(0.99) is None
+                        else round(pctl(0.99) * 1e6, 1)),
+        deadline_us=deadline_us,
+        virtual_seconds=round(clock.t, 6),
+        response_log_sha=_sha(lb.response_log()),
+        tenants=fe.counters()["tenants"],
+        _frontend=fe, _server=lb,
+    )
+
+
+def _sha(b: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(b).hexdigest()
+
+
+def committed_uids(fe: Frontend, lb: LoopbackServer) -> List[tuple]:
+    """Write uids the CLIENT saw commit (S_OK puts/rmws) — the
+    ``committed_write_lost`` witness set."""
+    out = []
+    u = lb.u
+    off = 0
+    raw = lb.response_log()
+    step = wire.rsp_nbytes(u)
+    while off + step <= len(raw):
+        rsp = wire.decode_response(raw[off: off + step], u)
+        off += step
+        if rsp.status == wire.S_OK and rsp.uid is not None:
+            out.append(rsp.uid)
+    return out

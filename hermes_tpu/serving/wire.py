@@ -1,0 +1,188 @@
+"""RPC wire format of the serving front-end (round-14).
+
+One client op = one fixed-size REQUEST message; one resolution = one
+fixed-size RESPONSE.  Both are little-endian structs followed by the
+config's fixed value-payload width (``value_words - 2`` int32 words —
+the same "both ends derive the layout from the same config" discipline
+as the replica wire codec, transport/codec.py), so a message's byte
+length is known from the config alone.  Every message that crosses a
+real socket rides a checksummed CRC frame (``codec.frame_pack`` /
+``frame_unpack`` — the round-11 frame layer): corruption is detected on
+receipt and the frame is dropped, never decoded into a scrambled
+key/deadline/tenant.
+
+Deadlines are RELATIVE microseconds in the request (0 = none); the
+server stamps the absolute expiry on intake against ITS clock, so a
+client never needs clock sync to bound its wait.  The response echoes
+``req_id`` (client-chosen, unique per connection) and carries either the
+op result or a loud refusal:
+
+  * ``S_RETRY_AFTER`` — admission control / backpressure / load shed;
+    ``retry_after_us`` is the server's earliest-retry hint and ``reason``
+    says which rung refused (queue_full / quota / rate / shed_write /
+    shed_read) — queue-full is an explicit signal, NEVER silent
+    buffering;
+  * ``S_DEADLINE`` — the op's deadline expired (at intake or at
+    completion).  For updates this is a MAYBE: the broadcast may still
+    commit (exactly the crash-'lost' semantics, kvs.C_LOST);
+  * ``S_REJECTED`` — definitively did not happen (elastic fence /
+    degraded-mode shed inside the store);
+  * ``S_LOST`` — the serving replica died holding the op (maybe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+# -- op kinds (wire) ---------------------------------------------------------
+K_GET, K_PUT, K_RMW = 1, 2, 3
+_KIND_NAMES = {K_GET: "get", K_PUT: "put", K_RMW: "rmw"}
+_KIND_CODES = {v: k for k, v in _KIND_NAMES.items()}
+
+# -- response statuses -------------------------------------------------------
+S_OK = 0           # op completed (kind's normal completion)
+S_RMW_ABORT = 1    # rmw lost its race (reference abort semantics)
+S_REJECTED = 2     # definitively did NOT happen (fence / degraded shed)
+S_RETRY_AFTER = 3  # refused at the front door; retry_after_us hints when
+S_DEADLINE = 4     # deadline expired (updates: MAYBE committed)
+S_LOST = 5         # replica crash holding the op (MAYBE committed)
+STATUS_NAMES = {S_OK: "ok", S_RMW_ABORT: "rmw_abort", S_REJECTED: "rejected",
+                S_RETRY_AFTER: "retry_after", S_DEADLINE: "deadline",
+                S_LOST: "lost"}
+
+# -- retry_after reasons (which admission rung refused) ----------------------
+R_NONE = 0
+R_QUEUE_FULL = 1   # bounded intake queue at capacity
+R_QUOTA = 2        # tenant's in-flight session quota exhausted
+R_RATE = 3         # tenant's token bucket empty
+R_SHED_WRITE = 4   # overload ladder rung 1: new writes shed
+R_SHED_READ = 5    # overload ladder rung 2: non-hot-key reads shed
+REASON_NAMES = {R_NONE: "", R_QUEUE_FULL: "queue_full", R_QUOTA: "quota",
+                R_RATE: "rate", R_SHED_WRITE: "shed_write",
+                R_SHED_READ: "shed_read"}
+
+REQ_MAGIC = 0x5251   # 'QR'
+RSP_MAGIC = 0x5253   # 'SR'
+# magic u16 | kind u8 | pad u8 | req_id u32 | tenant u16 | pad u16 |
+# deadline_us u32 | key i64
+_REQ = struct.Struct("<HBBIHHIq")
+# magic u16 | status u8 | reason u8 | req_id u32 | found u8 | has_uid u8 |
+# pad u16 | step i32 | retry_after_us u32 | uid_hi i32 | uid_lo i32
+# (has_uid is explicit: uid (0, 0) is a REAL write id — replica 0,
+# session 0, op 0 — and must not read back as "absent")
+_RSP = struct.Struct("<HBBIBBHiIii")
+
+
+def req_nbytes(u: int) -> int:
+    """Wire size of one (unframed) request at payload width ``u``."""
+    return _REQ.size + 4 * u
+
+
+def rsp_nbytes(u: int) -> int:
+    return _RSP.size + 4 * u
+
+
+@dataclasses.dataclass
+class Request:
+    kind: str                 # 'get' | 'put' | 'rmw'
+    req_id: int
+    tenant: int
+    key: int
+    deadline_us: int = 0      # RELATIVE to server intake; 0 = none
+    value: Optional[List[int]] = None  # payload words (updates)
+
+
+@dataclasses.dataclass
+class Response:
+    status: int
+    req_id: int
+    reason: int = R_NONE
+    found: bool = True
+    step: int = -1
+    retry_after_us: int = 0
+    uid: Optional[tuple] = None
+    value: Optional[List[int]] = None
+
+    @property
+    def status_name(self) -> str:
+        return STATUS_NAMES[self.status]
+
+    @property
+    def reason_name(self) -> str:
+        return REASON_NAMES[self.reason]
+
+
+def encode_request(req: Request, u: int) -> bytes:
+    if req.kind not in _KIND_CODES:
+        raise ValueError(f"unknown op kind {req.kind!r}")
+    if not (0 <= req.deadline_us < 1 << 32):
+        raise ValueError("deadline_us must fit u32 (relative microseconds)")
+    pay = np.zeros(u, np.int32)
+    if req.value is not None:
+        v = np.asarray(list(req.value), np.int32)
+        if v.ndim != 1 or v.shape[0] > u:
+            raise ValueError(f"value must be <= {u} int32 words")
+        pay[: v.shape[0]] = v
+    return _REQ.pack(REQ_MAGIC, _KIND_CODES[req.kind], 0, req.req_id,
+                     req.tenant, 0, req.deadline_us,
+                     req.key) + pay.tobytes()
+
+
+def peek_req_id(buf: bytes) -> Optional[int]:
+    """Best-effort req_id from a request whose BODY is undecodable (wrong
+    payload width): the fixed header may still be intact, letting the
+    server refuse the request loudly instead of leaving the client to
+    time out.  None when even the header is unusable."""
+    buf = bytes(buf)
+    if len(buf) < _REQ.size:
+        return None
+    magic, _k, _p, req_id, *_rest = _REQ.unpack(buf[: _REQ.size])
+    return req_id if magic == REQ_MAGIC else None
+
+
+def decode_request(buf: bytes, u: int) -> Request:
+    buf = bytes(buf)
+    if len(buf) != req_nbytes(u):
+        raise ValueError(f"request size {len(buf)} != {req_nbytes(u)} "
+                         f"(payload width {u})")
+    magic, kind, _p, req_id, tenant, _p2, dl, key = _REQ.unpack(
+        buf[: _REQ.size])
+    if magic != REQ_MAGIC:
+        raise ValueError(f"bad request magic 0x{magic:04x}")
+    if kind not in _KIND_NAMES:
+        raise ValueError(f"unknown wire op kind {kind}")
+    value = np.frombuffer(buf[_REQ.size:], np.int32).tolist()
+    return Request(kind=_KIND_NAMES[kind], req_id=req_id, tenant=tenant,
+                   key=key, deadline_us=dl,
+                   value=value if _KIND_NAMES[kind] != "get" else None)
+
+
+def encode_response(rsp: Response, u: int) -> bytes:
+    pay = np.zeros(u, np.int32)
+    if rsp.value is not None:
+        v = np.asarray(list(rsp.value), np.int32)
+        pay[: v.shape[0]] = v
+    hi, lo = rsp.uid if rsp.uid is not None else (0, 0)
+    return _RSP.pack(RSP_MAGIC, rsp.status, rsp.reason, rsp.req_id,
+                     1 if rsp.found else 0,
+                     1 if rsp.uid is not None else 0, 0, rsp.step,
+                     rsp.retry_after_us, hi, lo) + pay.tobytes()
+
+
+def decode_response(buf: bytes, u: int) -> Response:
+    buf = bytes(buf)
+    if len(buf) != rsp_nbytes(u):
+        raise ValueError(f"response size {len(buf)} != {rsp_nbytes(u)}")
+    (magic, status, reason, req_id, found, has_uid, _p2, step, retry,
+     hi, lo) = _RSP.unpack(buf[: _RSP.size])
+    if magic != RSP_MAGIC:
+        raise ValueError(f"bad response magic 0x{magic:04x}")
+    value = np.frombuffer(buf[_RSP.size:], np.int32).tolist()
+    return Response(status=status, reason=reason, req_id=req_id,
+                    found=bool(found), step=step, retry_after_us=retry,
+                    uid=(hi, lo) if has_uid else None,
+                    value=value if status == S_OK else None)

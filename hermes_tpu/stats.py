@@ -29,6 +29,19 @@ def percentile_from_hist(hist: np.ndarray, q: float) -> Optional[int]:
     return percentile_from_counts(hist, q)
 
 
+def percentile_nearest_rank(sorted_vals, q: float):
+    """Nearest-rank percentile (the ceil(q*n)-th order statistic) of an
+    already-sorted sequence: with 100 samples p99 is the 99th value, not
+    the max — one outlier no longer defines the reported tail.  Returns
+    None on an empty sequence."""
+    import math
+
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           max(0, math.ceil(q * len(sorted_vals)) - 1))]
+
+
 def summarize(meta, wall_s: Optional[float] = None, steps: Optional[int] = None,
               hists: bool = False) -> dict:
     """One metrics record from a Meta pytree (batched (R, ...) or

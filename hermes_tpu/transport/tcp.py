@@ -14,6 +14,7 @@ import ctypes
 import os
 import pathlib
 import subprocess
+import threading
 
 import numpy as np
 
@@ -36,6 +37,100 @@ def _ensure_built(force: bool = False) -> pathlib.Path:
     )
     os.replace(tmp, _SO)
     return _SO
+
+
+class FramedSocket:
+    """Checksummed-frame message boundary over one stream socket
+    (round-14, the serving RPC path).  Every message crosses as a
+    round-11 CRC frame (``codec.frame_pack``): the fixed header carries
+    the payload length (the stream framing) AND the checksum (end-to-end
+    integrity) — a corrupt frame raises ``codec.FrameCorrupt`` at the
+    receiver, which must treat it as dropped, never decode it.
+
+    Blocking, one-message-at-a-time; the serving server gives each
+    connection its own reader thread (serving/rpc.py).  ``send`` is
+    internally serialized, so two threads sharing one FramedSocket can
+    never splice frames mid-stream.
+
+    ``expect_lens`` (optional set of plausible payload lengths) is
+    consulted ONLY when a frame fails its CRC: a failing frame whose
+    length field is not a plausible message size most likely had the
+    LENGTH itself corrupted — skipping it would silently misalign the
+    stream cursor — so the stream tears down loudly instead.  Frames
+    with a valid CRC pass through at any length (the server must still
+    see wrong-width-but-intact requests to refuse them decodably)."""
+
+    def __init__(self, sock, expect_lens=None):
+        from hermes_tpu.transport import codec
+
+        self._codec = codec
+        self.sock = sock
+        self.corrupt_dropped = 0
+        self._expect_lens = (None if expect_lens is None
+                             else frozenset(expect_lens))
+        self._send_lock = threading.Lock()
+
+    def send(self, payload: bytes) -> None:
+        frame = self._codec.frame_pack(np.frombuffer(
+            bytes(payload), np.uint8))
+        with self._send_lock:
+            self.sock.sendall(frame.tobytes())
+
+    def _read_exact(self, n: int):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None  # peer closed
+            buf += chunk
+        return bytes(buf)
+
+    def recv(self):
+        """One framed payload as bytes, None on orderly EOF.  A frame
+        that fails its CRC is counted and skipped (the serving analogue
+        of TcpHostTransport's corrupt -> zero-block downgrade); a
+        header too mangled to carry a believable length — or, with
+        ``expect_lens``, a CRC failure on an implausible length —
+        tears the stream down (raises), since the message boundary
+        itself is suspect."""
+        codec = self._codec
+        while True:
+            hdr = self._read_exact(codec.FRAME_OVERHEAD)
+            if hdr is None:
+                return None
+            magic, _algo, _pad, length, _crc = codec.FRAME_HEADER.unpack(hdr)
+            if magic != codec.FRAME_MAGIC or length > (1 << 26):
+                raise codec.FrameCorrupt(
+                    f"unrecoverable stream framing (magic 0x{magic:04x}, "
+                    f"len {length}): message boundary lost")
+            body = self._read_exact(length)
+            if body is None:
+                return None
+            try:
+                payload = codec.frame_unpack(np.frombuffer(
+                    hdr + body, np.uint8))
+            except codec.FrameCorrupt:
+                if (self._expect_lens is not None
+                        and length not in self._expect_lens):
+                    # the CRC failed AND the length field names no
+                    # plausible message: the corruption likely hit the
+                    # length itself, so the bytes just consumed straddle
+                    # a real frame boundary — "skip and continue" would
+                    # silently desynchronize the stream
+                    raise codec.FrameCorrupt(
+                        f"CRC failure on implausible frame length "
+                        f"{length} (expected one of "
+                        f"{sorted(self._expect_lens)}): length field "
+                        f"suspect, stream alignment lost") from None
+                self.corrupt_dropped += 1
+                continue
+            return payload.tobytes()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
 
 class TcpMesh:

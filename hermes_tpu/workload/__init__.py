@@ -1,1 +1,12 @@
-"""Workload generation (SURVEY.md §1 L6): YCSB-style synthetic op streams."""
+"""Workload generation (SURVEY.md §1 L6): YCSB-style synthetic op
+streams (workload.ycsb) and the round-14 serving load shapes — seeded
+open-loop Poisson arrivals, chaos-shapeable rates, closed-loop clients
+(workload.openloop)."""
+
+from hermes_tpu.workload.openloop import (ClosedLoop, MixSpec,
+                                          ShapedArrivals, hot_set, make_mix,
+                                          poisson_arrivals, scenario_matrix,
+                                          scenario_seed)
+
+__all__ = ["ClosedLoop", "MixSpec", "ShapedArrivals", "hot_set", "make_mix",
+           "poisson_arrivals", "scenario_matrix", "scenario_seed"]

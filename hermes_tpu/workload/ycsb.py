@@ -31,6 +31,21 @@ def _zipf_cdf(n: int, theta: float) -> np.ndarray:
     return cdf / cdf[-1]
 
 
+def scrambled_zipfian(
+    rng: np.random.Generator, n_keys: int, theta: float, scramble_seed: int,
+    size,
+) -> np.ndarray:
+    """Scrambled-zipfian key draw (YCSB): rank by the Zipfian(theta) CDF,
+    then spread the hot ranks over the key space with a fixed permutation
+    keyed off ``scramble_seed``.  The ONE implementation — the YCSB op
+    streams and the serving mixes (workload.openloop) both draw through
+    it."""
+    cdf = _zipf_cdf(n_keys, theta)
+    ranks = np.searchsorted(cdf, rng.random(size=size))
+    perm = np.random.default_rng(scramble_seed ^ 0x5CA1AB1E).permutation(n_keys)
+    return perm[ranks]
+
+
 def sample_keys(
     rng: np.random.Generator, cfg: HermesConfig, size: tuple[int, ...]
 ) -> np.ndarray:
@@ -38,12 +53,8 @@ def sample_keys(
     if wl.distribution == "uniform":
         return rng.integers(0, cfg.n_keys, size=size, dtype=np.int32)
     if wl.distribution == "zipfian":
-        cdf = _zipf_cdf(cfg.n_keys, wl.zipf_theta)
-        ranks = np.searchsorted(cdf, rng.random(size=size))
-        # Scramble ranks -> keys with a fixed permutation so the hot ranks are
-        # spread over the key space (YCSB's "scrambled zipfian").
-        perm = np.random.default_rng(wl.seed ^ 0x5CA1AB1E).permutation(cfg.n_keys)
-        return perm[ranks].astype(np.int32)
+        return scrambled_zipfian(rng, cfg.n_keys, wl.zipf_theta, wl.seed,
+                                 size).astype(np.int32)
     raise ValueError(f"unknown distribution {wl.distribution!r}")
 
 

@@ -1,0 +1,271 @@
+"""Round-14 serving gate (CI, the NINTH gate): overload is a first-class,
+seeded, gated failure mode — the front-end must shed loudly, honor
+deadlines, and degrade gracefully rather than wedge.
+
+Four assertions, CPU-smoke sized (joins the eight earlier gates in
+scripts/run_gates.py — gates run SERIALLY, never beside pytest):
+
+  1. overload soak, both engines — an open-loop Poisson soak at >= 2x
+     the MEASURED closed-loop capacity (the capacity probe runs first,
+     through the same serving path) over the batched AND sharded KVS at
+     pipeline depth 2 must (a) keep the linearizability checker green
+     with committed_write_lost == [] (no client-visible commit
+     contradicted), (b) resolve EVERY request loudly — admitted ops as
+     committed/deadline/rejected, refused ops as RETRY_AFTER; response
+     conservation + per-tenant admission accounting exactness are
+     asserted by verify_serving — and (c) bound admitted-op p99 by the
+     configured deadline (+ one virtual round: deadline enforcement is
+     checked once per pump);
+  2. deterministic replay — the same seed + configs replay the soak to
+     a byte-identical response log (sha256 over the emitted response
+     bytes, the chaos-schedule determinism contract applied to load);
+  3. fleet facade — the same envelope over a 2-group Fleet: the soak
+     spans both groups, every group's checker is green, verify_fleet
+     holds, and the serving invariants pass through the router;
+  4. seeded overload storm — chaos ``overload x=N`` windows (Schedule.
+     overload_storm attached to the arrival shaper via ChaosRunner's
+     load= seam) burst the arrival rate mid-soak; the envelope must
+     still satisfy (b)+(c), shed visibly (retry_after > 0), and the
+     executed chaos log + response log must replay byte-identically.
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_serving.py
+
+Prints one JSON line (also written to SERVING_SOAK.json); exit non-zero
+on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+SEED = 14
+# tight enough that a 2x-capacity soak's tail CROSSES it (the deadline
+# machinery must fire, not just exist), loose enough that the bulk commits
+DEADLINE_US = 8_000
+ROUND_US = 1000
+
+
+def _cfg(n_replicas=4, **over):
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    kw = dict(
+        n_replicas=n_replicas, n_keys=64, n_sessions=4, replay_slots=6,
+        ops_per_session=96, value_words=6, replay_age=6,
+        replay_scan_every=4, rebroadcast_every=2, lease_steps=6,
+        pipeline_depth=2, op_timeout_rounds=48,
+        workload=WorkloadConfig(read_frac=0.5, seed=SEED),
+    )
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _scfg(**over):
+    from hermes_tpu.serving import ServingConfig
+
+    kw = dict(tenant_rate_per_s=200_000.0, tenant_burst=64.0,
+              tenant_quota=12, queue_cap=48, round_us=ROUND_US,
+              shed_write_frac=0.6, shed_read_frac=0.9)
+    kw.update(over)
+    return ServingConfig(**kw)
+
+
+def _store(backend: str, record=True):
+    from hermes_tpu.kvs import KVS
+
+    if backend == "sharded":
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("replica",))
+        return KVS(_cfg(), backend="sharded", mesh=mesh,
+                   record="array" if record else False)
+    return KVS(_cfg(), record="array" if record else False)
+
+
+def _assert_envelope(res: dict, report_key: str, report: dict,
+                     require_shed: bool = True,
+                     require_deadline: bool = False) -> None:
+    """(b) + (c): every op resolved loudly, tail bounded by the deadline."""
+    st = res["statuses"]
+    resolved = (res["completed"] + res["deadline"] + st.get("rejected", 0)
+                + res["lost"] + st.get("retry_after", 0))
+    assert res["ops_offered"] == res["sent"], res
+    assert resolved == res["sent"], (
+        f"{report_key}: {res['sent']} requests but only {resolved} loud "
+        f"resolutions ({st})")
+    assert res["lost"] == 0, f"{report_key}: clean soak lost ops ({st})"
+    if require_shed:
+        assert st.get("retry_after", 0) > 0, (
+            f"{report_key}: a >=2x-capacity soak shed nothing — the "
+            f"admission path is not engaging ({st})")
+    if require_deadline:
+        assert res["deadline"] > 0, (
+            f"{report_key}: the overload tail never crossed the "
+            f"{DEADLINE_US}us deadline — the enforcement path did not "
+            f"fire ({st})")
+    bound = DEADLINE_US + ROUND_US
+    assert res["p99_latency_us"] is not None \
+        and res["p99_latency_us"] <= bound, (
+        f"{report_key}: admitted-op p99 {res['p99_latency_us']}us exceeds "
+        f"the deadline bound {bound}us")
+    report[report_key] = {k: v for k, v in res.items()
+                          if not k.startswith("_")}
+
+
+def _check_history(store, res) -> None:
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.serving.soak import committed_uids
+
+    v = store.rt.check()
+    assert v.ok, f"checker FAIL: {[f.reason[:160] for f in v.failures[:2]]}"
+    uids = committed_uids(res["_frontend"], res["_server"])
+    assert uids, "soak committed nothing the client saw"
+    lost = lin.committed_write_lost(uids, store.rt.history_ops(),
+                                    store.rt.recorder.aborted_uids)
+    assert not lost, (
+        f"committed-and-observed writes contradicted by the history: "
+        f"{lost[:4]}")
+
+
+def check_engines(report: dict) -> None:
+    from hermes_tpu.serving import measure_capacity, run_open_loop
+    from hermes_tpu.workload.openloop import MixSpec
+
+    spec = MixSpec(name="uniform", tenants=4)
+    for backend in ("batched", "sharded"):
+        cap = measure_capacity(_store(backend, record=False), _scfg(),
+                               spec, n=240, seed=SEED)
+        rate = 2.0 * cap["ops_per_vs"]
+        shas = []
+        for rep in range(2):
+            store = _store(backend)
+            res = run_open_loop(store, _scfg(), spec, rate_per_s=rate,
+                                n=500, seed=SEED, deadline_us=DEADLINE_US)
+            if rep == 0:
+                _assert_envelope(res, f"{backend}_soak", report,
+                                 require_deadline=True)
+                _check_history(store, res)
+                report[f"{backend}_soak"]["capacity_probe"] = cap
+                report[f"{backend}_soak"]["rate_per_vs"] = rate
+            shas.append(res["response_log_sha"])
+        assert shas[0] == shas[1], (
+            f"{backend}: same seed replayed to a DIFFERENT response log "
+            f"({shas})")
+        report[f"{backend}_replay_identical"] = True
+
+
+def check_fleet(report: dict) -> None:
+    from hermes_tpu.config import FleetConfig
+    from hermes_tpu.fleet import Fleet, verify_fleet
+    from hermes_tpu.serving import measure_capacity, run_open_loop
+    from hermes_tpu.workload.openloop import MixSpec
+
+    spec = MixSpec(name="uniform", tenants=4)
+    fcfg = FleetConfig(groups=2, base=_cfg())
+    cap = measure_capacity(Fleet(fcfg), _scfg(), spec, n=240, seed=SEED)
+    rate = 2.0 * cap["ops_per_vs"]
+    fleet = Fleet(fcfg, record="array")
+    res = run_open_loop(fleet, _scfg(), spec, rate_per_s=rate, n=500,
+                        seed=SEED, deadline_us=DEADLINE_US)
+    _assert_envelope(res, "fleet_soak", report)
+    # the mix must actually span both groups
+    import numpy as np
+
+    from hermes_tpu.workload.openloop import make_mix
+
+    fe = res["_frontend"]
+    mix = make_mix(spec, fe.n_keys, 500, SEED, value_words=fe.u)
+    gids, _ = fleet.router.locate(np.asarray(mix["key"], np.int64))
+    assert set(np.asarray(gids).tolist()) == {0, 1}, "mix spanned one group"
+    verdicts = fleet.check()
+    assert verdicts["ok"], f"fleet checker FAIL {verdicts}"
+    verify_fleet(fleet)
+    # the client-visible-commit invariant THROUGH the router: every uid
+    # the client saw commit must be a definite committed write in some
+    # group's history and aborted in none (the engines-leg cross-check
+    # applied to the fleet facade)
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.serving.soak import committed_uids
+
+    uids = committed_uids(res["_frontend"], res["_server"])
+    assert uids, "fleet soak committed nothing the client saw"
+    all_ops = [o for g in fleet.groups for o in g.rt.history_ops()]
+    aborted = set()
+    for g in fleet.groups:
+        aborted |= set(g.rt.recorder.aborted_uids)
+    lost = lin.committed_write_lost(uids, all_ops, aborted)
+    assert not lost, (
+        f"fleet: committed-and-observed writes contradicted by the "
+        f"group histories: {lost[:4]}")
+    report["fleet_soak"]["group_verdicts"] = verdicts["groups"]
+    report["fleet_soak"]["capacity_probe"] = cap
+
+
+def check_overload_storm(report: dict) -> None:
+    from hermes_tpu import chaos
+    from hermes_tpu.serving import run_open_loop
+    from hermes_tpu.workload.openloop import (MixSpec, ShapedArrivals,
+                                              hot_set)
+
+    # a REAL hot-key mix with the hot set handed to the shed ladder, so
+    # rung-2 retention is exercised through the storm, not only in units
+    spec = MixSpec(name="hotkey", distribution="hotkey", hot_frac=0.8,
+                   hot_keys=4, tenants=4)
+    scfg = _scfg(hot_keys=hot_set(spec))
+    sched = chaos.Schedule.overload_storm(SEED, steps=400, n_windows=2,
+                                          x_range=(3.0, 6.0))
+    assert len(sched) == 2
+    outs = []
+    for _ in range(2):
+        store = _store("batched")
+        arrivals = ShapedArrivals(1200.0, 400, SEED)
+        runner = chaos.ChaosRunner(store, chaos.Schedule(list(sched)),
+                                   load=arrivals)
+        res = run_open_loop(store, scfg, spec, rate_per_s=1200.0,
+                            n=400, seed=SEED, deadline_us=DEADLINE_US,
+                            chaos_runner=runner, arrivals=arrivals)
+        outs.append((res, runner.log_json()))
+    res = outs[0][0]
+    _assert_envelope(res, "overload_storm", report, require_shed=False)
+    applied = [e for e in json.loads(outs[0][1]) if e["kind"] == "overload"]
+    assert applied, "no overload window applied"
+    assert outs[0][1] == outs[1][1], "executed chaos logs differ"
+    assert outs[0][0]["response_log_sha"] == outs[1][0]["response_log_sha"], \
+        "overload-storm response logs differ across replays"
+    report["overload_storm"]["windows_applied"] = applied
+    report["overload_storm_replay_identical"] = True
+
+
+def main() -> int:
+    report: dict = {"gate": "serving"}
+    try:
+        check_engines(report)
+        check_fleet(report)
+        check_overload_storm(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report, default=str))
+        return 1
+    report["ok"] = True
+    out = os.path.join(os.path.dirname(__file__), "..", "SERVING_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    print(json.dumps(report, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
 """One serial runner for every CI gate (round-11 satellite).
 
-The eight gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
-netchaos, fleet — MUST run serially and never beside a pytest run: the
+The nine gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
+netchaos, fleet, serving — MUST run serially and never beside a pytest run: the
 obs-overhead gate measures per-round wall time against an ablation
 baseline and is contention-sensitive (a parallel pytest's CPU load turns a
 behavior-identical change into a spurious overhead failure).  That rule
@@ -11,6 +11,9 @@ used to live in docs; this runner enforces it in tooling:
     with the canonical CPU env;
   * a live pytest on the machine aborts the run up front (override with
     --force if you know the contention is harmless, e.g. a collect-only);
+  * a gate that overruns its per-gate timeout is KILLED (its whole
+    process group — a wedged gate must not stall the serial run or leak
+    grandchildren) and recorded as ``timed_out`` in the summary;
   * per-gate wall time and the gate's own JSON report land in ONE summary
     (GATES_SUMMARY.json + one printed JSON line), exit non-zero if any
     gate failed.
@@ -24,6 +27,7 @@ import argparse
 import glob
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -40,6 +44,7 @@ GATES = (
     ("elastic", "check_elastic.py"),
     ("netchaos", "check_netchaos.py"),
     ("fleet", "check_fleet.py"),
+    ("serving", "check_serving.py"),
 )
 
 
@@ -67,17 +72,29 @@ def gate_env() -> dict:
 
 def run_gate(name: str, script: str, timeout: int) -> dict:
     t0 = time.perf_counter()
+    # own process group: on timeout the WHOLE group is killed, so a gate
+    # that wedged inside a grandchild (a spawned replica process, a stuck
+    # device claim) cannot stall the serial run or leak orphans
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", script)],
+        cwd=REPO, env=gate_env(), start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts", script)],
-            cwd=REPO, env=gate_env(), timeout=timeout,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        out_b, err_b = proc.communicate(timeout=timeout)
         rc = proc.returncode
-        out = proc.stdout.decode(errors="replace")
-        err = proc.stderr.decode(errors="replace")
     except subprocess.TimeoutExpired:
-        return dict(gate=name, ok=False, rc=-1, seconds=timeout,
-                    error=f"timed out after {timeout}s")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out_b, err_b = proc.communicate()
+        return dict(gate=name, ok=False, rc=-9, timed_out=True,
+                    seconds=round(time.perf_counter() - t0, 2),
+                    error=f"timed out after {timeout}s (process group "
+                          "killed)",
+                    stderr_tail=err_b.decode(errors="replace")[-1500:])
+    out = out_b.decode(errors="replace")
+    err = err_b.decode(errors="replace")
     secs = round(time.perf_counter() - t0, 2)
     report = None
     for line in reversed(out.strip().splitlines()):
@@ -133,7 +150,9 @@ def main() -> int:
 
     summary = dict(
         ok=all(r["ok"] for r in results),
-        gates={r["gate"]: dict(ok=r["ok"], seconds=r["seconds"])
+        gates={r["gate"]: dict(ok=r["ok"], seconds=r["seconds"],
+                               **({"timed_out": True} if r.get("timed_out")
+                                  else {}))
                for r in results},
         total_seconds=round(sum(r["seconds"] for r in results), 2),
         results=results,

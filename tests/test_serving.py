@@ -1,0 +1,566 @@
+"""Round-14 serving front-end: wire codec, admission exactness,
+deadlines, backpressure, shed ladder, fleet routing, determinism, and
+the run_gates timeout satellite."""
+
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
+from hermes_tpu.kvs import KVS, StuckOpError
+from hermes_tpu.serving import (Frontend, LoopbackServer, RpcClient,
+                                ServingConfig, TcpRpcServer, TokenBucket,
+                                VirtualClock, measure_capacity,
+                                run_open_loop, verify_serving, wire)
+from hermes_tpu.workload.openloop import (MixSpec, ShapedArrivals, make_mix,
+                                          poisson_arrivals, scenario_matrix,
+                                          scenario_seed)
+
+
+def _cfg(**over):
+    kw = dict(n_replicas=3, n_keys=64, n_sessions=4, replay_slots=6,
+              ops_per_session=96, value_words=6, replay_age=6,
+              replay_scan_every=4, rebroadcast_every=2, lease_steps=6,
+              workload=WorkloadConfig(read_frac=0.5, seed=7))
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _scfg(**over):
+    kw = dict(tenant_rate_per_s=1e6, tenant_burst=1e4, tenant_quota=16,
+              queue_cap=64, round_us=1000)
+    kw.update(over)
+    return ServingConfig(**kw)
+
+
+# -- wire codec --------------------------------------------------------------
+
+def test_wire_request_response_roundtrip():
+    req = wire.Request(kind="rmw", req_id=99, tenant=3, key=41,
+                       deadline_us=12345, value=[5, 6])
+    out = wire.decode_request(wire.encode_request(req, 4), 4)
+    assert (out.kind, out.req_id, out.tenant, out.key, out.deadline_us) == \
+        ("rmw", 99, 3, 41, 12345)
+    assert out.value == [5, 6, 0, 0]
+    rsp = wire.Response(status=wire.S_RETRY_AFTER, req_id=99,
+                        reason=wire.R_QUEUE_FULL, retry_after_us=777)
+    back = wire.decode_response(wire.encode_response(rsp, 4), 4)
+    assert back.status == wire.S_RETRY_AFTER
+    assert back.reason_name == "queue_full"
+    assert back.retry_after_us == 777
+
+
+def test_wire_rejects_bad_magic_and_size():
+    raw = bytearray(wire.encode_request(
+        wire.Request(kind="get", req_id=1, tenant=0, key=0), 2))
+    raw[0] ^= 0xFF
+    with pytest.raises(ValueError, match="magic"):
+        wire.decode_request(bytes(raw), 2)
+    with pytest.raises(ValueError, match="size"):
+        wire.decode_request(b"\x00" * 7, 2)
+
+
+def test_framed_socket_drops_corrupt_frame():
+    from hermes_tpu.transport import codec
+    from hermes_tpu.transport.tcp import FramedSocket
+
+    a, b = socket.socketpair()
+    tx, rx = FramedSocket(a), FramedSocket(b)
+    bad = codec.frame_pack(np.frombuffer(b"hello", np.uint8)).copy()
+    bad[-1] ^= 0xFF  # corrupt the payload AFTER the crc was computed
+    a.sendall(bad.tobytes())
+    tx.send(b"world")
+    assert rx.recv() == b"world"  # corrupt frame skipped, not applied
+    assert rx.corrupt_dropped == 1
+    tx.close(), rx.close()
+
+
+def test_framed_socket_corrupt_length_tears_down_not_desyncs():
+    # a bit flip in the header's LENGTH field (CRC covers only the
+    # payload) would silently shift the stream cursor; with expect_lens
+    # the receiver detects the implausible length on CRC failure and
+    # tears down LOUDLY instead of delivering misaligned frames
+    from hermes_tpu.transport import codec
+    from hermes_tpu.transport.tcp import FramedSocket
+
+    a, b = socket.socketpair()
+    tx, rx = FramedSocket(a), FramedSocket(b, expect_lens={5})
+    bad = bytearray(codec.frame_pack(
+        np.frombuffer(b"hello", np.uint8)).tobytes())
+    # header <HBBII: magic(2) algo(1) pad(1) length(4) crc(4)
+    assert bad[4] == 5
+    bad[4] = 6  # corrupted length: still plausible-looking, wrong
+    a.sendall(bytes(bad))
+    tx.send(b"world")  # rx would consume 1 byte of THIS frame's header
+    with pytest.raises(codec.FrameCorrupt, match="length"):
+        rx.recv()
+    # payload corruption with an EXPECTED length still skips, as before
+    a2, b2 = socket.socketpair()
+    tx2, rx2 = FramedSocket(a2), FramedSocket(b2, expect_lens={5})
+    bad2 = codec.frame_pack(np.frombuffer(b"howdy", np.uint8)).copy()
+    bad2[-1] ^= 0xFF
+    a2.sendall(bad2.tobytes())
+    tx2.send(b"again")
+    assert rx2.recv() == b"again"
+    assert rx2.corrupt_dropped == 1
+    tx.close(), rx.close(), tx2.close(), rx2.close()
+
+
+# -- generators --------------------------------------------------------------
+
+def test_poisson_arrivals_byte_identical():
+    a = poisson_arrivals(500.0, 300, seed=21)
+    assert a.tobytes() == poisson_arrivals(500.0, 300, seed=21).tobytes()
+    assert a.tobytes() != poisson_arrivals(500.0, 300, seed=22).tobytes()
+    assert (np.diff(a) > 0).all()
+
+
+def test_make_mix_deterministic_and_shaped():
+    m1 = make_mix(MixSpec(tenants=3), 64, 200, seed=5, value_words=4)
+    m2 = make_mix(MixSpec(tenants=3), 64, 200, seed=5, value_words=4)
+    for k in ("kind", "key", "tenant", "value"):
+        assert m1[k].tobytes() == m2[k].tobytes()
+    hot = make_mix(MixSpec(distribution="hotkey", hot_frac=1.0, hot_keys=2),
+                   64, 100, seed=5)
+    assert set(hot["key"].tolist()) <= {0, 1}
+
+
+def test_shaped_arrivals_overload_compresses_deterministically():
+    runs = []
+    for _ in range(2):
+        sa = ShapedArrivals(100.0, 50, seed=3)
+        out = []
+        for i in range(50):
+            if i == 20:
+                sa.set_rate_x(4.0)
+            out.append(sa.peek())
+            sa._next = None  # consume
+        runs.append(out)
+    assert runs[0] == runs[1]
+    # after the multiplier, arrivals land earlier than the unshaped
+    # schedule (gaps past the window compress by 4x)
+    assert runs[0][30] < poisson_arrivals(100.0, 50, 3)[30]
+
+
+def test_overload_verb_parse_format_and_refusal():
+    from hermes_tpu import chaos
+
+    sched = chaos.Schedule.parse("@5 overload x=3.5 until=20\n@30 overload_clear\n")
+    assert sched.events[0].x == 3.5 and sched.events[0].until == 20
+    assert chaos.Schedule.parse(sched.format()).format() == sched.format()
+    storm = chaos.Schedule.overload_storm(9, steps=100, n_windows=2)
+    assert storm.format() == chaos.Schedule.overload_storm(
+        9, steps=100, n_windows=2).format()
+    kvs = KVS(_cfg())
+    with pytest.raises(ValueError, match="load shaper"):
+        chaos.ChaosRunner(kvs, sched)  # no load= attached
+    sa = ShapedArrivals(100.0, 10, seed=1)
+    runner = chaos.ChaosRunner(kvs, sched, load=sa)
+    runner.tick(5)
+    assert sa.rate_x == 3.5
+    runner.tick(20)  # window expires
+    assert sa.rate_x == 1.0
+
+
+def test_heal_closes_open_overload_window():
+    # an `overload x=N` with no until= (awaiting an overload_clear) must
+    # not outlive a heal — same rule as skews/partitions
+    from hermes_tpu import chaos
+
+    sched = chaos.Schedule.parse("@2 overload x=4\n@6 heal\n")
+    kvs = KVS(_cfg())
+    sa = ShapedArrivals(100.0, 10, seed=1)
+    runner = chaos.ChaosRunner(kvs, sched, load=sa)
+    runner.tick(2)
+    assert sa.rate_x == 4.0
+    runner.tick(6)
+    assert sa.rate_x == 1.0
+
+
+# -- admission ---------------------------------------------------------------
+
+def test_token_bucket_exact():
+    tb = TokenBucket(rate_per_s=10.0, burst=2.0)
+    assert tb.take(0.0) and tb.take(0.0) and not tb.take(0.0)
+    assert not tb.take(0.05)   # half a token accrued
+    assert tb.take(0.1)        # exactly one
+    assert tb.wait_s(0.1) == pytest.approx(0.1)
+
+
+def test_quota_accounting_exact_under_concurrent_tenants():
+    kvs = KVS(_cfg())
+    clock = VirtualClock()
+    quota = 3
+    fe = Frontend(kvs, _scfg(tenant_quota=quota, queue_cap=64), clock=clock)
+    refused = {0: 0, 1: 0}
+    rid = 0
+    for wave in range(6):
+        for t in (0, 1):
+            for _ in range(5):  # 5 > quota: some must be refused
+                rid += 1
+                rsp = fe.submit(wire.Request(kind="put", req_id=rid,
+                                             tenant=t, key=rid % 64,
+                                             value=[rid]))
+                if rsp is not None:
+                    assert rsp.status == wire.S_RETRY_AFTER
+                    assert rsp.reason == wire.R_QUOTA
+                    refused[t] += 1
+        # in-flight per tenant can NEVER exceed the quota
+        for t, row in fe.adm.counters().items():
+            assert row["inflight"] <= quota
+        fe.pump()
+        clock.advance(0.001)
+    assert fe.drain()
+    ev = verify_serving(fe)  # admitted == resolved, inflight == 0, exact
+    assert refused[0] > 0 and refused[1] > 0
+    assert ev["requests"] == ev["responses"] == rid
+
+
+def test_backpressure_queue_full_is_loud():
+    kvs = KVS(_cfg())
+    clock = VirtualClock()
+    # store takes 1 op at a time; queue holds 4: the 6th+ must be refused
+    fe = Frontend(kvs, _scfg(tenant_quota=1000, queue_cap=4,
+                             store_inflight_cap=1), clock=clock)
+    refusals = 0
+    for i in range(20):
+        rsp = fe.submit(wire.Request(kind="put", req_id=i + 1, tenant=0,
+                                     key=i % 64, value=[i]))
+        if rsp is not None:
+            assert rsp.status == wire.S_RETRY_AFTER
+            assert rsp.reason in (wire.R_QUEUE_FULL, wire.R_SHED_WRITE)
+            assert rsp.retry_after_us > 0
+            refusals += 1
+    assert refusals >= 14  # nothing was silently buffered
+    while not fe.drain(200):
+        clock.advance(0.001)
+    verify_serving(fe)
+    assert fe.requests == fe.responses == 20
+
+
+def test_deadline_enforced_at_completion_and_is_a_maybe():
+    cfg = _cfg(op_timeout_rounds=0)
+    kvs = KVS(cfg)
+    clock = VirtualClock()
+    fe = Frontend(kvs, _scfg(), clock=clock)
+    kvs.rt.freeze(1)  # a frozen ack peer stalls every write
+    assert fe.submit(wire.Request(kind="put", req_id=1, tenant=0, key=5,
+                                  deadline_us=3000, value=[42])) is None
+    rsps = []
+    for _ in range(8):
+        rsps += fe.pump()
+        clock.advance(0.001)
+    dl = [r for r in rsps if r.status == wire.S_DEADLINE]
+    assert dl and dl[0].req_id == 1, rsps
+    assert fe.adm.counters()[0]["deadline"] == 1
+    assert fe._abandoned  # the store op is still open — a MAYBE
+    kvs.rt.thaw(1)
+    assert fe.drain()
+    verify_serving(fe)
+
+
+def test_deadline_enforced_at_intake_queue():
+    kvs = KVS(_cfg())
+    clock = VirtualClock()
+    fe = Frontend(kvs, _scfg(store_inflight_cap=1, queue_cap=32),
+                  clock=clock)
+    kvs.rt.freeze(1)  # head op wedges the single store slot
+    for i in range(5):
+        assert fe.submit(wire.Request(kind="put", req_id=i + 1, tenant=0,
+                                      key=i, deadline_us=2000,
+                                      value=[i])) is None
+    rsps = []
+    for _ in range(6):
+        rsps += fe.pump()
+        clock.advance(0.001)
+    intake_expired = [r for r in rsps if r.status == wire.S_DEADLINE
+                      and r.req_id > 1]
+    assert len(intake_expired) == 4  # expired IN the queue, never injected
+    assert fe._lane_seq[0] == 1      # only the head was ever issued
+    kvs.rt.thaw(1)
+    assert fe.drain()
+    verify_serving(fe)
+
+
+# -- shed ladder -------------------------------------------------------------
+
+def test_degraded_mode_sheds_writes_first_reads_serve():
+    from hermes_tpu.obs import Observability
+
+    cfg = _cfg(min_healthy_for_writes=3)
+    kvs = KVS(cfg)
+    obs = kvs.rt.attach_obs(Observability())
+    clock = VirtualClock()
+    fe = Frontend(kvs, _scfg(), clock=clock)
+    kvs.rt.freeze(2)  # healthy 2 < floor 3 => degraded
+    w = fe.submit(wire.Request(kind="put", req_id=1, tenant=0, key=3,
+                               value=[1]))
+    assert w is not None and w.reason == wire.R_SHED_WRITE
+    r = fe.submit(wire.Request(kind="get", req_id=2, tenant=0, key=3))
+    assert r is None  # reads still admitted at rung 1
+    fe.pump()
+    clock.advance(0.001)
+    kvs.rt.thaw(2)
+    assert fe.drain()
+    names = [rec.get("name") for rec in obs.records
+             if rec.get("kind") == "event"]
+    assert "shed" in names and "shed_clear" in names
+    verify_serving(fe)
+
+
+def test_rung2_sheds_cold_reads_hot_keys_survive():
+    kvs = KVS(_cfg())
+    clock = VirtualClock()
+    fe = Frontend(kvs, _scfg(queue_cap=10, shed_write_frac=0.3,
+                             shed_read_frac=0.5, hot_keys=(1,),
+                             store_inflight_cap=1), clock=clock)
+    kvs.rt.freeze(1)  # wedge the store so the intake queue fills
+    rid = 0
+    for i in range(6):  # fill past shed_read_frac * 10 = 5
+        rid += 1
+        fe.submit(wire.Request(kind="get", req_id=rid, tenant=0,
+                               key=10 + i))
+    assert fe.shed_level == 2
+    rid += 1
+    cold = fe.submit(wire.Request(kind="get", req_id=rid, tenant=0, key=20))
+    assert cold is not None and cold.reason == wire.R_SHED_READ
+    rid += 1
+    hot = fe.submit(wire.Request(kind="get", req_id=rid, tenant=0, key=1))
+    assert hot is None  # the hot key keeps serving
+    rid += 1
+    wr = fe.submit(wire.Request(kind="put", req_id=rid, tenant=0, key=2,
+                                value=[9]))
+    assert wr is not None and wr.reason == wire.R_SHED_WRITE
+    kvs.rt.thaw(1)
+    assert fe.drain()
+    verify_serving(fe)
+
+
+# -- watchdog tags (satellite) ----------------------------------------------
+
+def test_stuck_op_diag_carries_tenant_and_deadline_budget():
+    cfg = _cfg(op_timeout_rounds=4)
+    kvs = KVS(cfg, strict_timeouts=True)
+    clock = VirtualClock()
+    fe = Frontend(kvs, _scfg(), clock=clock)
+    kvs.rt.freeze(1)
+    assert fe.submit(wire.Request(kind="put", req_id=1, tenant=5, key=9,
+                                  deadline_us=1_000_000,
+                                  value=[1])) is None
+    with pytest.raises(StuckOpError) as ei:
+        for _ in range(12):
+            fe.pump()
+            clock.advance(0.001)
+    diag = ei.value.diagnostics[0]
+    assert diag["tenant"] == 5
+    assert 0 < diag["deadline_left_us"] <= 1_000_000
+    assert "tenant=5" in str(ei.value)
+    assert "deadline_left_us=" in str(ei.value)
+
+
+# -- fleet + misc ------------------------------------------------------------
+
+def test_fleet_frontend_routes_and_checks():
+    fcfg = FleetConfig(groups=2, base=_cfg(pipeline_depth=2))
+    from hermes_tpu.fleet import Fleet, verify_fleet
+
+    fleet = Fleet(fcfg, record="array")
+    res = run_open_loop(fleet, _scfg(), MixSpec(tenants=3),
+                        rate_per_s=4000.0, n=120, seed=11,
+                        deadline_us=50_000)
+    assert res["statuses"].get("ok", 0) > 0
+    mix = make_mix(MixSpec(tenants=3), fcfg.total_keys, 120, 11,
+                   value_words=4)
+    gids, _ = fleet.router.locate(np.asarray(mix["key"], np.int64))
+    assert set(np.asarray(gids).tolist()) == {0, 1}
+    assert fleet.check()["ok"]
+    verify_fleet(fleet)
+
+
+def test_frontend_rejects_out_of_range_key_loudly():
+    kvs = KVS(_cfg())
+    fe = Frontend(kvs, _scfg(), clock=VirtualClock())
+    rsp = fe.submit(wire.Request(kind="put", req_id=1, tenant=0,
+                                 key=10_000, value=[1]))
+    assert rsp is not None and rsp.status == wire.S_REJECTED
+    verify_serving(fe)
+
+
+def test_loopback_put_get_roundtrip_through_frames():
+    kvs = KVS(_cfg(pipeline_depth=2))
+    clock = VirtualClock()
+    fe = Frontend(kvs, _scfg(), clock=clock)
+    lb = LoopbackServer(fe)
+    assert lb.submit(wire.Request(kind="put", req_id=1, tenant=0, key=7,
+                                  value=[3, 1, 4])) is None
+    got = {}
+    for _ in range(40):
+        for rsp in lb.pump():
+            got[rsp.req_id] = rsp
+        clock.advance(0.001)
+        if 1 in got:
+            break
+    # the get is sequenced AFTER the put's response: it must see the value
+    assert lb.submit(wire.Request(kind="get", req_id=2, tenant=0,
+                                  key=7)) is None
+    for _ in range(40):
+        for rsp in lb.pump():
+            got[rsp.req_id] = rsp
+        clock.advance(0.001)
+        if 2 in got:
+            break
+    assert got[1].status == wire.S_OK and got[1].uid is not None
+    assert got[2].status == wire.S_OK and got[2].value[:3] == [3, 1, 4]
+    assert lb.wire_rx > 0 and lb.wire_tx > 0
+
+
+def test_open_loop_soak_replays_byte_identically():
+    shas = []
+    for _ in range(2):
+        kvs = KVS(_cfg(pipeline_depth=2))
+        res = run_open_loop(kvs, _scfg(tenant_quota=6, queue_cap=24),
+                            MixSpec(tenants=3), rate_per_s=6000.0, n=150,
+                            seed=17, deadline_us=9000)
+        shas.append(res["response_log_sha"])
+    assert shas[0] == shas[1]
+
+
+def test_measure_capacity_resolves_everything():
+    kvs = KVS(_cfg(pipeline_depth=2))
+    cap = measure_capacity(kvs, _scfg(), MixSpec(tenants=2), n=80, seed=3)
+    assert cap["ops_per_round"] > 0
+    assert cap["ops"] >= 80
+
+
+def test_verify_serving_red_on_lost_response():
+    kvs = KVS(_cfg())
+    fe = Frontend(kvs, _scfg(), clock=VirtualClock())
+    fe.requests += 1  # a request that never got a response
+    with pytest.raises(AssertionError, match="conservation"):
+        verify_serving(fe)
+
+
+def test_scenario_matrix_and_seed_anchor():
+    seed = scenario_seed()
+    assert isinstance(seed, int) and seed == scenario_seed()
+    names = [s.name for s in scenario_matrix()]
+    assert names == ["uniform", "zipfian", "hotkey"]
+
+
+def test_tcp_rpc_server_end_to_end():
+    cfg = _cfg(pipeline_depth=2)
+    kvs = KVS(cfg)
+    fe = Frontend(kvs, _scfg())
+    srv = TcpRpcServer(fe)
+    try:
+        cl = RpcClient(srv.addr, fe.u)
+        put = cl.call("put", 9, value=[7, 7])
+        assert put.status == wire.S_OK and put.uid is not None
+        get = cl.call("get", 9)
+        assert get.status == wire.S_OK and get.value[:2] == [7, 7]
+        cl.close()
+    finally:
+        srv.close()
+
+
+def test_tcp_rpc_req_id_collision_across_connections():
+    # client req_ids are only unique PER CONNECTION: two clients both
+    # numbering from 1 must not collide in the frontend's pending map or
+    # steal each other's responses (the server re-mints internal ids)
+    cfg = _cfg(pipeline_depth=2)
+    kvs = KVS(cfg)
+    fe = Frontend(kvs, _scfg())
+    srv = TcpRpcServer(fe)
+    try:
+        a = RpcClient(srv.addr, fe.u)
+        b = RpcClient(srv.addr, fe.u)
+        assert a._next_id == b._next_id == 1
+        pa = a.call("put", 3, value=[11, 0], tenant=1)
+        pb = b.call("put", 4, value=[22, 0], tenant=2)
+        assert pa.status == wire.S_OK and pb.status == wire.S_OK
+        ga = a.call("get", 3, tenant=1)
+        gb = b.call("get", 4, tenant=2)
+        assert ga.value[:2] == [11, 0], "client A got someone else's answer"
+        assert gb.value[:2] == [22, 0], "client B got someone else's answer"
+        # the responses echo EACH CLIENT's own req_id numbering
+        assert ga.req_id == gb.req_id == 2
+        a.close()
+        b.close()
+    finally:
+        srv.close()
+
+
+def test_admission_refusal_does_not_charge_token_bucket():
+    # a quota/queue refusal must not burn the tenant's rate budget: the
+    # bucket is charged LAST, only on actual admission
+    from hermes_tpu.serving.admission import AdmissionControl
+
+    scfg = _scfg(tenant_quota=1, tenant_rate_per_s=10.0, tenant_burst=2.0,
+                 hot_keys=(0,))
+    adm = AdmissionControl(scfg)
+    assert adm.admit("put", 0, 7, 0.0, 0, False)[0] == wire.R_NONE
+    adm.note_admitted(7)
+    for _ in range(5):  # quota-refused retries, bucket untouched
+        assert adm.admit("put", 0, 7, 0.0, 0, False)[0] == wire.R_QUOTA
+    assert adm.tenant(7).bucket.tokens == 1.0
+    # queue-full refusals don't charge either (hot-key get: passes the
+    # shed ladder at full queue, refused by the queue bound itself)
+    for _ in range(3):
+        reason, _w = adm.admit("get", 0, 8, 0.0, scfg.queue_cap, False)
+        assert reason == wire.R_QUEUE_FULL
+    assert adm.tenant(8).bucket.tokens == 2.0
+
+
+def test_tcp_rpc_undecodable_request_refused_loudly():
+    # a frame-valid request the server cannot decode (payload-width
+    # mismatch) must come back S_REJECTED, never silence + client timeout
+    import socket as socket_mod
+
+    from hermes_tpu.transport.tcp import FramedSocket
+
+    cfg = _cfg(pipeline_depth=2)
+    kvs = KVS(cfg)
+    fe = Frontend(kvs, _scfg())
+    srv = TcpRpcServer(fe)
+    try:
+        fsock = FramedSocket(socket_mod.create_connection(srv.addr,
+                                                          timeout=10.0))
+        req = wire.Request(kind="put", req_id=77, tenant=0, key=1,
+                           value=[5])
+        fsock.send(wire.encode_request(req, fe.u + 3))  # wrong width
+        raw = fsock.recv()
+        rsp = wire.decode_response(raw, fe.u)
+        assert rsp.status == wire.S_REJECTED and rsp.req_id == 77
+        assert srv.undecodable == 1
+        fsock.close()
+    finally:
+        srv.close()
+
+
+def test_run_gates_records_timed_out(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    try:
+        import run_gates
+    finally:
+        sys.path.pop(0)
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "wedge.py").write_text(
+        "import subprocess, sys, time\n"
+        # a grandchild too: the process-group kill must take it down
+        "subprocess.Popen([sys.executable, '-c', 'import time; "
+        "time.sleep(60)'])\n"
+        "time.sleep(60)\n")
+    old_repo = run_gates.REPO
+    run_gates.REPO = str(tmp_path)
+    try:
+        r = run_gates.run_gate("wedge", "wedge.py", timeout=2)
+    finally:
+        run_gates.REPO = old_repo
+    assert r["timed_out"] is True and r["ok"] is False
+    assert r["seconds"] < 30
+    assert "serving" in [g[0] for g in run_gates.GATES]
