@@ -109,12 +109,80 @@ def _scan_acc_cell() -> KernelCell:
                       note="loop-carried ref accumulation sentinel")
 
 
+def _mega_cfg(n_keys: int = 16):
+    from hermes_tpu.config import HermesConfig
+
+    return HermesConfig(n_replicas=2, n_keys=n_keys, n_sessions=4,
+                        replay_slots=2, ops_per_session=4,
+                        arb_mode="sort", mega_round=True)
+
+
+def _mega_route_cell() -> KernelCell:
+    import jax.numpy as jnp
+
+    from hermes_tpu.core import megaround
+
+    cfg = _mega_cfg()
+    R, L = cfg.n_replicas, cfg.n_lanes
+    shapes = tuple(_sds((R, L), jnp.int32) for _ in range(3))
+    return KernelCell(
+        name="mega_route/r2l6", fn=lambda si, w, sr:
+        megaround.mega_route(cfg, si, w, sr), shapes=shapes,
+        in_avs=seeds_lib.seed_mega_route(cfg),
+        note="serial permutation route-back + slot region (round-15)")
+
+
+def _mega_apply_cell() -> KernelCell:
+    import jax.numpy as jnp
+
+    from hermes_tpu.core import megaround
+
+    cfg = _mega_cfg()
+    N = 2 * cfg.n_lanes + 4  # slots + replay rows shape
+    shapes = (_sds((cfg.n_keys,), jnp.int32), _sds((N,), jnp.int32),
+              _sds((N,), jnp.int32), _sds((N,), jnp.int32))
+    return KernelCell(
+        name="mega_apply/k16n16", fn=lambda v, k, p, m:
+        megaround.mega_apply(cfg, v, k, p, m), shapes=shapes,
+        in_avs=seeds_lib.seed_mega_apply(cfg),
+        note="two-phase scatter-max + verdict read-back; keys span the "
+             "untrusted 29-bit wire field (drop/clamp exercised)")
+
+
+def _mega_replay_cell(name: str, n_keys: int, block_bytes: int,
+                      note: str) -> KernelCell:
+    import jax.numpy as jnp
+
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.core import megaround
+
+    cfg = _mega_cfg(n_keys=n_keys)
+    R, RS, V4 = cfg.n_replicas, cfg.replay_slots, 4 * cfg.value_words
+    W4 = 4 * (2 + cfg.value_words)
+    K = cfg.n_keys
+
+    def fn(step, act, frozen, bank, vpts, key, pts, acks, val):
+        rep = fst.FastReplay(active=act, key=key, pts=pts, val=val,
+                             acks=acks)
+        return megaround.mega_replay(cfg, step, frozen, vpts, bank, rep,
+                                     block_bytes=block_bytes)
+
+    shapes = (_sds((), jnp.int32), _sds((R, RS), jnp.bool_),
+              _sds((R,), jnp.bool_), _sds((K, W4), jnp.int8),
+              _sds((K,), jnp.int32), _sds((R, RS), jnp.int32),
+              _sds((R, RS), jnp.int32), _sds((R, RS), jnp.int32),
+              _sds((R, RS, V4), jnp.int8))
+    return KernelCell(name=name, fn=fn, shapes=shapes,
+                      in_avs=seeds_lib.seed_mega_replay(cfg), note=note)
+
+
 def kernel_cells() -> List[KernelCell]:
     """The gate's kernel matrix: every in-tree Pallas kernel at the
     shapes that exercise its distinct code paths (the block-size
     formula in kernels.stats_block makes R drive the block cap, so a
-    tall R forces the multi-block grid at small S), plus the synthetic
-    scan-accumulate sentinel."""
+    tall R forces the multi-block grid at small S; the mega_replay
+    block override forces its multi-block grid + streaming scratch at
+    toy shapes), plus the synthetic scan-accumulate sentinel."""
     return [
         _stats_cell("stats_block/r4s512", 4, 512,
                     note="single block, no padding"),
@@ -124,6 +192,14 @@ def kernel_cells() -> List[KernelCell]:
         _stats_cell("stats_block/r512s2000", 512, 2000,
                     note="3-block grid, ragged"),
         _scan_acc_cell(),
+        _mega_route_cell(),
+        _mega_apply_cell(),
+        _mega_replay_cell("mega_replay/k16b1", 16, 1 << 20,
+                          note="single table block (round-15)"),
+        _mega_replay_cell("mega_replay/k22b3", 22, 8 * 40,
+                          note="multi-block RAGGED grid (3 blocks of 8 "
+                               "over 22 rows): streaming candidate "
+                               "cursor crosses block visits"),
     ]
 
 

@@ -157,7 +157,12 @@ def analyze_config(cfg: HermesConfig, engines=("batched", "sharded"),
     "both" | "as-is"."""
     cfgs = [cfg]
     if variants == "both" and cfg.use_fused_sort:
-        cfgs.append(dataclasses.replace(cfg, fused_sort=False))
+        # the split program is the A/B baseline for BOTH the fused sort
+        # and the round-15 mega path, so the variant drops mega_round
+        # too (a split mega config is not constructible — the mega route
+        # consumes the fused sort's verdicts)
+        cfgs.append(dataclasses.replace(cfg, fused_sort=False,
+                                        mega_round=False))
     reports = []
     for engine in engines:
         for c in cfgs:

@@ -273,17 +273,24 @@ def _interpret_kernel(eqn, ins: List[AbsVal], ctx: I.Ctx) -> List[AbsVal]:
     # -- phase 2: steady state over the whole grid --------------------------
     if total > 1:
         kctx.pid = [D.iv(0, max(0, g - 1)) for g in grid]
+        unstable: set = set()
         for it in range(4):
             pre = {v: c.snapshot() for v, c in kctx.cells.items()}
             for o, c in enumerate(out_cells):
                 if not c.revisit:  # a fresh block every visit
                     c.restore(out_seed[o])
-            if it == 3:  # widen: unstable revisited cells go dtype-TOP
-                for c in kctx.cells.values():
-                    if c.av is not None:
-                        c.av = D.top(c.dtype)
+            if it == 3:
+                # widen ONLY the cells the previous iteration showed
+                # still moving: a blanket widen would discard the seeded
+                # bounds of never-stored inputs (the SMEM step scalar)
+                # and of accumulators that stabilized early — exactly
+                # the bounds the bitpack pass needs inside the mega
+                # kernels.  Monotone transfer rules propagate
+                # instability, so stable cells are genuine fixpoints.
+                _widen_cells(kctx, only=unstable)
             run_visit()
             stable = True
+            unstable = set()
             for v, c in kctx.cells.items():
                 joined = _join_snaps(pre[v], c.snapshot())
                 if c.kind == "out" and not c.revisit:
@@ -292,9 +299,21 @@ def _interpret_kernel(eqn, ins: List[AbsVal], ctx: I.Ctx) -> List[AbsVal]:
                     continue
                 if joined != pre[v]:
                     stable = False
+                    unstable.add(v)
                 c.restore(joined)
             if stable:
                 break
+        if unstable:
+            # soundness belt: something STILL moved after the widened
+            # pass (a cell destabilized by a neighbor's widening, or a
+            # chain the selective widen missed).  Escalate to the old
+            # blanket behavior: every cell to dtype-TOP, one more visit
+            # so the TOP-derived values PROPAGATE into out_acc and
+            # dependent cells, then re-pin (a full-block store in that
+            # visit must not un-widen a cell).
+            _widen_cells(kctx)
+            run_visit()
+            _widen_cells(kctx)
 
     outs = []
     for o, v in enumerate(eqn.outvars):
@@ -608,27 +627,36 @@ def _induction_bounds(j, nc, ncar, init, length):
     return out
 
 
-def _widen_cells(kctx) -> None:
-    """Last-iteration widening: any cell holding a value may hold ANY
-    dtype value after more iterations (init states form a finite
-    min-join lattice and converge on their own)."""
-    for c in kctx.cells.values():
+def _widen_cells(kctx, only=None) -> None:
+    """Widening: a cell named in ``only`` (default: every cell) may hold
+    ANY dtype value after more iterations (init states form a finite
+    min-join lattice and converge on their own).  Callers pass the set
+    of cells their fixpoint loop measured UNSTABLE so early-stabilized
+    accumulators and never-stored inputs keep their seeded bounds —
+    monotone transfer rules propagate instability, so a stable cell is a
+    genuine fixpoint."""
+    for v, c in kctx.cells.items():
+        if only is not None and v not in only:
+            continue
         if c.av is not None:
             c.av = D.top(c.dtype)
 
 
-def _join_cells_pre(kctx, pre) -> bool:
+def _join_cells_pre(kctx, pre, unstable=None) -> bool:
     """Kleene step for loop-carried cell state: join each cell's
     post-body state into its pre-body state; True when stable.  Without
     this the loop fixpoint would check only SSA carries and a
     ``ref[...] += 1`` accumulation would 'converge' after one body
     evaluation — an under-approximation the differential sanitizer
-    red-tests (scan-accumulate cell)."""
+    red-tests (scan-accumulate cell).  ``unstable`` (a set) collects the
+    cells that moved, for the selective widening above."""
     stable = True
     for v, c in kctx.cells.items():
         joined = _join_snaps(pre[v], c.snapshot())
         if joined != pre[v]:
             stable = False
+            if unstable is not None:
+                unstable.add(v)
         c.restore(joined)
     return stable
 
@@ -645,23 +673,34 @@ def _eval_scan_k(eqn, ins, ctx, kctx):
 
     carry = [p if p is not None else c for p, c in zip(pinned, init)]
     ys = [D.top(v.aval.dtype) for v in eqn.outvars[ncar:]]
+    unstable: set = set()
     for it in range(5):
         if it == 4:
             carry = [p if p is not None else
                      (AbsVal(min(c.lo, -(1 << 63)), max(c.hi, 1 << 63))
                       if (c.lo, c.hi) != (i.lo, i.hi) else c)
                      for p, c, i in zip(pinned, carry, init)]
-            _widen_cells(kctx)
+            _widen_cells(kctx, only=unstable)
         pre = {v: c.snapshot() for v, c in kctx.cells.items()}
         o = _eval_jaxpr_k(j, consts + carry + xs, ctx, kctx, jconsts)
         ys = o[ncar:]
-        cells_stable = _join_cells_pre(kctx, pre)
+        unstable = set()
+        cells_stable = _join_cells_pre(kctx, pre, unstable)
         nxt = [p if p is not None else D.join(c, n)
                for p, c, n in zip(pinned, carry, o[:ncar])]
         if cells_stable and all(n.lo == c.lo and n.hi == c.hi
                                 for n, c in zip(nxt, carry)):
             break
         carry = nxt
+    if unstable:
+        # soundness belt, with propagation: blanket-widen, re-evaluate
+        # the body once so TOP reaches ys/carry and dependent cells,
+        # then re-pin the widened cell state
+        _widen_cells(kctx)
+        o = _eval_jaxpr_k(j, consts + carry + xs, ctx, kctx, jconsts)
+        ys = [D.join(a, b) for a, b in zip(ys, o[ncar:])]
+        carry = [D.join(c, n) for c, n in zip(carry, o[:ncar])]
+        _widen_cells(kctx)
     outs = carry + list(ys)
     return [D.clamp(a, v.aval.dtype)[0] for a, v in zip(outs, eqn.outvars)]
 
@@ -675,18 +714,26 @@ def _eval_while_k(eqn, ins, ctx, kctx):
     bconsts_avs = ins[cn:cn + bn]
     init = ins[cn + bn:]
     carry = list(init)
+    unstable: set = set()
     for it in range(5):
         if it == 4:
             carry = [AbsVal(min(c.lo, -(1 << 63)), max(c.hi, 1 << 63))
                      if (c.lo, c.hi) != (i.lo, i.hi) else c
                      for c, i in zip(carry, init)]
-            _widen_cells(kctx)
+            _widen_cells(kctx, only=unstable)
         pre = {v: c.snapshot() for v, c in kctx.cells.items()}
         o = _eval_jaxpr_k(bj, bconsts_avs + carry, ctx, kctx, bconsts)
-        cells_stable = _join_cells_pre(kctx, pre)
+        unstable = set()
+        cells_stable = _join_cells_pre(kctx, pre, unstable)
         nxt = [D.join(c, n) for c, n in zip(carry, o)]
         if cells_stable and all(n.lo == c.lo and n.hi == c.hi
                                 for n, c in zip(nxt, carry)):
             break
         carry = nxt
+    if unstable:
+        # soundness belt, with propagation (see _eval_scan_k)
+        _widen_cells(kctx)
+        o = _eval_jaxpr_k(bj, bconsts_avs + carry, ctx, kctx, bconsts)
+        carry = [D.join(c, n) for c, n in zip(carry, o)]
+        _widen_cells(kctx)
     return [D.clamp(a, v.aval.dtype)[0] for a, v in zip(carry, eqn.outvars)]

@@ -119,6 +119,37 @@ def seed_round_args(cfg: HermesConfig, has_uval: bool = False) -> tuple:
 # --------------------------------------------------------------------------
 
 
+def seed_mega_route(cfg: HermesConfig) -> list:
+    """Bounds for ``core.megaround.mega_route(si, word, srank)``: si is a
+    lane permutation ([0, n_lanes)), word the packed per-lane verdict
+    (layouts.LANE_WORD fields), srank the slot-rank bijection ([0,
+    n_lanes) for live entries; the kernel clamps+guards, so the declared
+    hull is the dense formula's)."""
+    L = cfg.n_lanes
+    word_hi = (layouts.LANE_WORD.field("taken").mask
+               | layouts.LANE_WORD.field("issue").mask
+               | layouts.LANE_WORD.field("chain_rank").mask)
+    return [iv(0, L - 1), iv(0, word_hi), iv(0, 2 * L)]
+
+
+def seed_mega_apply(cfg: HermesConfig) -> list:
+    """Bounds for ``core.megaround.mega_apply(vpts, keys, pts, mask)``:
+    keys deliberately span the full 29-bit WIRE field (the sharded path
+    feeds untrusted inbound keys — the kernel must drop/clamp them, and
+    the sanitizer draws them)."""
+    return [pts_seed(cfg), iv(0, layouts.INV_PKF.field("key").cap - 1),
+            pts_seed(cfg), iv(0, 1)]
+
+
+def seed_mega_replay(cfg: HermesConfig) -> list:
+    """Bounds for the mega_replay cell wrapper (step, replay fields,
+    frozen, bank, vpts, key, pts, acks, val) — same sources as
+    seed_fast_state's replay/table rows."""
+    key = iv(0, cfg.n_keys - 1)
+    return [step_seed(cfg), BOOL, BOOL, I8_TOP, pts_seed(cfg), key,
+            pts_seed(cfg), iv(0, cfg.full_mask), I8_TOP]
+
+
 def seed_stats_block() -> list:
     """One AbsVal per ``core.kernels.stats_block`` argument (step,
     sess_op, invoke_step, commit, abort, read_done) — the same bounds

@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--arb-mode", choices=["race", "sort"], default="race",
                     help="same-key issue arbitration strategy (faststep)")
+    ap.add_argument("--mega-round", action="store_true",
+                    help="round-15 Pallas mega-round (core/megaround.py): "
+                         "fuse the arbiter/apply/quorum chain's sparse ops "
+                         "into kernels — bit-identical state, batched "
+                         "census 12 -> 4; needs --arb-mode sort; falls "
+                         "back LOUDLY to the fused-sort program when "
+                         "Pallas/analysis refuse")
     ap.add_argument("--chain-writes", type=int, default=0,
                     help="intra-round same-key write chain length (faststep "
                          "hot-key throughput; needs --arb-mode sort)")
@@ -367,12 +374,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.chain_writes and args.arb_mode != "sort":
         ap.error("--chain-writes needs --arb-mode sort")
-    if ((args.arb_mode != "race" or args.chain_writes
+    if args.mega_round and args.arb_mode != "sort":
+        ap.error("--mega-round needs --arb-mode sort (the mega route "
+                 "kernel consumes the fused sort's verdicts)")
+    if ((args.arb_mode != "race" or args.chain_writes or args.mega_round
          or args.no_auto_rebase or args.rmw_retries)
             and args.backend not in ("fast", "fast-sharded")):
-        ap.error("--arb-mode/--chain-writes/--no-auto-rebase/--rmw-retries "
-                 "only affect the fast backends (core/faststep.py / runtime."
-                 "FastRuntime); use --backend fast or fast-sharded")
+        ap.error("--arb-mode/--chain-writes/--mega-round/--no-auto-rebase/"
+                 "--rmw-retries only affect the fast backends "
+                 "(core/faststep.py / runtime.FastRuntime); use --backend "
+                 "fast or fast-sharded")
     if args.pipeline_depth < 1:
         ap.error("--pipeline-depth must be >= 1")
     if ((args.pipeline_depth > 1 or args.no_donate)
@@ -492,6 +503,7 @@ def main(argv=None) -> int:
         wrap_stream=args.wrap_stream,
         arb_mode=args.arb_mode,
         chain_writes=args.chain_writes,
+        mega_round=args.mega_round,
         rmw_retries=args.rmw_retries,
         auto_rebase=not args.no_auto_rebase,
         pipeline_depth=args.pipeline_depth,

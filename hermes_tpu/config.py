@@ -13,6 +13,13 @@ from typing import Literal, Optional, Tuple
 
 from hermes_tpu.core import layouts
 
+#: Mega-round VMEM budget for the (K,) vpts arbiter column (round-15):
+#: the apply kernel keeps the whole packed-ts column on-chip (4 bytes/key
+#: — 4 MB at the 1M-key bench shape against ~16 MB VMEM/core); configs
+#: past this must run the fused-sort program (config validation refuses
+#: mega_round loudly instead of silently spilling to HBM).
+MEGA_VPTS_VMEM_BYTES = 8 << 20
+
 # The declared chain-rank field must hold every legal chain_writes value
 # (the [0, 4096] protocol bound below); a layout edit that shrinks the
 # field without revisiting the bound fails at import, not at runtime.
@@ -115,6 +122,22 @@ class HermesConfig:
     # and the fallback when the packed key cannot hold the shape —
     # use_fused_sort is the resolved switch).
     fused_sort: bool = True
+
+    # Round-15 Pallas mega-round (core/megaround.py): fuse the fused-sort
+    # round's route-back scatter, the arbiter scatter-max + post-arbiter
+    # verdict gather, and the cond-gated replay scan's sparse interior
+    # into Pallas kernels stepping the packed per-key state (the
+    # core/layouts.py word tables) with the vpts arbiter column resident
+    # in VMEM — batched sparse census 12 -> 4, sharded 15 -> 7 (the
+    # measured cost model prices each removed op at ~1.3-2.4 ms/round).
+    # Resolution follows the fused_sort pattern: ``use_mega_round`` is the
+    # resolved switch, the fused-sort program remains the A/B baseline and
+    # the automatic fallback — core/megaround.resolve() additionally
+    # refuses (loudly, via warnings) when the kernel self-check fails to
+    # compile on this backend or the invariant analyzer flags the kernel
+    # bodies.  Requires the fused sort (the mega route consumes its
+    # sorted-order verdicts) and a VMEM-residable arbiter column.
+    mega_round: bool = False
 
     # Intra-round same-key write chaining (sort arbiter only): up to this
     # many of a replica's wanting sessions for ONE key issue per round as a
@@ -262,6 +285,21 @@ class HermesConfig:
                 "chain_writes needs arb_mode='sort' (chain ranks come from "
                 "the sorted equal-key runs)"
             )
+        if self.mega_round:
+            # loud at construction for knob mismatches a caller controls;
+            # platform/analysis refusals fall back automatically at build
+            # time instead (core/megaround.resolve warns)
+            if self.arb_mode != "sort" or not self.fused_sort:
+                raise ValueError(
+                    "mega_round needs arb_mode='sort' and fused_sort=True "
+                    "(the mega route kernel consumes the fused sort's "
+                    "sorted-order verdicts)")
+            if 4 * self.n_keys > MEGA_VPTS_VMEM_BYTES:
+                raise ValueError(
+                    f"mega_round needs the vpts arbiter column VMEM-"
+                    f"resident: 4*n_keys = {4 * self.n_keys} bytes exceeds "
+                    f"the {MEGA_VPTS_VMEM_BYTES}-byte budget "
+                    f"(config.MEGA_VPTS_VMEM_BYTES)")
         if not (0 <= self.rmw_retries <= (1 << 20)):
             raise ValueError("rmw_retries must be in [0, 2^20]")
         if self.op_timeout_rounds < 0:
@@ -323,6 +361,19 @@ class HermesConfig:
         program."""
         return (self.arb_mode == "sort" and self.fused_sort
                 and self.n_lanes <= layouts.FUSED_KEY.field("sub").cap)
+
+    @property
+    def use_mega_round(self) -> bool:
+        """Statically-resolved mega-round switch (round-15): the config
+        half of the resolution — the knob is on and the fused sort
+        resolves (the mega route consumes its sorted-order verdicts).
+        The VMEM budget needs no re-check here: __post_init__ refuses a
+        mega_round config whose vpts column exceeds MEGA_VPTS_VMEM_BYTES
+        at construction (one source of truth, loud).  The build-time
+        half (kernel self-check + invariant analysis, which can refuse
+        per backend) lives in ``core/megaround.resolve``; the fused-sort
+        program is the automatic fallback."""
+        return self.mega_round and self.use_fused_sort
 
     @property
     def lane_budget(self) -> int:

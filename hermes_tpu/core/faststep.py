@@ -73,7 +73,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hermes_tpu.config import HermesConfig
-from hermes_tpu.core import compat, kernels, layouts
+from hermes_tpu.core import compat, kernels, layouts, megaround
 from hermes_tpu.core import state as st
 from hermes_tpu.core import types as t
 
@@ -811,6 +811,21 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
             ].set(mark, mode="drop")
         return table._replace(bank=new_bank), new_replay
 
+    if megaround.resolve(cfg):
+        # round-15: the scan's 4 gathers + top_k + mark scatter run
+        # block-gridded inside one Pallas launch (streaming candidate
+        # selection in global row order == top_k of -kiota; per-replica
+        # free-slot assignment and REPLAY marks block-local) — same
+        # (table, replay) trees bit-for-bit, and the launch only fires
+        # under this cond every replay_scan_every rounds
+        def do_scan(args):
+            table, replay = args
+            bank, (nact, nkey, npts, nacks, nval) = megaround.mega_replay(
+                cfg, step, ctl.frozen, table.vpts, table.bank, replay)
+            return (table._replace(bank=bank),
+                    FastReplay(active=nact, key=nkey, pts=npts, val=nval,
+                               acks=nacks))
+
     table, replay = jax.lax.cond(
         step % cfg.replay_scan_every == 0,
         do_scan,
@@ -908,15 +923,24 @@ def _coordinate(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
         # max == set.
         word = ((staken.astype(jnp.int32) << LANE_TAKEN_SHIFT)
                 | (issue.astype(jnp.int32) << LANE_ISSUE_SHIFT) | rank_word)
-        gz = jnp.zeros((R * (L + C),), jnp.int32)
-        ridx = jnp.arange(R, dtype=jnp.int32)[:, None] * (L + C)
-        tgt = jnp.concatenate(
-            [ridx + si,
-             jnp.where(srank < C, ridx + L + srank, R * (L + C))], axis=1)
-        upd = jnp.concatenate([word, si], axis=1)
-        flat = gz.at[tgt].max(upd, mode="drop").reshape(R, L + C)
-        lane_word = flat[:, :L]
-        slot_lane = flat[:, L:]
+        if megaround.resolve(cfg):
+            # round-15: the permutation route-back runs serially inside
+            # the mega route kernel (unique targets, so serial set ==
+            # the max-on-zeros scatter below) — one sparse op off the
+            # chain, same (lane_word, slot_lane) arrays bit-for-bit
+            lane_word, slot_lane = megaround.mega_route(cfg, si, word,
+                                                        srank)
+        else:
+            gz = jnp.zeros((R * (L + C),), jnp.int32)
+            ridx = jnp.arange(R, dtype=jnp.int32)[:, None] * (L + C)
+            tgt = jnp.concatenate(
+                [ridx + si,
+                 jnp.where(srank < C, ridx + L + srank, R * (L + C))],
+                axis=1)
+            upd = jnp.concatenate([word, si], axis=1)
+            flat = gz.at[tgt].max(upd, mode="drop").reshape(R, L + C)
+            lane_word = flat[:, :L]
+            slot_lane = flat[:, L:]
         taken_lane = (lane_word & (1 << LANE_TAKEN_SHIFT)) != 0
         win = want & ((lane_word[:, :S] & (1 << LANE_ISSUE_SHIFT)) != 0)
         if cfg.chain_writes:
@@ -1053,28 +1077,47 @@ def _apply_inv(cfg: HermesConfig, ctl: FastCtl, fs: FastState, inv_src: FastInv,
     across a shard's replicas (FastRuntime bumps them together).  (The
     reference phases engine keeps the fuller per-replica Write/Trans
     bookkeeping.)"""
-    fs = _apply_inv_arb(cfg, ctl, fs, inv_src)
     key0, pts0 = inv_src.key, inv_src.pts
     v_ok = inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
-    # ONE post-arbiter gather serves BOTH consumers of the settled vpts
-    # (round-6 op diet): the per-slot verdicts below AND the replay
-    # supersession test in _collect_acks (the local replay slots' keys ride
-    # the same index vector — vpts is written only by the scatter-max
-    # above, so the value is final for the round).  Gathers are priced by
-    # COUNT, not extent, on this runtime.
-    #
-    # The inbound key is an untrusted 29-bit WIRE field (layouts.INV_PKF)
-    # while the local table has only K rows: a corrupt peer's slot would
-    # index out of bounds in this promised-in-bounds gather (undefined),
-    # so clamp — a correct peer never sends key >= K, the min fuses into
-    # the index computation (no new sparse op), and a clamped bogus slot
-    # yields a garbage-but-defined verdict its v_ok mask already ignores.
-    # (The scatter path needs no clamp: mode="drop".)  Surfaced by the
-    # analysis scatter pass (oob-promised-index).
     nslot = key0.size
-    kcap = fs.table.vpts.shape[0] - 1
-    joint = fs.table.vpts[jnp.minimum(jnp.concatenate(
-        [key0.reshape(-1), replay_key.reshape(-1)]), kcap)]
+    if megaround.resolve(cfg):
+        # round-15: the arbiter scatter-max AND the joint verdict gather
+        # below fuse into one mega_apply launch over the same index
+        # vector (slots + local replay keys; replay rows carry a zero
+        # mask — verdict read only).  The kernel keeps the wire-key
+        # semantics exactly: >= K drops from the max, clamps for the read.
+        keys_all = jnp.concatenate([key0.reshape(-1),
+                                    replay_key.reshape(-1)])
+        pts_all = jnp.concatenate(
+            [pts0.reshape(-1),
+             jnp.zeros((replay_key.size,), jnp.int32)])
+        mask_all = jnp.concatenate(
+            [v_ok.reshape(-1), jnp.zeros((replay_key.size,), jnp.bool_)])
+        vpts, joint = megaround.mega_apply(cfg, fs.table.vpts, keys_all,
+                                           pts_all, mask_all)
+        fs = fs._replace(table=fs.table._replace(vpts=vpts),
+                         meta=_apply_inv_meta(ctl, fs.meta, inv_src))
+    else:
+        fs = _apply_inv_arb(cfg, ctl, fs, inv_src)
+        # ONE post-arbiter gather serves BOTH consumers of the settled
+        # vpts (round-6 op diet): the per-slot verdicts below AND the
+        # replay supersession test in _collect_acks (the local replay
+        # slots' keys ride the same index vector — vpts is written only
+        # by the scatter-max above, so the value is final for the round).
+        # Gathers are priced by COUNT, not extent, on this runtime.
+        #
+        # The inbound key is an untrusted 29-bit WIRE field
+        # (layouts.INV_PKF) while the local table has only K rows: a
+        # corrupt peer's slot would index out of bounds in this
+        # promised-in-bounds gather (undefined), so clamp — a correct
+        # peer never sends key >= K, the min fuses into the index
+        # computation (no new sparse op), and a clamped bogus slot
+        # yields a garbage-but-defined verdict its v_ok mask already
+        # ignores.  (The scatter path needs no clamp: mode="drop".)
+        # Surfaced by the analysis scatter pass (oob-promised-index).
+        kcap = fs.table.vpts.shape[0] - 1
+        joint = fs.table.vpts[jnp.minimum(jnp.concatenate(
+            [key0.reshape(-1), replay_key.reshape(-1)]), kcap)]
     post0 = joint[:nslot].reshape(key0.shape)
     replay_post = joint[nslot:].reshape(replay_key.shape)
     win0 = v_ok & (pts0 == post0)
@@ -1090,13 +1133,19 @@ def _apply_inv_arb(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     per-slot post0 gather + slot->lane scatter of the wire path are not."""
     v_ok = inv_src.valid & (inv_src.epoch == ctl.epoch[0])[..., None]
     table = _ts_scatter_max(fs.table, inv_src.key, inv_src.pts, v_ok)
-    meta = fs.meta._replace(
+    return fs._replace(table=table, meta=_apply_inv_meta(ctl, fs.meta,
+                                                         inv_src))
+
+
+def _apply_inv_meta(ctl: FastCtl, meta, inv_src: FastInv):
+    """The apply_inv last_seen heartbeat fold (dense; shared by the XLA
+    scatter path and the round-15 mega path)."""
+    return meta._replace(
         last_seen=jnp.where(
             inv_src.alive[None, :] & ~ctl.frozen[:, None], ctl.step,
-            fs.meta.last_seen,
+            meta.last_seen,
         )
     )
-    return fs._replace(table=table, meta=meta)
 
 
 def _ts_scatter_max(table: FastTable, keys, pts, mask):
@@ -1140,16 +1189,28 @@ def _apply_inv_lanes(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
     identical row multiset to _apply_inv_arb over the compacted slots
     (taken_lane marks exactly the lanes holding a slot; OOB-masked rows cost
     the same as live rows on this chip, so the wider lane extent is free),
-    minus the lane->slot take_along routing."""
+    minus the lane->slot take_along routing.
+
+    Returns ``(fs, post_lane)``: on the round-15 mega path the apply
+    kernel also reads back the settled per-lane verdict (post_lane), so
+    ``_derived_acks`` skips its vpts gather; on the XLA path post_lane is
+    None and the gather stays."""
     v_ok = taken_lane & (ctl.epoch == ctl.epoch[0])[:, None]
-    table = _ts_scatter_max(fs.table, lanes.key, lanes.pts, v_ok)
+    if megaround.resolve(cfg):
+        vpts, post = megaround.mega_apply(cfg, fs.table.vpts, lanes.key,
+                                          lanes.pts, v_ok)
+        table = fs.table._replace(vpts=vpts)
+        post_lane = post.reshape(lanes.key.shape)
+    else:
+        table = _ts_scatter_max(fs.table, lanes.key, lanes.pts, v_ok)
+        post_lane = None
     meta = fs.meta._replace(
         last_seen=jnp.where(
             ~ctl.frozen[None, :] & ~ctl.frozen[:, None], ctl.step,
             fs.meta.last_seen,
         )
     )
-    return fs._replace(table=table, meta=meta)
+    return fs._replace(table=table, meta=meta), post_lane
 
 
 def _apply_commit_lanes(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
@@ -1198,7 +1259,7 @@ def _apply_commit(cfg: HermesConfig, ctl: FastCtl, fs: FastState,
 
 
 def _derived_acks(ctl: FastCtl, table: FastTable, taken_lane, pend_key,
-                  pend_pts):
+                  pend_pts, post_lane=None):
     """Lockstep-batched ACK derivation — the quorum bitmap without the wire,
     computed per LANE (no slot->lane scatter).
 
@@ -1219,7 +1280,8 @@ def _derived_acks(ctl: FastCtl, table: FastTable, taken_lane, pend_key,
     abits = jnp.sum(
         jnp.where(~ctl.frozen, jnp.int32(1) << jnp.arange(R, dtype=jnp.int32), 0)
     ).astype(jnp.int32)
-    post_lane = table.vpts[pend_key]  # (R, L) post-scatter arbiter
+    if post_lane is None:  # mega path delivers it from the apply kernel
+        post_lane = table.vpts[pend_key]  # (R, L) post-scatter arbiter
     survived = post_lane == pend_pts
     gained = jnp.where(taken_lane, abits, 0)
     nacked = taken_lane & ~survived & (abits != 0)
@@ -1466,9 +1528,9 @@ def fast_round_batched(cfg: HermesConfig, ctl: FastCtl, fs: FastState, stream):
     VAL phase does not exist here."""
     (fs, lanes, slot_lane, taken_lane, read_done,
      read_extra, sub_comps, pre_comm) = _coordinate(cfg, ctl, fs, stream)
-    fs = _apply_inv_lanes(cfg, ctl, fs, lanes, taken_lane)
+    fs, kpost = _apply_inv_lanes(cfg, ctl, fs, lanes, taken_lane)
     gained, nacked, win_lane, post_lane = _derived_acks(
-        ctl, fs.table, taken_lane, lanes.key, lanes.pts
+        ctl, fs.table, taken_lane, lanes.key, lanes.pts, post_lane=kpost
     )
     fs, commit_lane, comp = _collect_acks(cfg, ctl, fs, gained, nacked,
                                           taken_lane, read_done,
@@ -1566,6 +1628,8 @@ def pending_sessions(status, live_mask, frozen):
 
 
 def build_fast_batched(cfg: HermesConfig, donate: bool = False):
+    megaround.resolve(cfg)  # warm the cached probe outside any trace
+
     def step(fs, stream, ctl):
         return fast_round_batched(cfg, ctl, fs, stream)
 
@@ -1575,6 +1639,7 @@ def build_fast_batched(cfg: HermesConfig, donate: bool = False):
 def build_fast_scan(cfg: HermesConfig, rounds: int, donate: bool = True):
     """``rounds`` rounds per dispatch (amortizes the host round trip,
     SURVEY.md §7 M6).  Completions feed only the meta counters."""
+    megaround.resolve(cfg)  # warm the cached probe outside any trace
 
     def chunk(fs, stream, ctl):
         def body(carry, off):
@@ -1616,6 +1681,7 @@ def build_fast_sharded(cfg: HermesConfig, mesh: Mesh, rounds: int = 1,
     """The fast round under shard_map over Mesh(('replica',))."""
     if mesh.shape["replica"] != cfg.n_replicas:
         raise ValueError("mesh 'replica' axis must equal cfg.n_replicas")
+    megaround.resolve(cfg)  # warm the cached probe outside any trace
 
     def shard_body(fs, stream, ctl):
         my = jax.lax.axis_index("replica").astype(jnp.int32)

@@ -1,0 +1,554 @@
+"""Round-15: the Pallas mega-round (ISSUE 11).
+
+Throughput has been flat at ~13.7M committed writes/s since round 6
+because the measured cost model (ARCHITECTURE.md "Sparse-op COUNT
+dominates") prices a protocol round as (#sparse ops) x ~1.3-2.4 ms of
+nearly size-independent launch overhead — the PR-2 diet bottomed out at
+12 batched sparse ops and the op COUNT became the floor.  This module
+breaks the floor the way the original target spec (SNIPPETS.md header)
+asks: the arbiter -> apply -> quorum chain's sparse touches fuse into
+Pallas kernels that step the packed per-key state (the core/layouts.py
+word tables) with the (K,) vpts arbiter column resident in VMEM, so the
+batched round lowers to FOUR sparse XLA ops (2 intake row gathers + the
+one fused arbiter sort + the winner-row byte scatter) and the sharded
+round to seven (census gate: OP_BUDGET.json ``batched_mega`` /
+``sharded_mega``).
+
+What stays XLA, and why (each a measured decision, not an omission):
+
+  * the ONE fused arbiter+compaction ``lax.sort`` — Mosaic has no
+    vectorized random access (PALLAS_PROBE.json: ``vgather`` still fails
+    to lower), so an in-kernel arbitration would serialize R*S dependent
+    per-key scratch accesses (~5-15 ms at bench shape) against the
+    sort's ~1.8 ms; the sort is the right tool and its sorted-order
+    verdicts are exactly what the route kernel consumes;
+  * the intake bank-row gathers — random reads over the 46 MB table are
+    XLA's fast path and are not part of the arbiter/apply/quorum chain;
+  * the winner-row set-scatter — the int8 byte-move scatter is the
+    best-measured op on the chip (~2.3x faster than int32; faststep
+    header) and its value payload (R, L, 4V) cannot ride a VMEM-resident
+    kernel at bench shape (21 MB > VMEM).
+
+The three kernels (shared verbatim by both engines — the commit decision
+stays in the unchanged dense ``_collect_acks``, so there is no duplicated
+protocol logic to drift):
+
+  * ``mega_route``   — the fused sort's ONE permutation scatter, serial:
+    ``lane_word[si[p]] = word[p]`` plus the slot-ownership region
+    (``slot_lane[srank[p]] = si[p]`` for ``srank < C``) — unique targets,
+    so serial stores are exactly the max-on-zeros scatter they replace.
+  * ``mega_apply``   — the arbiter core: phase-gridded (grid ``(2,)``)
+    scatter-MAX of packed timestamps into the VMEM-resident vpts column
+    (phase 0), then the settled post-arbiter verdict read-back for every
+    row (phase 1) — one launch replacing the ``_ts_scatter_max`` scatter
+    AND the post/joint verdict gather.  Wire keys keep faststep's exact
+    semantics: a key >= K DROPS from the max (mode="drop" twin) and
+    CLAMPS for the verdict read (the promised-in-bounds gather twin).
+  * ``mega_replay``  — the cond-gated stuck-key scan: grid over
+    VMEM-sized table blocks, dense per-block stuck detection, streaming
+    candidate selection in global row order (bit-identical to the
+    ``top_k`` of ``-kiota``), per-replica free-slot assignment and the
+    REPLAY row marks all block-local — absorbing the scan's 4 gathers +
+    1 scatter (and the top_k) into one launch that only runs every
+    ``replay_scan_every`` rounds.
+
+Serial-access idiom: every dynamically-indexed array is shaped ``(N, 1)``
+and touched through ``pl.ds`` on the sublane dim — the one dynamic access
+shape Mosaic reliably lowers (scripts/pallas_probe.py's serial candidate,
+measured ~6 ns/iteration VMEM-resident and stamped ``analysis_clean``).
+Every dynamic index is clamped to its block (the analyzer proves the
+bound; the guard ``pl.when`` keeps the semantics exact), so the PR-8
+RefHazard pass walks all three kernels clean.
+
+Resolution (the ``fused_sort`` pattern): ``HermesConfig.use_mega_round``
+is the static half; ``resolve(cfg)`` adds the build-time half — a tiny
+concrete kernel self-test (catches a backend whose Pallas cannot compile;
+interpret mode keeps every CPU/test path working) and the invariant
+analyzer's verdict on the kernel bodies (a flagged kernel must not ship).
+Refusals warn LOUDLY once and fall back to the fused-sort program, which
+remains the A/B baseline (scripts/mega_compare.py measures the pair on
+chip).
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401 (SMEM scratch)
+
+from hermes_tpu.core import layouts
+from hermes_tpu.core import types as t
+
+# bank row word indices (mirrors faststep; importing faststep here would
+# cycle — the values are fixed by the declared row layout)
+_BANK_PTS, _BANK_SST, _BANK_VAL = 0, 1, 2
+
+#: mega_replay table-block budget: bank block bytes kept under ~4 MB so
+#: block + lane arrays + outputs stay inside VMEM at bench shape.
+REPLAY_BLOCK_BYTES = 4 << 20
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _col(x):
+    """Flatten to the (N, 1) serial-access shape."""
+    return x.reshape(-1, 1)
+
+
+def _u8_to_i32(b4):
+    """(..., 4) int8 bytes -> (..., 1) int32 word — the faststep byte
+    codec (same-width bitcasts for the sign reinterpretations)."""
+    u = jax.lax.bitcast_convert_type(b4, jnp.uint8).astype(jnp.uint32)
+    w = (u[..., 0:1] | (u[..., 1:2] << 8) | (u[..., 2:3] << 16)
+         | (u[..., 3:4] << 24))
+    return jax.lax.bitcast_convert_type(w, jnp.int32)
+
+
+def _i32_to_u8(w1):
+    """(..., 1) int32 word -> (..., 4) int8 bytes (codec inverse)."""
+    u = jax.lax.bitcast_convert_type(w1, jnp.uint32)
+    b = jnp.concatenate(
+        [((u >> (8 * k)) & 0xFF).astype(jnp.uint8) for k in range(4)],
+        axis=-1)
+    return jax.lax.bitcast_convert_type(b, jnp.int8)
+
+
+# --------------------------------------------------------------------------
+# kernel 1: route — the fused sort's permutation scatter, serialized
+# --------------------------------------------------------------------------
+
+
+def _route_kernel(L: int, C: int):
+    def kern(si_ref, word_ref, srank_ref, lw_ref, sl_ref):
+        # full-block zero fill first: unwritten slots must read 0 exactly
+        # like the max-on-zeros scatter this replaces (si is a permutation
+        # so every lane IS written; the zero fill also proves init)
+        lw_ref[:] = jnp.zeros_like(lw_ref)
+        sl_ref[:] = jnp.zeros_like(sl_ref)
+
+        def body(p, c):
+            lane_1 = si_ref[pl.ds(p, 1), 0]  # (1,) sorted lane id
+            lane = jnp.clip(lane_1[0], 0, L - 1)
+            lw_ref[pl.ds(lane, 1), 0] = word_ref[pl.ds(p, 1), 0]
+            s = srank_ref[pl.ds(p, 1), 0][0]
+            sc = jnp.clip(s, 0, C - 1)
+
+            @pl.when((s >= 0) & (s < C))
+            def _():
+                sl_ref[pl.ds(sc, 1), 0] = jnp.clip(lane_1, 0, L - 1)
+
+            return c
+
+        jax.lax.fori_loop(0, L, body, 0)
+
+    return kern
+
+
+def mega_route(cfg, si, word, srank):
+    """Per-lane verdict route-back + slot ownership (the fused path's ONE
+    permutation scatter, faststep._coordinate round-6): returns
+    ``(lane_word (R, L), slot_lane (R, C))`` — the exact arrays
+    ``flat[:, :L]`` / ``flat[:, L:]`` of the scatter formulation (targets
+    are unique: si is a permutation, srank a bijection, so serial set ==
+    max-on-zeros)."""
+    # leading axis from the data, not cfg.n_replicas: per-chip arrays
+    # under shard_map carry R_local = 1
+    R, L = si.shape
+    C = cfg.lane_budget
+    blk = lambda n: pl.BlockSpec((n, 1), lambda r: (r, 0))
+    with layouts.audited("mega-route-unique-targets"):
+        lw, sl = pl.pallas_call(
+            _route_kernel(L, C),
+            grid=(R,),
+            in_specs=[blk(L)] * 3,
+            out_specs=[blk(L), blk(C)],
+            out_shape=[_sds((R * L, 1), jnp.int32),
+                       _sds((R * C, 1), jnp.int32)],
+            interpret=_interpret(),
+        )(_col(si), _col(word), _col(srank))
+    return lw.reshape(R, L), sl.reshape(R, C)
+
+
+# --------------------------------------------------------------------------
+# kernel 2: apply — scatter-max into VMEM-resident vpts + verdict read-back
+# --------------------------------------------------------------------------
+
+
+def _apply_kernel(K: int, N: int):
+    def kern(vin_ref, key_ref, pts_ref, mask_ref, vout_ref, post_ref):
+        # vout aliases the vpts input (input_output_aliases) — the probe's
+        # serial-candidate pattern; vin is the dead pre-alias view
+        del vin_ref
+        phase = pl.program_id(0)
+
+        @pl.when(phase == 0)
+        def _max_pass():
+            # scatter-MAX twin: masked rows land max(old, pts); a wire key
+            # outside the table DROPS (mode="drop" semantics), hence the
+            # in-bounds guard alongside the mask
+            def body(m, c):
+                k_raw = key_ref[pl.ds(m, 1), 0][0]
+                k = jnp.clip(k_raw, 0, K - 1)
+                ok = ((mask_ref[pl.ds(m, 1), 0][0] != 0)
+                      & (k_raw >= 0) & (k_raw < K))
+
+                @pl.when(ok)
+                def _():
+                    vout_ref[pl.ds(k, 1), 0] = jnp.maximum(
+                        vout_ref[pl.ds(k, 1), 0], pts_ref[pl.ds(m, 1), 0])
+
+                return c
+
+            jax.lax.fori_loop(0, N, body, 0)
+
+        @pl.when(phase == 1)
+        def _post_pass():
+            # settled verdict read-back for EVERY row (the post/joint
+            # gather twin): clamped like the promised-in-bounds gather's
+            # explicit min — a bogus wire key yields a garbage-but-defined
+            # verdict its validity mask already ignores
+            def body(m, c):
+                k = jnp.clip(key_ref[pl.ds(m, 1), 0][0], 0, K - 1)
+                post_ref[pl.ds(m, 1), 0] = vout_ref[pl.ds(k, 1), 0]
+                return c
+
+            jax.lax.fori_loop(0, N, body, 0)
+
+    return kern
+
+
+def mega_apply(cfg, vpts, keys, pts, mask):
+    """The arbiter core in ONE launch: phase 0 scatter-MAXes every masked
+    (key, pts) row into the VMEM-resident vpts column; phase 1 reads the
+    settled ``vpts[key]`` verdict for every row.  ``keys``/``pts``/``mask``
+    are flat (N,) row vectors (batched: R*L lanes; sharded: Rsrc*C wire
+    slots + R*RS replay keys).  Returns ``(vpts', post (N,))``."""
+    K = int(vpts.shape[0])
+    N = int(keys.size)
+    full = lambda n: pl.BlockSpec((n, 1), lambda i: (0, 0))
+    with layouts.audited("mega-apply-two-phase-revisit"):
+        vout, post = pl.pallas_call(
+            _apply_kernel(K, N),
+            grid=(2,),
+            in_specs=[full(K), full(N), full(N), full(N)],
+            out_specs=[full(K), full(N)],
+            out_shape=[_sds((K, 1), jnp.int32), _sds((N, 1), jnp.int32)],
+            input_output_aliases={0: 0},
+            interpret=_interpret(),
+        )(_col(vpts), _col(keys.reshape(-1)), _col(pts.reshape(-1)),
+          _col(mask.reshape(-1).astype(jnp.int32)))
+    return vout.reshape(K), post.reshape(-1)
+
+
+# --------------------------------------------------------------------------
+# kernel 3: replay — the cond-gated stuck-key scan, block-gridded
+# --------------------------------------------------------------------------
+
+
+def _replay_kernel(cfg, rows: int, Bk: int, W: int, R: int, RS: int):
+    K = cfg.n_keys
+    age_thresh = cfg.replay_age
+    sst_lo, sst_hi = 4 * _BANK_SST, 4 * _BANK_SST + 4
+    val_lo = 4 * _BANK_VAL
+
+    def kern(step_ref, act_ref, frozen_ref, bank_in, vpts_ref,
+             key_in, pts_in, acks_in, val_in,
+             bank_ref, nact_ref, nkey_ref, npts_ref, nacks_ref, nval_ref,
+             cursor):
+        # bank_ref aliases bank_in (input_output_aliases); marks are the
+        # only writes, so untouched rows keep their bytes
+        del bank_in
+        blk = pl.program_id(0)
+        step = step_ref[0, 0]
+
+        @pl.when(blk == 0)
+        def _init():
+            # replay outputs start as copies (slots not taken this scan
+            # keep their rows); cursor = [n_cand, next-free-slot ptr x R]
+            nact_ref[:] = act_ref[:]
+            nkey_ref[:] = key_in[:]
+            npts_ref[:] = pts_in[:]
+            nacks_ref[:] = acks_in[:]
+            nval_ref[:] = val_in[:]
+            # one FULL-block store: element-wise zeroing would leave the
+            # init state at 'maybe' for the RefHazard pass (partial
+            # stores cannot prove a block fully initialized)
+            cursor[:] = jnp.zeros_like(cursor)
+
+        # dense per-block stuck detection off the PRE-mark block bytes
+        # (exactly the do_scan mask: replayable state older than the age
+        # threshold; ragged tail rows masked out)
+        sst = _u8_to_i32(bank_ref[:, sst_lo:sst_hi])  # (Bk, 1)
+        state = sst & 7
+        age = step - (sst >> layouts.SST.field("step").shift)
+        row0 = blk * Bk
+        gidx = row0 + jax.lax.broadcasted_iota(jnp.int32, (Bk, 1), 0)
+        stuck0 = (((state == t.INVALID) | (state == t.TRANS)
+                   | (state == t.REPLAY))
+                  & (age > age_thresh) & (gidx < rows)).astype(jnp.int32)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Bk, 1), 0)
+        iota_rs = jax.lax.broadcasted_iota(jnp.int32, (RS, 1), 0)
+
+        def cand_body(ci, stuck):
+            # next candidate = first remaining stuck row of this block,
+            # taken only while the global candidate budget (RS) lasts —
+            # the streaming twin of top_k(-kiota)'s ascending-row order
+            idx_f = jnp.min(jnp.where(stuck != 0, iota_b, Bk))
+            found = (idx_f < Bk) & (cursor[0] < RS)
+            idx = jnp.clip(idx_f, 0, Bk - 1)
+
+            @pl.when(found)
+            def _take():
+                row8 = bank_ref[pl.ds(idx, 1), :]  # (1, 4W) snapshot bytes
+                ckey = jnp.remainder(row0 + idx, K)
+                cpts = vpts_ref[pl.ds(idx, 1), 0]  # (1,)
+                any_take = False
+                for r in range(R):
+                    # r's next free slot consumes this candidate (the
+                    # free-rank mapping) whether or not r takes it
+                    ptr = cursor[1 + r]
+                    arow = act_ref[pl.ds(r * RS, RS), :]  # (RS, 1)
+                    s = jnp.min(jnp.where((iota_rs >= ptr) & (arow == 0),
+                                          iota_rs, RS))
+                    cursor[1 + r] = jnp.minimum(s + 1, RS)
+                    sc = jnp.clip(s, 0, RS - 1)
+                    take = (s < RS) & (frozen_ref[r, 0] == 0)
+                    any_take = take if r == 0 else (any_take | take)
+
+                    @pl.when(take)
+                    def _slot(r=r, s=s, sc=sc):
+                        slot = r * RS + sc
+                        nact_ref[pl.ds(slot, 1), 0] = jnp.ones(
+                            (1,), jnp.int32)
+                        nkey_ref[pl.ds(slot, 1), 0] = jnp.full(
+                            (1,), ckey, jnp.int32)
+                        npts_ref[pl.ds(slot, 1), 0] = cpts
+                        nacks_ref[pl.ds(slot, 1), 0] = jnp.zeros(
+                            (1,), jnp.int32)
+                        nval_ref[pl.ds(slot, 1), :] = row8[:, val_lo:]
+
+                cursor[0] = cursor[0] + 1
+
+                @pl.when(any_take)
+                def _mark():
+                    # REPLAY mark: same bytes, sst word re-stamped — all
+                    # taking replicas write the identical row (the
+                    # replay-mark audit of the scatter it replaces)
+                    mark_sst = _i32_to_u8(
+                        ((step << layouts.SST.field("step").shift)
+                         | t.REPLAY).reshape(1, 1))
+                    bank_ref[pl.ds(idx, 1), :] = jnp.concatenate(
+                        [row8[:, :sst_lo], mark_sst, row8[:, sst_hi:]],
+                        axis=1)
+
+            return jnp.where((iota_b == idx) & found, 0, stuck)
+
+        @pl.when((jnp.sum(stuck0) > 0) & (cursor[0] < RS))
+        def _scan_block():
+            jax.lax.fori_loop(0, RS, cand_body, stuck0)
+
+    return kern
+
+
+def mega_replay(cfg, step, frozen, table_vpts, table_bank, replay,
+                block_bytes: int = None):
+    """The replay scan's sparse interior as one block-gridded kernel
+    (runs under faststep's existing ``replay_scan_every`` cond): returns
+    ``(new_bank, new_replay_fields)`` bit-identical to do_scan's top_k +
+    gather/scatter formulation.  ``replay`` is the FastReplay tuple;
+    fields come back as ``(active, key, pts, acks, val)`` arrays.
+    ``block_bytes`` overrides the table-block budget (the kernel matrix
+    forces the multi-block grid at toy shapes with it)."""
+    rows = int(table_vpts.shape[0])
+    W4 = int(table_bank.shape[1])
+    # leading axis from the data (per-chip replay under shard_map is
+    # (1, RS)); the key-id modulus stays cfg.n_keys — the per-shard
+    # table holds exactly K rows in both engines
+    R, RS = replay.active.shape
+    V4 = 4 * cfg.value_words
+    if block_bytes is None:
+        block_bytes = REPLAY_BLOCK_BYTES
+    nblk = max(1, -(-(rows * W4) // block_bytes))
+    Bk = -(-rows // nblk)
+    nblk = -(-rows // Bk)
+
+    bankb = pl.BlockSpec((Bk, W4), lambda b: (b, 0))
+    vptsb = pl.BlockSpec((Bk, 1), lambda b: (b, 0))
+    fullc = lambda n, w=1: pl.BlockSpec((n, w), lambda b: (0, 0))
+    smem = lambda sh: pl.BlockSpec(sh, lambda b: (0, 0),
+                                   memory_space=pltpu.SMEM)
+
+    act = _col(replay.active.astype(jnp.int32))
+    with layouts.audited("mega-replay-stream-accumulate"):
+        outs = pl.pallas_call(
+            _replay_kernel(cfg, rows, Bk, W4, R, RS),
+            grid=(nblk,),
+            in_specs=[
+                smem((1, 1)),
+                fullc(R * RS), smem((R, 1)),
+                bankb, vptsb,
+                fullc(R * RS), fullc(R * RS), fullc(R * RS),
+                fullc(R * RS, V4),
+            ],
+            out_specs=[bankb, fullc(R * RS), fullc(R * RS), fullc(R * RS),
+                       fullc(R * RS), fullc(R * RS, V4)],
+            out_shape=[
+                _sds((rows, W4), jnp.int8),
+                _sds((R * RS, 1), jnp.int32), _sds((R * RS, 1), jnp.int32),
+                _sds((R * RS, 1), jnp.int32), _sds((R * RS, 1), jnp.int32),
+                _sds((R * RS, V4), jnp.int8),
+            ],
+            input_output_aliases={3: 0},
+            scratch_shapes=[pltpu.SMEM((1 + R,), jnp.int32)],
+            interpret=_interpret(),
+        )(jnp.asarray(step, jnp.int32).reshape(1, 1), act,
+          frozen.astype(jnp.int32).reshape(R, 1), table_bank,
+          _col(table_vpts), _col(replay.key), _col(replay.pts),
+          _col(replay.acks), replay.val.reshape(R * RS, V4))
+    bank, nact, nkey, npts, nacks, nval = outs
+    shp = (R, RS)
+    return bank, (nact.reshape(shp) != 0, nkey.reshape(shp),
+                  npts.reshape(shp), nacks.reshape(shp),
+                  nval.reshape(R, RS, V4))
+
+
+# --------------------------------------------------------------------------
+# resolution: the build-time half of use_mega_round
+# --------------------------------------------------------------------------
+
+
+def _toy_cfg():
+    from hermes_tpu.config import HermesConfig
+
+    return HermesConfig(n_replicas=2, n_keys=16, n_sessions=4,
+                        replay_slots=2, ops_per_session=4,
+                        arb_mode="sort", mega_round=True)
+
+
+@functools.lru_cache(maxsize=1)
+def _self_test() -> tuple:
+    """(ok, reason): run every mega kernel CONCRETELY at a toy shape on
+    this backend.  Catches a backend whose Pallas cannot lower the
+    kernels — the 'platform lacks Pallas' refusal.  On non-TPU backends
+    the kernels run interpret-mode (pure jax emulation, no Mosaic), so
+    there is nothing platform-specific to probe and the compile probe is
+    skipped — the analyzer half of resolve() still runs everywhere."""
+    if _interpret():
+        return (True, "interpret")
+    # The first resolve may happen while an outer round is being traced
+    # (profile/census paths jit the round directly).  JAX's trace state
+    # is thread-local, so a fresh thread gives the concrete probe a
+    # clean trace context regardless of the caller's.
+    import threading
+
+    box: dict = {}
+
+    def probe():
+        try:
+            import numpy as np
+
+            from hermes_tpu.core import faststep as fst
+
+            cfg = _toy_cfg()
+            L, R, RS = cfg.n_lanes, cfg.n_replicas, cfg.replay_slots
+            si = jnp.tile(jnp.arange(L, dtype=jnp.int32)[None], (R, 1))
+            lw, _sl = mega_route(cfg, si, si + 1, si)
+            _vpts, post = mega_apply(
+                cfg, jnp.zeros((cfg.n_keys,), jnp.int32),
+                jnp.arange(R * L, dtype=jnp.int32) % cfg.n_keys,
+                jnp.arange(R * L, dtype=jnp.int32),
+                jnp.ones((R * L,), jnp.int32))
+            # the replay kernel is the structurally riskiest of the
+            # three (cross-grid SMEM cursor, aliased int8 block grid):
+            # it MUST be part of the platform probe or a toolchain that
+            # rejects only it would crash at round compile time instead
+            # of falling back loudly here
+            state = fst.init_fast_state(cfg)
+            bank, (nact, *_rest) = mega_replay(
+                cfg, jnp.int32(99), jnp.zeros((R,), jnp.bool_),
+                state.table.vpts, state.table.bank, state.replay,
+                block_bytes=8 * 4 * (2 + cfg.value_words))
+            np.asarray(jax.block_until_ready(post))
+            np.asarray(jax.block_until_ready(lw))
+            np.asarray(jax.block_until_ready(nact))
+            np.asarray(jax.block_until_ready(bank))
+            box["v"] = (True, "ok")
+        except Exception as e:  # pragma: no cover - backend-specific
+            box["v"] = (False, f"kernel self-test failed: {e!r:.200}")
+
+    th = threading.Thread(target=probe, name="mega-self-test")
+    th.start()
+    th.join()
+    return box.get("v", (False, "kernel self-test thread died"))
+
+
+@functools.lru_cache(maxsize=1)
+def _kernels_clean() -> tuple:
+    """(ok, reason): the PR-8 invariant analyzer's verdict on the mega
+    kernel bodies (shape-independent rules at the toy shape).  A flagged
+    kernel must not serve traffic — the 'analysis refuses' refusal."""
+    try:
+        from hermes_tpu.analysis import diffcheck
+
+        # one representative cell per kernel family: the resolve-time
+        # check is a tripwire, not the matrix — scripts/check_analysis.py
+        # runs EVERY registered cell (incl. the multi-block replay shape)
+        # plus the differential sanitizer
+        rep_cells = {"mega_route/r2l6", "mega_apply/k16n16",
+                     "mega_replay/k16b1"}
+        bad = []
+        for cell in diffcheck.kernel_cells():
+            if cell.name not in rep_cells:
+                continue
+            rep = diffcheck.analyze_kernel(cell)
+            gating = [f for f in rep["findings"]
+                      if f.severity in ("error", "warn")]
+            if gating:
+                bad.append(f"{cell.name}: "
+                           + "; ".join(f"{f.code}@{f.site}" for f in gating))
+        if bad:
+            return (False, "analyzer flagged mega kernels: "
+                    + " | ".join(bad))
+        return (True, "ok")
+    except Exception as e:  # pragma: no cover
+        return (False, f"kernel analysis crashed: {e!r:.200}")
+
+
+_WARNED = set()
+
+
+def resolve(cfg) -> bool:
+    """The resolved mega switch the round builders consult at trace time:
+    config half (``cfg.use_mega_round``) AND the cached build-time half
+    (kernel self-test + analyzer verdict).  Refusals warn loudly ONCE per
+    reason and fall back to the fused-sort program."""
+    if not cfg.use_mega_round:
+        return False
+    for ok, reason in (_self_test(), _kernels_clean()):
+        if not ok:
+            if reason not in _WARNED:
+                _WARNED.add(reason)
+                warnings.warn(
+                    f"mega_round requested but refused ({reason}); "
+                    f"falling back to the fused-sort program",
+                    RuntimeWarning, stacklevel=2)
+            return False
+    return True
+
+
+def reset_resolution_cache() -> None:
+    """Test hook: clear the cached self-test/analysis verdicts (e.g.
+    after monkeypatching a kernel or an analyzer rule)."""
+    _self_test.cache_clear()
+    _kernels_clean.cache_clear()
+    _WARNED.clear()

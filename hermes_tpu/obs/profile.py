@@ -58,6 +58,12 @@ COLLECTIVE = ("stablehlo.all_gather", "stablehlo.all_to_all",
 # projection and the ledger's modeled pricing both import from here.
 COST_LO, COST_MID, COST_HI = 1.3, 1.8, 2.4
 
+# Round-15 serial-interior pricing (PALLAS_PROBE.json: the serial
+# VMEM-resident Pallas loop runs ~6 ns/iteration on the current Mosaic
+# toolchain; bracketed for scalarization overhead) — what the Pallas
+# ledger prices a kernel's serial iteration bound at, in ns/iteration.
+SERIAL_NS_LO, SERIAL_NS_MID, SERIAL_NS_HI = 2.0, 6.0, 12.0
+
 
 def census_text(txt: str) -> dict:
     """Count the cost-model ops in StableHLO text (one lowered program)."""
@@ -81,6 +87,143 @@ def census_text(txt: str) -> dict:
     out["sparse_total"] = sum(counts.get(k, 0) for k in SPARSE)
     out["collective_total"] = sum(counts.get(k, 0) for k in COLLECTIVE)
     return out
+
+
+# --------------------------------------------------------------------------
+# Pallas-aware ledger (round-15): police kernel INTERIORS, not just the
+# XLA op list.  The StableHLO census above prices the launch-taxed sparse
+# chain; a Pallas mega-round kernel is ONE launch there — without this
+# section the census would count it as one op and silently stop policing
+# whatever the kernel does inside (a hidden interior gather, or an
+# unbounded serial loop, would be invisible to CI).  This walks the
+# ROUND JAXPR instead: every pallas_call's body is censused for
+# cost-model primitives (must stay 0 — a kernel-interior gather/scatter
+# would pay the same vector-unit cost without even XLA's fusion) and for
+# its SERIAL ITERATION BOUND (grid size x nested scan trip counts — the
+# real interior cost, priced at the probe-measured ~6 ns/iteration).
+# --------------------------------------------------------------------------
+
+_PALLAS_SPARSE_PRIMS = ("gather", "scatter", "scatter-max", "scatter-min",
+                        "scatter-add", "sort", "dynamic_gather")
+_REF_PRIMS = ("get", "swap", "addupdate")
+
+
+def _sub_jaxprs(eqn):
+    """(jaxpr, trip_multiplier) pairs nested under one equation."""
+    from jax.extend.core import ClosedJaxpr
+
+    name = eqn.primitive.name
+    out = []
+    if name == "scan":
+        out.append((eqn.params["jaxpr"], int(eqn.params.get("length") or 1)))
+    elif name == "while":
+        # trip count unknowable statically: count the body once and let
+        # the caller see a while flag (none of the in-tree kernels use
+        # unbounded loops)
+        out.append((eqn.params["body_jaxpr"], 1))
+    elif name == "cond":
+        for br in eqn.params["branches"]:
+            out.append((br, 1))
+    else:
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            inner = eqn.params.get(key)
+            if inner is not None and name != "pallas_call":
+                out.append((inner, 1))
+    res = []
+    for j, m in out:
+        res.append((j.jaxpr if isinstance(j, ClosedJaxpr) else j, m))
+    return res
+
+
+def _kernel_interior(jaxpr) -> dict:
+    """Recursive census of ONE kernel body: cost-model primitives,
+    ref-access sites, and the serial iteration bound (scan trips,
+    cond branches counted at their max)."""
+    sparse = 0
+    refs = 0
+    iters = 0
+    whiles = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _PALLAS_SPARSE_PRIMS:
+            sparse += 1
+        if name in _REF_PRIMS:
+            refs += 1
+        if name == "while":
+            # a while's trip count is statically unknowable, so the
+            # serial bound counts its body ONCE and the loop itself is
+            # surfaced as a budgetable count (OP_BUDGET.json pins
+            # pallas_while_loops at 0 — an unbounded in-kernel loop must
+            # be a conscious budget change, never a silent pass)
+            whiles += 1
+        if name == "cond":
+            best = None
+            for sub, _m in _sub_jaxprs(eqn):
+                r = _kernel_interior(sub)
+                sparse += r["interior_sparse"]
+                refs += r["ref_sites"]
+                whiles += r["while_loops"]
+                best = r["serial_iters"] if best is None else max(
+                    best, r["serial_iters"])
+            iters += best or 0
+            continue
+        for sub, mult in _sub_jaxprs(eqn):
+            r = _kernel_interior(sub)
+            sparse += r["interior_sparse"]
+            refs += r["ref_sites"]
+            whiles += r["while_loops"]
+            iters += mult * max(1, r["serial_iters"]) if name == "scan" \
+                else r["serial_iters"]
+    return dict(interior_sparse=sparse, ref_sites=refs, serial_iters=iters,
+                while_loops=whiles)
+
+
+def pallas_ledger_of_jaxpr(jaxpr) -> dict:
+    """Walk a round jaxpr; census every ``pallas_call``'s interior.
+    Returns the census-extension dict (all keys budgetable via
+    OP_BUDGET.json): ``pallas_calls``, ``pallas_interior_sparse``,
+    ``pallas_serial_iter_bound`` (sum over calls of grid-size x in-kernel
+    serial trips) and the modeled serial cost."""
+    calls = []
+
+    def walk(j):
+        for eqn in j.eqns:
+            if eqn.primitive.name == "pallas_call":
+                gm = eqn.params["grid_mapping"]
+                grid = 1
+                for g in getattr(gm, "grid", ()) or ():
+                    try:
+                        grid *= int(g)
+                    except Exception:
+                        pass
+                kj = eqn.params["jaxpr"]
+                r = _kernel_interior(kj)
+                calls.append(dict(grid=grid, **r))
+            for sub, _m in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    bound = sum(c["grid"] * c["serial_iters"] for c in calls)
+    return {
+        "pallas_calls": len(calls),
+        "pallas_interior_sparse": sum(c["interior_sparse"] for c in calls),
+        "pallas_ref_sites": sum(c["ref_sites"] for c in calls),
+        "pallas_while_loops": sum(c["while_loops"] for c in calls),
+        "pallas_serial_iter_bound": bound,
+        "pallas_serial_modeled_ms": [
+            round(bound * SERIAL_NS_LO / 1e6, 2),
+            round(bound * SERIAL_NS_HI / 1e6, 2)],
+    }
+
+
+def pallas_ledger(cfg, backend: str = "batched", mesh=None) -> dict:
+    """The Pallas interior ledger of ONE protocol round at cfg's shape
+    (abstract tracing, backend-independent — the jaxpr is the same
+    whether the kernels later compile via Mosaic or interpret).  A thin
+    filter over ``op_census`` (the one build-trace-ledger path), so the
+    standalone entry point cannot drift from what the gate measures."""
+    return {k: v for k, v in op_census(cfg, backend, mesh).items()
+            if k.startswith("pallas_")}
 
 
 def _abstract_round_args(cfg, n_local=None):
@@ -125,7 +268,15 @@ def op_census(cfg, backend: str = "batched", mesh=None) -> dict:
     else:
         raise ValueError(f"unknown backend {backend!r}")
     fs, stream, ctl = _abstract_round_args(cfg, n_local)
-    return census_text(fn.lower(fs, stream, ctl).as_text())
+    # ONE trace serves both halves: the StableHLO text census (launch-
+    # taxed XLA ops) and the round-15 Pallas interior ledger (kernel
+    # interiors the text census cannot see — OP_BUDGET.json budgets the
+    # interior-sparse count, while-loop count, and serial iteration
+    # bound alongside the XLA op counts)
+    traced = fn.trace(fs, stream, ctl)
+    cen = census_text(traced.lower().as_text())
+    cen.update(pallas_ledger_of_jaxpr(traced.jaxpr.jaxpr))
+    return cen
 
 
 # --------------------------------------------------------------------------
@@ -146,7 +297,8 @@ def _stage_fns(cfg):
     def apply_inv(ctl, fs, stream):
         fs2, lanes, slot_lane, taken_lane, *_ = fst._coordinate(
             cfg, ctl, fs, stream)
-        return fst._apply_inv_lanes(cfg, ctl, fs2, lanes, taken_lane)
+        fs3, _post = fst._apply_inv_lanes(cfg, ctl, fs2, lanes, taken_lane)
+        return fs3
 
     def full(ctl, fs, stream):
         nxt, _ = fst.fast_round_batched(cfg, ctl, fs, stream)

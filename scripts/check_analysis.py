@@ -45,6 +45,8 @@ def gate_configs() -> dict:
     """The analyzed matrix: named configs -> HermesConfig.  Default (race
     arbiter) + the bench operating shape (sort+chain+fused — the split
     program is added automatically as the A/B variant)."""
+    import dataclasses
+
     from hermes_tpu.config import HermesConfig
 
     import bench
@@ -53,6 +55,10 @@ def gate_configs() -> dict:
         "default": HermesConfig(),
         "bench": bench._cfg("a"),
         "bench-rmw": bench._cfg("rmw"),
+        # round-15: the mega path's kernels analyzed INSIDE the round
+        # programs (the split A/B variant is added automatically)
+        "bench-mega": dataclasses.replace(bench._cfg("a"),
+                                          mega_round=True),
     }
 
 

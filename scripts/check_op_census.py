@@ -54,16 +54,24 @@ def main() -> int:
                     "of the census artifact instead of failing on drift")
     args = ap.parse_args()
 
+    import dataclasses
+
     import bench
 
     cfg = bench._cfg("a")
+    mega = dataclasses.replace(cfg, mega_round=True)
     mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
     print(f"censusing bench shape (S={cfg.n_sessions}, C={cfg.lane_budget}, "
-          f"K={cfg.n_keys}, fused_sort={cfg.use_fused_sort})...",
+          f"K={cfg.n_keys}, fused_sort={cfg.use_fused_sort}) + mega path...",
           file=sys.stderr)
     measured = {
         "batched": prof.op_census(cfg, "batched"),
         "sharded": prof.op_census(cfg, "sharded", mesh),
+        # round-15: the mega path is budgeted separately (batched must
+        # hold the 4-sparse-op floor; the pallas_* ceilings police the
+        # kernel interiors the plain census cannot see)
+        "batched_mega": prof.op_census(mega, "batched"),
+        "sharded_mega": prof.op_census(mega, "sharded", mesh),
     }
 
     with open(args.budget) as f:
@@ -89,12 +97,14 @@ def main() -> int:
                              f"code lowers to {v}")
 
     if drift and args.update and artifact is not None:
-        from sharded_census import projection
+        from sharded_census import mega_projection, projection
 
         artifact["census"] = measured
         artifact["bench_shape"] = prof.census_shape(cfg)
         artifact["v5e8_projection"] = projection(measured["batched"],
                                                  measured["sharded"])
+        artifact["mega_projection"] = mega_projection(
+            measured["batched"], measured["batched_mega"])
         with open(args.census, "w") as f:
             json.dump(artifact, f, indent=1)
         print(f"updated {args.census} census section", file=sys.stderr)
@@ -108,6 +118,12 @@ def main() -> int:
                           sparse_sharded=measured["sharded"]["sparse_total"],
                           collectives_sharded=measured["sharded"][
                               "collective_total"],
+                          sparse_batched_mega=measured["batched_mega"][
+                              "sparse_total"],
+                          sparse_sharded_mega=measured["sharded_mega"][
+                              "sparse_total"],
+                          mega_serial_iter_bound=measured["batched_mega"][
+                              "pallas_serial_iter_bound"],
                           budget_failures=failures, census_drift=drift)))
     return 0 if out["ok"] else 1
 

@@ -1,0 +1,73 @@
+"""Mega-round vs fused-sort A/B at the exact bench shape (round-15
+tentpole evidence): one process, one chip claim, every cell through
+bench.run_mix's measurement protocol — the scripts/fused_compare.py
+pattern, with ``over=dict(mega_round=...)`` as the toggle.
+
+The modeled projection (SHARDED_CENSUS.json ``mega_projection``) brackets
+the mega path between ~0.54x and ~2.1x of the 13.7M w/s plateau because
+the serial kernel-interior cost (~2-12 ns/iteration over ~1.6M
+iterations/round) is the decisive unknown the CPU host cannot measure —
+THIS script is the required evidence.  Cells: the primary YCSB-A mix and
+the contended zipfian mix, mega on/off; the off cells ARE the bench
+operating point, so the pair is directly comparable to BENCH_r05.json.
+
+Writes MEGA_COMPARE.json and prints one JSON line per cell to stderr,
+plus a summary line to stdout.  Run on the real chip (default env, no
+other TPU process, no timeout-kill).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import bench
+
+CELLS = [
+    ("a", {"mega_round": True}),
+    ("a", {"mega_round": False}),
+    ("zipfian", {"mega_round": True}),
+    ("zipfian", {"mega_round": False}),
+]
+
+
+def main() -> None:
+    ok, info = bench.probe_backend(
+        float(os.environ.get("HERMES_BENCH_PROBE_TIMEOUT", "180")))
+    if not ok:
+        print(json.dumps({"error": info}))
+        sys.exit(1)
+
+    results = []
+    for mix, over in CELLS:
+        t0 = time.perf_counter()
+        r = bench.run_mix(mix, over=over)
+        r["mega_round"] = over["mega_round"]
+        r["cell_wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(r)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+        # rewrite after every cell: a mid-matrix chip failure must not
+        # discard the completed cells' artifact
+        with open("MEGA_COMPARE.json", "w") as f:
+            json.dump(results, f, indent=1)
+
+    summary = {}
+    for r in results:
+        summary.setdefault(r["mix"], {})[
+            "mega" if r["mega_round"] else "fused"] = dict(
+                writes_per_sec=r["writes_per_sec"], round_us=r["round_us"])
+    for mix, cells in summary.items():
+        if "mega" in cells and "fused" in cells:
+            cells["round_ms_saved"] = round(
+                (cells["fused"]["round_us"] - cells["mega"]["round_us"])
+                / 1e3, 2)
+            cells["speedup_x"] = round(
+                cells["fused"]["round_us"]
+                / max(1e-9, cells["mega"]["round_us"]), 3)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -183,9 +183,14 @@ def candidate_step(cand, K, M, interpret=True):
                       == jnp.arange(K, dtype=jnp.int32)[None, :])
             # int8 planes keep the scatter exact through the MXU (bf16
             # would round) for the 0/1 onehot plane; rows mixes in the
-            # carry so the loop body is not hoistable.  (The analyzer
-            # truthfully flags the rows int8 wrap.)
-            rows = rows + acc[:1, :]
+            # carry so the loop body is not hoistable.  The payload is
+            # masked to the low 7 bits BEFORE the int8 narrow so the
+            # convert is value-preserving (round-15: was a bare astype —
+            # a silent two's-complement wrap the analyzer truthfully
+            # flagged as dtype/implicit-wrap-convert; the mask is one
+            # fused elementwise AND, timing-neutral for a cell whose
+            # cost is the O(K x M) MXU work).
+            rows = (rows + acc[:1, :]) & 0x7F
             return jax.lax.dot_general(
                 onehot.astype(jnp.int8), rows.astype(jnp.int8),
                 (((0,), (0,)), ((), ())),

@@ -89,7 +89,8 @@ run("no commit row-scatter", lambda: setattr(
     lambda cfg, ctl, fs, lanes, win_lane, commit_lane: fs))
 
 run("no vpts scatter-max", lambda: setattr(
-    fst, "_apply_inv_lanes", lambda cfg, ctl, fs, lanes, taken_lane: fs))
+    fst, "_apply_inv_lanes",
+    lambda cfg, ctl, fs, lanes, taken_lane: (fs, None)))
 
 
 def _no_stats():

@@ -108,6 +108,18 @@ SLOW_TESTS = {
     "test_fleet_sharded_groups_on_submeshes",
     "test_fleet_migration_smoke",
     "test_membership_and_healthy_set_group_scoped",
+    # round-15 mega-round: the quick tier keeps a single-compile
+    # checker-gated mega drive (test_mega_quick_drain_check_with_replay),
+    # the census floor, the kernel-cell registration + sanitizer draw,
+    # and both analyzer red tests (which also cover the refusal->
+    # fallback warning path); the two-program bit-identity matrix is
+    # slow-tier (it compiles both programs — and every serial gate run
+    # exercises the identity machinery anyway)
+    "test_mega_matches_fused_batched_through_freeze_thaw",
+    "test_mega_matches_fused_sharded",
+    "test_mega_replay_multiblock_ragged_identity",
+    "test_mega_pipeline_depth2_chaos_schedule_identity",
+    "test_mega_resolution_refusal_falls_back_loudly",
 }
 
 

@@ -7,7 +7,40 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 import bench
+
+
+def test_commit_latency_fields_are_honest_bounds():
+    """Round-15 satellite: BENCH_r05 reported p50/p99_commit_rounds = 0
+    (legitimate: commits land in-round) but derived 'p50_commit_us_est'
+    fields that just echoed the amortized dispatch time as if it were a
+    measured percentile.  The fields are now explicit upper bounds: the
+    *_us_ub value is (rounds+1) * round_us (1-round histogram
+    resolution), the note names the bound semantics, and the old _est
+    keys are gone."""
+    # degenerate-at-zero histogram: every commit in its issue round
+    hist = np.zeros(32, np.int64)
+    hist[0] = 1000
+    f = bench.commit_latency_fields(hist, step_us=28609.0)
+    assert f["p50_commit_rounds"] == 0 and f["p99_commit_rounds"] == 0
+    assert f["p50_commit_us_ub"] == round(1 * 28609.0, 1)
+    assert "UPPER BOUNDS" in f["commit_us_note"]
+    assert not any(k.endswith("_us_est") for k in f)
+
+    # a spread histogram keeps the bound one round above the percentile
+    hist = np.zeros(32, np.int64)
+    hist[0], hist[3], hist[9] = 50, 49, 1
+    f = bench.commit_latency_fields(hist, step_us=100.0)
+    assert f["p50_commit_rounds"] == 0
+    assert f["p99_commit_rounds"] == 3
+    assert f["p99_commit_us_ub"] == round(4 * 100.0, 1)
+
+    # empty histogram (zero commits): bounds are None, never a crash
+    f = bench.commit_latency_fields(np.zeros(32, np.int64), step_us=5.0)
+    assert f["p50_commit_rounds"] is None
+    assert f["p50_commit_us_ub"] is None and f["p99_commit_us_ub"] is None
 
 
 def test_probe_skips_on_cpu(monkeypatch):

@@ -1,0 +1,345 @@
+"""Round-15 Pallas mega-round (core/megaround.py, ISSUE 11).
+
+The mega path's contract is BIT-IDENTITY: with ``mega_round=True`` the
+round must produce byte-for-byte the same FastState/Meta trees as the
+fused-sort program it fuses — on both engines, through freeze/thaw (the
+replay-scan kernel's take path), through the multi-block ragged table
+grid, at pipeline depth 2 and under a seeded chaos schedule.  Plus the
+resolution contract (loud fallback when analysis refuses), the census
+floor, and the analyzer red tests (a deliberately broken kernel must
+flip the findings red and the resolution must then refuse it).
+"""
+
+import dataclasses
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from hermes_tpu import chaos
+from hermes_tpu.config import (HermesConfig, MEGA_VPTS_VMEM_BYTES,
+                               WorkloadConfig)
+from hermes_tpu.core import megaround
+from hermes_tpu.runtime import FastRuntime
+
+
+def _cfg(**kw):
+    base = dict(
+        n_replicas=3, n_keys=32, n_sessions=8, replay_slots=4,
+        ops_per_session=24, arb_mode="sort", chain_writes=2,
+        replay_scan_every=4, replay_age=4, rebroadcast_every=2,
+        workload=WorkloadConfig(read_frac=0.3, rmw_frac=0.2, seed=7),
+    )
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"state leaf {i} diverged")
+
+
+def _drive_freeze_thaw(cfg, backend="batched", mesh=None):
+    rt = FastRuntime(cfg, backend=backend, mesh=mesh, record=True)
+    for i in range(100):
+        if i == 10:
+            rt.freeze(1)
+        if i == 40:
+            rt.thaw(1)
+        if i == 60:
+            rt.freeze(0)
+        if i == 80:
+            rt.thaw(0)
+        rt.step_once()
+    rt.drain(3000)
+    return rt
+
+
+def test_mega_quick_drain_check_with_replay():
+    """Quick-tier sibling (single compile — the two-program bit-identity
+    runs live in the slow tier and every gate run): one mega round
+    program through a freeze window at a tiny shape must exercise route
+    + apply + the replay-scan kernel (replay_age=4, scan every 4), drain
+    every op, conserve totals, and pass the linearizability checker."""
+    cfg = _cfg(n_keys=16, n_sessions=4, ops_per_session=8,
+               mega_round=True)
+    rt = FastRuntime(cfg, record=True)
+    for i in range(30):
+        if i == 5:
+            rt.freeze(1)
+        if i == 18:
+            rt.thaw(1)
+        rt.step_once()
+    assert rt.drain(1000)
+    assert int(np.asarray(rt.fs.meta.replay_peak).max()) > 0, \
+        "replay kernel path was not exercised"
+    c = rt.counters()
+    total = c["n_read"] + c["n_write"] + c["n_rmw"] + c["n_abort"]
+    assert total == cfg.n_replicas * cfg.n_sessions * cfg.ops_per_session
+    assert rt.check().ok
+
+
+def test_mega_matches_fused_batched_through_freeze_thaw():
+    """State identity under failure injection: freezes age keys past
+    replay_age, so the mega replay kernel's candidate/mark/slot path runs
+    for real (replay_peak reaches the slot count) — every leaf of the
+    final FastState/Meta tree must match the fused-sort program's."""
+    a = _drive_freeze_thaw(_cfg())
+    b = _drive_freeze_thaw(_cfg(mega_round=True))
+    _tree_equal(a.fs, b.fs)
+    assert int(np.asarray(b.fs.meta.replay_peak).max()) > 0, \
+        "replay path was not exercised — the identity claim is vacuous"
+    assert b.check().ok
+
+
+def test_mega_matches_fused_sharded():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("replica",))
+    base = dict(n_replicas=4, workload=WorkloadConfig(
+        read_frac=0.3, rmw_frac=0.2, seed=9))
+    a = _drive_freeze_thaw(_cfg(**base), backend="sharded", mesh=mesh)
+    b = _drive_freeze_thaw(_cfg(mega_round=True, **base),
+                           backend="sharded", mesh=mesh)
+    _tree_equal(a.fs, b.fs)
+    assert b.check().ok
+
+
+def test_mega_replay_multiblock_ragged_identity(monkeypatch):
+    """The replay kernel's block grid at a RAGGED shape (37 rows over
+    13-row blocks): the streaming candidate cursor crosses block visits
+    and the tail block masks its padding rows — still bit-identical."""
+    monkeypatch.setattr(megaround, "REPLAY_BLOCK_BYTES", 13 * 40)
+    a = _drive_freeze_thaw(_cfg(n_keys=37))
+    b = _drive_freeze_thaw(_cfg(n_keys=37, mega_round=True))
+    _tree_equal(a.fs, b.fs)
+    assert int(np.asarray(b.fs.meta.replay_peak).max()) > 0
+    assert b.check().ok
+
+
+def test_mega_pipeline_depth2_chaos_schedule_identity():
+    """The serving shape: pipeline depth 2 + a seeded chaos schedule
+    (freeze/thaw/heartbeat skew) driven identically against the fused and
+    mega programs — byte-identical executed event log AND final state,
+    checker green."""
+    def run(mega):
+        cfg = _cfg(n_replicas=4, pipeline_depth=2, mega_round=mega,
+                   ops_per_session=16)
+        rt = FastRuntime(cfg, record=True)
+        sched = chaos.Schedule.random(cfg, seed=23, steps=80)
+        runner = chaos.ChaosRunner(rt, sched)
+        res = runner.run(80, check=True)
+        assert res["drained"] and res["checked_ok"]
+        return runner.log_json(), rt.fs
+
+    log_a, fs_a = run(False)
+    log_b, fs_b = run(True)
+    assert log_a == log_b, "executed chaos logs differ"
+    _tree_equal(fs_a, fs_b)
+
+
+def test_mega_census_floor_and_interior_policed():
+    """The round-15 acceptance floor at a device-stream shape: the mega
+    batched round lowers to <= 4 sparse ops (vs the fused baseline's
+    strictly more), the kernel interiors carry ZERO cost-model ops, and
+    the Pallas ledger sees all four kernels (stats + route + apply +
+    replay) with a nonzero serial bound — the census can no longer go
+    blind inside a pallas_call."""
+    from hermes_tpu.obs import profile as prof
+
+    base = dict(n_keys=64, n_sessions=8, device_stream=True,
+                wrap_stream=True, ops_per_session=8)
+    fused = prof.op_census(_cfg(**base), "batched")
+    mega = prof.op_census(_cfg(mega_round=True, **base), "batched")
+    assert mega["sparse_total"] <= 4
+    assert mega["sparse_total"] < fused["sparse_total"]
+    assert mega["pallas_interior_sparse"] == 0
+    assert mega["pallas_calls"] == 4
+    assert mega["pallas_serial_iter_bound"] > 0
+    assert mega["collective_total"] == 0
+    # the non-mega census rides the ledger too (stats_block policed)
+    assert fused["pallas_calls"] == 1
+    assert fused["pallas_interior_sparse"] == 0
+
+
+def test_mega_config_validation_and_resolution():
+    with pytest.raises(ValueError, match="mega_round"):
+        HermesConfig(mega_round=True, arb_mode="race")
+    with pytest.raises(ValueError, match="mega_round"):
+        HermesConfig(mega_round=True, arb_mode="sort", fused_sort=False)
+    with pytest.raises(ValueError, match="VMEM"):
+        HermesConfig(mega_round=True, arb_mode="sort",
+                     n_keys=(MEGA_VPTS_VMEM_BYTES // 4) * 2)
+    assert not HermesConfig().use_mega_round
+    assert _cfg(mega_round=True).use_mega_round
+    assert not megaround.resolve(_cfg())  # knob off -> never resolves
+
+
+def test_mega_resolution_refusal_falls_back_loudly(monkeypatch):
+    """The 'analysis refuses' contract: when the kernel verdict is
+    dirty, the builders must warn LOUDLY (once) and the built program
+    must be the fused-sort fallback — bit-identical to fused_sort=True,
+    with zero pallas mega kernels in the lowering."""
+    from hermes_tpu.core import faststep as fst
+    from hermes_tpu.obs import profile as prof
+    from hermes_tpu.workload import ycsb
+
+    monkeypatch.setattr(megaround, "_kernels_clean",
+                        lambda: (False, "forced-dirty (test)"))
+    megaround._WARNED.clear()
+    try:
+        cfg = _cfg(mega_round=True)
+        with pytest.warns(RuntimeWarning, match="forced-dirty"):
+            assert not megaround.resolve(cfg)
+        # the built program is the fused baseline: same lowering census
+        cen = prof.op_census(cfg, "batched")
+        ref = prof.op_census(_cfg(), "batched")
+        assert cen == ref
+        # and it still runs correctly end to end
+        stream = fst.prep_stream(ycsb.make_streams(cfg))
+        fs = fst.init_fast_state(cfg)
+        step = fst.build_fast_batched(cfg)
+        for i in range(5):
+            fs, _ = step(fs, stream, fst.make_fast_ctl(cfg, i))
+        ref_fs = fst.init_fast_state(_cfg())
+        ref_step = fst.build_fast_batched(_cfg())
+        for i in range(5):
+            ref_fs, _ = ref_step(ref_fs, stream,
+                                 fst.make_fast_ctl(_cfg(), i))
+        _tree_equal(fs, ref_fs)
+    finally:
+        megaround._WARNED.clear()
+
+
+def test_broken_kernel_oob_store_flips_analyzer_red(monkeypatch):
+    """Analyzer red test: drop the apply kernel's index clamp/guard and
+    the RefHazard pass must flag the scatter site (the untrusted 29-bit
+    wire key escapes the vpts block) — and the resolution must then
+    REFUSE the mega path."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from hermes_tpu.analysis import diffcheck
+
+    def bad_apply_kernel(K, N):
+        def kern(vin_ref, key_ref, pts_ref, mask_ref, vout_ref, post_ref):
+            del vin_ref
+            phase = pl.program_id(0)
+
+            @pl.when(phase == 0)
+            def _():
+                def body(m, c):
+                    k = key_ref[pl.ds(m, 1), 0][0]  # UNCLAMPED wire key
+                    vout_ref[pl.ds(k, 1), 0] = jnp.maximum(
+                        vout_ref[pl.ds(k, 1), 0], pts_ref[pl.ds(m, 1), 0])
+                    return c
+
+                jax.lax.fori_loop(0, N, body, 0)
+
+            @pl.when(phase == 1)
+            def _():
+                def body(m, c):
+                    k = jnp.clip(key_ref[pl.ds(m, 1), 0][0], 0, K - 1)
+                    post_ref[pl.ds(m, 1), 0] = vout_ref[pl.ds(k, 1), 0]
+                    return c
+
+                jax.lax.fori_loop(0, N, body, 0)
+
+        return kern
+
+    monkeypatch.setattr(megaround, "_apply_kernel", bad_apply_kernel)
+    megaround.reset_resolution_cache()
+    try:
+        rep = diffcheck.analyze_kernel(
+            diffcheck.cell_by_name("mega_apply/k16n16"))
+        codes = [f.code for f in rep["findings"]
+                 if f.severity in ("error", "warn")]
+        assert "oob-block-store" in codes
+        ok, reason = megaround._kernels_clean()
+        assert not ok and "oob-block-store" in reason
+        with pytest.warns(RuntimeWarning):
+            assert not megaround.resolve(_cfg(mega_round=True))
+    finally:
+        megaround.reset_resolution_cache()
+
+
+def test_broken_kernel_pack_overflow_flips_analyzer_red(monkeypatch):
+    """Analyzer red test #2 (the pack half): a route kernel that shifts
+    the verdict word into the sign bit must trip the bitpack pass inside
+    the kernel body."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from hermes_tpu.analysis import diffcheck
+
+    def bad_route_kernel(L, C):
+        def kern(si_ref, word_ref, srank_ref, lw_ref, sl_ref):
+            lw_ref[:] = jnp.zeros_like(lw_ref)
+            sl_ref[:] = jnp.zeros_like(sl_ref)
+
+            def body(p, c):
+                lane = jnp.clip(si_ref[pl.ds(p, 1), 0][0], 0, L - 1)
+                w = word_ref[pl.ds(p, 1), 0]
+                lw_ref[pl.ds(lane, 1), 0] = (w << 12) | w  # sign-bit pack
+                return c
+
+            jax.lax.fori_loop(0, L, body, 0)
+
+        return kern
+
+    monkeypatch.setattr(megaround, "_route_kernel", bad_route_kernel)
+    megaround.reset_resolution_cache()
+    try:
+        rep = diffcheck.analyze_kernel(
+            diffcheck.cell_by_name("mega_route/r2l6"))
+        codes = [f.code for f in rep["findings"]
+                 if f.severity in ("error", "warn")]
+        assert "pack-shift-overflow" in codes
+        ok, _reason = megaround._kernels_clean()
+        assert not ok
+    finally:
+        megaround.reset_resolution_cache()
+
+
+def test_mega_kernel_cells_registered_and_sanitized():
+    """The differential sanitizer must draw against the mega kernels
+    (ISSUE 11 satellite): all three kernels registered, including the
+    multi-block ragged replay cell; one representative cell sanitized
+    here (the full matrix runs in the analysis gate)."""
+    from hermes_tpu.analysis import diffcheck
+
+    names = {c.name for c in diffcheck.kernel_cells()}
+    assert {"mega_route/r2l6", "mega_apply/k16n16", "mega_replay/k16b1",
+            "mega_replay/k22b3"} <= names
+    res = diffcheck.diff_check(
+        diffcheck.cell_by_name("mega_apply/k16n16"), n_draws=2)
+    assert res["ok"], res["violations"]
+
+
+def test_resolution_probe_usable_under_trace():
+    """The first resolve may happen while an outer round is being traced
+    (census/profile paths jit the round directly): the probe must not
+    leak tracers or refuse.  Force the cold path inside a jit trace."""
+    import jax.numpy as jnp
+
+    megaround.reset_resolution_cache()
+    try:
+        cfg = _cfg(mega_round=True)
+        seen = {}
+
+        @jax.jit
+        def traced(x):
+            seen["resolved"] = megaround.resolve(cfg)
+            return x + 1
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            traced(jnp.zeros((4,), jnp.int32))
+        assert seen["resolved"] is True
+    finally:
+        megaround.reset_resolution_cache()
