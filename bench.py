@@ -488,6 +488,208 @@ def run_fleet_bench(groups: int = 4, rounds: int | None = None,
     return r
 
 
+def _read_bench_cfg(on_tpu: bool):
+    """The read-bench store shape: full bench scale on a chip, a
+    reduced-but-same-mechanism shape on the host backend (the
+    run_fleet_bench carried-over protocol)."""
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    if on_tpu:
+        kw = dict(n_keys=1 << 20, n_sessions=8192, n_replicas=8)
+    else:
+        kw = dict(n_keys=1 << 14, n_sessions=512, n_replicas=4)
+    return HermesConfig(
+        value_words=8, replay_slots=64, ops_per_session=256,
+        pipeline_depth=2, rebroadcast_every=4, replay_scan_every=32,
+        workload=WorkloadConfig(read_frac=0.5, seed=0), **kw)
+
+
+def run_read_bench(n: int | None = None, seed: int = 14) -> dict:
+    """Round-16 read-side cells (BENCH_READS.json): the local-read fast
+    path measured against the per-op round path it replaces, plus the
+    YCSB-B/C/D read-heavy mixes and a checker-gated cell.
+
+      * ``per_op_get``   — N single gets through the classic future path
+                           (one key per (replica, session) lane per
+                           round) — the baseline the ISSUE's >= 5x
+                           acceptance compares against;
+      * ``multi_get``    — the same read volume through the batched
+                           device-resident path (one gather dispatch per
+                           chunk);
+      * ``scan``         — full-range scans through the zero-sparse-op
+                           slice program;
+      * ``ycsb_b/c/d``   — read-heavy mixes (workload.ycsb.READ_MIXES):
+                           writes ride submit_batch, reads ride
+                           multi_get, interleaved per chunk so D's
+                           latest-distribution reads actually chase the
+                           write frontier;
+      * ``checked``      — a smaller recorded run: linearizability
+                           checker green AND stale_read == [] (the read
+                           path is verified, not assumed).
+
+    The headline is ``reads_per_sec`` (batched multi_get) with
+    ``speedup_x`` vs the per-op rate."""
+    import numpy as np
+
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.workload.openloop import MixSpec, make_mix
+    from hermes_tpu.workload.ycsb import READ_MIXES
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = _read_bench_cfg(on_tpu)
+    if n is None:
+        n = 1 << 18 if on_tpu else 1 << 15
+    rng = np.random.default_rng(seed)
+    kvs = KVS(cfg)
+    lanes = [(r, s) for r in range(cfg.n_replicas)
+             for s in range(cfg.n_sessions)]
+
+    # preload a write working set so reads observe real values
+    prekeys = rng.permutation(cfg.n_keys)[: cfg.n_keys // 2].astype(np.int64)
+    vals = rng.integers(1, 1 << 20, size=(prekeys.size, cfg.value_words - 2)
+                        ).astype(np.int32)
+    bf = kvs.submit_batch(np.full(prekeys.size, KVS.PUT, np.int32), prekeys,
+                          vals)
+    assert kvs.run_batch(bf), "read-bench preload did not drain"
+
+    read_keys = prekeys[rng.integers(0, prekeys.size, size=n)]
+
+    # warm every measured program OUT of the timed windows (the standard
+    # warmup-chunk protocol of run_mix): the read programs compile on
+    # first dispatch, and a cold compile inside a cell would swamp the
+    # measured rate at host scale
+    chunk = 8192
+    kvs.multi_get(read_keys[:chunk])
+    kvs.scan(0, cfg.n_keys)
+    fw = kvs.get(0, 0, int(read_keys[0]))
+    assert kvs.run_until([fw])
+
+    # cell 1: the per-op round path (the pre-round-16 get)
+    n_per_op = min(n, 2048 if not on_tpu else 16384)
+    t0 = time.perf_counter()
+    futs = []
+    for i in range(n_per_op):
+        r, s = lanes[i % len(lanes)]
+        futs.append(kvs.get(r, s, int(read_keys[i])))
+    assert kvs.run_until(futs), "per-op gets did not drain"
+    per_op_wall = time.perf_counter() - t0
+    per_op_rate = n_per_op / per_op_wall
+
+    # cell 2: the batched device-resident path (the headline)
+    t0 = time.perf_counter()
+    local = 0
+    for lo in range(0, n, chunk):
+        res = kvs.multi_get(read_keys[lo: lo + chunk])
+        assert res.all_done()
+        local += res.local_served
+    mget_wall = time.perf_counter() - t0
+    mget_rate = n / mget_wall
+
+    # cell 3: range scans (whole table per dispatch window)
+    scan_reps = 4 if not on_tpu else 16
+    t0 = time.perf_counter()
+    for _ in range(scan_reps):
+        res = kvs.scan(0, cfg.n_keys)
+        assert res.all_done()
+    scan_wall = time.perf_counter() - t0
+    scan_rate = scan_reps * cfg.n_keys / scan_wall
+
+    cells = {
+        "per_op_get": dict(ops=n_per_op, wall_s=round(per_op_wall, 4),
+                           reads_per_sec=round(per_op_rate, 1)),
+        "multi_get": dict(ops=n, wall_s=round(mget_wall, 4),
+                          reads_per_sec=round(mget_rate, 1),
+                          local_served=local, chunk=chunk,
+                          fallbacks=kvs.read_stats()["fallback_reads"]),
+        "scan": dict(keys=scan_reps * cfg.n_keys,
+                     wall_s=round(scan_wall, 4),
+                     reads_per_sec=round(scan_rate, 1)),
+    }
+
+    # YCSB-B/C/D mixed cells: writes through submit_batch, reads through
+    # multi_get, interleaved chunk-wise
+    n_mix = min(n, 1 << 14) if not on_tpu else n
+    for name, kw in READ_MIXES.items():
+        spec = MixSpec(name=f"ycsb_{name}", tenants=4, **kw)
+        mix = make_mix(spec, cfg.n_keys, n_mix, seed,
+                       value_words=cfg.value_words - 2)
+        t0 = time.perf_counter()
+        reads = writes = 0
+        for lo in range(0, n_mix, chunk):
+            kk = mix["key"][lo: lo + chunk]
+            kd = mix["kind"][lo: lo + chunk]
+            wr = kd != 0
+            if wr.any():
+                b = kvs.submit_batch(
+                    np.full(int(wr.sum()), KVS.PUT, np.int32), kk[wr],
+                    mix["value"][lo: lo + chunk][wr])
+                assert kvs.run_batch(b)
+                writes += int(wr.sum())
+            rd = ~wr
+            if rd.any():
+                res = kvs.multi_get(kk[rd])
+                assert res.all_done()
+                reads += int(rd.sum())
+        wall = time.perf_counter() - t0
+        cells[f"ycsb_{name}"] = dict(
+            ops=n_mix, reads=reads, writes=writes,
+            wall_s=round(wall, 4),
+            ops_per_sec=round(n_mix / wall, 1),
+            reads_per_sec=round(reads / wall, 1) if reads else 0.0,
+            read_frac=spec.read_frac, distribution=spec.distribution)
+
+    # checked cell: the fast path VERIFIED — full linearizability plus
+    # the structural stale-read check over a recorded B-mix run
+    ccfg = _read_bench_cfg(False)
+    ckvs = KVS(ccfg, record="array")
+    spec = MixSpec(name="ycsb_b", tenants=4, **READ_MIXES["b"])
+    n_chk = 6000
+    mix = make_mix(spec, ccfg.n_keys, n_chk, seed,
+                   value_words=ccfg.value_words - 2)
+    for lo in range(0, n_chk, 1024):
+        kk = mix["key"][lo: lo + 1024]
+        kd = mix["kind"][lo: lo + 1024]
+        wr = kd != 0
+        if wr.any():
+            b = ckvs.submit_batch(np.full(int(wr.sum()), KVS.PUT, np.int32),
+                                  kk[wr], mix["value"][lo: lo + 1024][wr])
+            assert ckvs.run_batch(b)
+        if (~wr).any():
+            assert ckvs.multi_get(kk[~wr]).all_done()
+    v = ckvs.rt.check()
+    stale = lin.stale_read(ckvs.rt.history_ops())
+    cells["checked"] = dict(
+        ops=n_chk, checker_ok=bool(v.ok), keys_checked=v.keys_checked,
+        stale_read=[repr(e) for e in stale[:4]],
+        read_stats=ckvs.read_stats())
+
+    speedup = mget_rate / per_op_rate
+    out = {
+        "cells": cells,
+        "reads_per_sec": cells["multi_get"]["reads_per_sec"],
+        "speedup_x": round(speedup, 2),
+        "speedup_floor": 5.0,
+        "checker_ok": cells["checked"]["checker_ok"],
+        "stale_read_clean": not stale,
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+        "shape": dict(n_keys=cfg.n_keys, n_sessions=cfg.n_sessions,
+                      n_replicas=cfg.n_replicas,
+                      value_words=cfg.value_words),
+        "seed": seed,
+        "note": ("reads_per_sec = batched device-resident multi_get "
+                 "(core/readpath.py, one gather dispatch per chunk); "
+                 "speedup_x vs the per-op future path; checker cell "
+                 "gates full linearizability + stale_read == []"),
+    }
+    if not on_tpu:
+        out["tpu_pending"] = (
+            "host-backend stand-in at reduced shape — rerun bench.py "
+            "--reads on the chip for the full-scale cells")
+    return out
+
+
 def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
                    warmup: int = 8) -> dict:
     """Serving rate under chaos (round-9, CHAOS_BENCH.json): the bench-
@@ -618,6 +820,16 @@ def main() -> None:
                     "a tpu_pending note)")
     ap.add_argument("--serve-ops", type=int, default=None,
                     help="ops per --serve cell (default: platform-sized)")
+    ap.add_argument("--reads", action="store_true",
+                    help="measure the round-16 read side instead: batched "
+                    "device-resident multi_get vs the per-op get path "
+                    "(>=5x acceptance floor), range scans, the YCSB-B/C/D "
+                    "read-heavy mixes, and a checker-gated cell with "
+                    "stale_read == []; writes BENCH_READS.json (host "
+                    "cells carry a tpu_pending note)")
+    ap.add_argument("--reads-ops", type=int, default=None,
+                    help="read volume per --reads cell (default: "
+                    "platform-sized)")
     ap.add_argument("--fleet", action="store_true",
                     help="measure the key-sharded fleet instead "
                     "(round-13, hermes_tpu.fleet): per-group + aggregate "
@@ -684,6 +896,29 @@ def main() -> None:
         # a cell that lost its server or part of its answers is NOT a
         # pass, however good the answered-prefix percentiles look
         if errs or not r["latency_p50_improves"]:
+            sys.exit(1)
+        return
+
+    if args.reads:
+        r = run_read_bench(n=args.reads_ops)
+        with open("BENCH_READS.json", "w") as f:
+            json.dump(r, f, indent=1)
+        cell(r)
+        out.write({
+            "metric": "local_reads_per_sec",
+            "value": r["reads_per_sec"],
+            "unit": "reads/s",
+            "per_op_reads_per_sec":
+                r["cells"]["per_op_get"]["reads_per_sec"],
+            "speedup_x": r["speedup_x"],
+            "scan_reads_per_sec": r["cells"]["scan"]["reads_per_sec"],
+            "checker_ok": r["checker_ok"],
+            "stale_read_clean": r["stale_read_clean"],
+        })
+        # the acceptance floor is part of the cell's meaning: a read path
+        # slower than 5x the per-op path, or an unverified one, is a FAIL
+        if (r["speedup_x"] < r["speedup_floor"] or not r["checker_ok"]
+                or not r["stale_read_clean"]):
             sys.exit(1)
         return
 

@@ -22,8 +22,9 @@ from hermes_tpu.core import types
 __version__ = "0.2.0"
 
 __all__ = ["HermesConfig", "WorkloadConfig", "FleetConfig", "types", "KVS",
-           "KeyIndex", "RangeRouter", "Fleet", "FleetRouter", "FastRuntime",
-           "Runtime", "Frontend", "ServingConfig", "__version__"]
+           "MultiGetResult", "KeyIndex", "RangeRouter", "Fleet",
+           "FleetRouter", "FastRuntime", "Runtime", "Frontend",
+           "ServingConfig", "__version__"]
 
 
 def __getattr__(name):
@@ -33,6 +34,8 @@ def __getattr__(name):
     # cached in module globals, so __getattr__ runs once per name.
     if name == "KVS":
         from hermes_tpu.kvs import KVS as obj
+    elif name == "MultiGetResult":
+        from hermes_tpu.kvs import MultiGetResult as obj
     elif name == "KeyIndex":
         from hermes_tpu.keyindex import KeyIndex as obj
     elif name == "RangeRouter":

@@ -270,6 +270,69 @@ def committed_write_lost(committed_uids, ops: Sequence[Op],
     return lost
 
 
+def stale_read(ops: Sequence[Op], initial_uid_for_key=lambda k: (k, -1)
+               ) -> List[dict]:
+    """Round-16 read-side safety cross-check, structural form of the
+    local-read hazard class: a read that returned a value the history
+    PROVES was overwritten before the read was even issued.
+
+    The full Wing&Gong search would also reject such a history, but (like
+    ``committed_write_lost`` for the PR-5 bug class) this names the exact
+    failure shape the read fast path could introduce — serving stale
+    bytes from a row the protocol already superseded — so a violation is
+    diagnosed as "stale read", not as an opaque no-linearization-exists.
+
+    Rule: updates linearize in protocol-timestamp order (the witness).
+    For a read r observing value v written by committed update u1, if ANY
+    committed update u2 on the key has ts(u2) > ts(u1) and responded
+    before r was invoked (u2.resp < r.inv), then v was provably no longer
+    current at every point in [r.inv, r.resp] — u2 had already linearized
+    and only higher-ts updates can follow — so r cannot linearize.
+    Reads of the initial value are stale once any committed update
+    responded before their invocation.  Incomplete updates (maybe_w)
+    never prove staleness (they may linearize arbitrarily late).
+
+    Returns evidence dicts (empty list = clean): one per stale read with
+    the read, the value it observed, and the superseding update."""
+    by_key: Dict[int, List[Op]] = {}
+    for o in ops:
+        by_key.setdefault(o.key, []).append(o)
+    evidence: List[dict] = []
+    for k, kops in by_key.items():
+        updates = [o for o in kops if o.kind in ("w", "rmw")
+                   and o.ts is not None]
+        if not updates:
+            continue
+        updates.sort(key=lambda o: o.ts)
+        ts_of = {u.wuid: i for i, u in enumerate(updates)}
+        # sufmin[i] = earliest response among updates ranked > i (the
+        # first PROVEN overwrite time of update i's value)
+        sufmin = [INF] * (len(updates) + 1)
+        for i in range(len(updates) - 1, -1, -1):
+            sufmin[i] = min(sufmin[i + 1], updates[i].resp)
+        initial = initial_uid_for_key(k)
+        for o in kops:
+            if o.kind not in ("r", "rmw") or o.ruid is None:
+                continue
+            if o.ruid == initial:
+                overwritten = sufmin[0]
+            else:
+                rank = ts_of.get(o.ruid)
+                if rank is None:
+                    continue  # unknown/maybe value: not this check's job
+                overwritten = sufmin[rank + 1]
+            if overwritten < o.inv:
+                cands = (updates if o.ruid == initial
+                         else updates[ts_of[o.ruid] + 1:])
+                sup = min(cands, key=lambda u: u.resp)
+                evidence.append(dict(
+                    key=k, read=o, observed=o.ruid,
+                    superseded_by=sup.wuid, superseded_resp=sup.resp))
+        if len(evidence) >= 64:
+            break  # plenty of evidence; keep failure reports bounded
+    return evidence
+
+
 def sample_keys(ops: Sequence[Op], max_keys: int = 512, seed: int = 0) -> List[Op]:
     """Down-sample a huge history to ``max_keys`` keys (bench-scale runs
     check a sample; tests check everything).  Keeps whole per-key

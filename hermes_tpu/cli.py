@@ -168,6 +168,24 @@ def build_parser() -> argparse.ArgumentParser:
                     "the serving invariants (response conservation, "
                     "admission accounting exactness).  Needs "
                     "--value-words >= 3; fast batched backend")
+    ap.add_argument("--reads", type=int, default=None, metavar="N",
+                    help="local-read fast-path quickstart (round-16, "
+                    "core/readpath.py): drive N ops — reads through the "
+                    "batched device-resident multi_get, writes through "
+                    "submit_batch, interleaved — and print one JSON "
+                    "summary line; --check additionally gates the "
+                    "linearizability checker AND the stale-read check "
+                    "(checker/linearizability.stale_read).  Needs "
+                    "--value-words >= 3; fast batched backend.  "
+                    "--read-frac sets the read share, --distribution/"
+                    "--zipf-theta shape the keys (plus 'latest' via "
+                    "--read-latest)")
+    ap.add_argument("--read-frac", type=float, default=0.95,
+                    help="read fraction of the --reads mix (default "
+                    "0.95, the YCSB-B shape)")
+    ap.add_argument("--read-latest", action="store_true",
+                    help="--reads: draw read keys latest-distribution "
+                    "(YCSB-D) instead of --distribution")
     ap.add_argument("--serve-rate", type=float, default=8000.0,
                     help="open-loop arrival rate (ops per virtual second) "
                     "for --serve")
@@ -273,6 +291,68 @@ def _run_serve(args, cfg) -> int:
         v = kvs.rt.check(max_keys=args.check_keys)
         summary["checked_ok"] = bool(v.ok)
         ok = ok and v.ok
+    summary["ok"] = bool(ok)
+    print(json.dumps(summary, default=str))
+    return 0 if ok else 1
+
+
+def _run_reads(args, cfg) -> int:
+    """Local-read quickstart (round-16): N ops at --read-frac through
+    the batched device-resident read path (reads) and submit_batch
+    (writes), one JSON line; --check gates the linearizability checker
+    plus the structural stale-read check."""
+    import json
+
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.checker.fast import default_record
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.workload.openloop import MixSpec, make_mix
+
+    kvs = KVS(cfg, record=default_record(args.check))
+    dist = "latest" if args.read_latest else cfg.workload.distribution
+    spec = MixSpec(name=dist, distribution=dist,
+                   zipf_theta=cfg.workload.zipf_theta,
+                   read_frac=args.read_frac)
+    n = args.reads
+    mix = make_mix(spec, cfg.n_keys, n, args.seed,
+                   value_words=cfg.value_words - 2)
+    chunk = 4096
+    t0 = time.perf_counter()
+    reads = writes = local = 0
+    for lo in range(0, n, chunk):
+        kk = mix["key"][lo: lo + chunk]
+        wr = mix["kind"][lo: lo + chunk] != 0
+        if wr.any():
+            bf = kvs.submit_batch(
+                np.full(int(wr.sum()), KVS.PUT, np.int32), kk[wr],
+                mix["value"][lo: lo + chunk][wr])
+            if not kvs.run_batch(bf, max_steps=args.steps or 50_000):
+                print(json.dumps({"ok": False,
+                                  "error": "write share did not drain"}))
+                return 1
+            writes += int(wr.sum())
+        rd = ~wr
+        if rd.any():
+            res = kvs.multi_get(kk[rd])
+            if not res.all_done():
+                print(json.dumps({"ok": False,
+                                  "error": "read share did not drain"}))
+                return 1
+            reads += int(rd.sum())
+            local += res.local_served
+    wall = time.perf_counter() - t0
+    summary = dict(ops=n, reads=reads, writes=writes,
+                   read_frac=args.read_frac, distribution=dist,
+                   wall_s=round(wall, 3),
+                   reads_per_sec=round(reads / wall, 1) if reads else 0.0,
+                   **kvs.read_stats())
+    ok = True
+    if args.check:
+        v = kvs.rt.check(max_keys=args.check_keys)
+        stale = lin.stale_read(kvs.rt.history_ops())
+        summary["checked_ok"] = bool(v.ok)
+        summary["stale_read"] = [repr(e) for e in stale[:4]]
+        ok = bool(v.ok) and not stale
     summary["ok"] = bool(ok)
     print(json.dumps(summary, default=str))
     return 0 if ok else 1
@@ -455,6 +535,23 @@ def main(argv=None) -> int:
                                or args.chaos_schedule or args.freeze):
         ap.error("--bench-latency is its own drive; drop --acceptance/"
                  "--drill/--fleet-groups/--chaos/--freeze")
+    if args.reads is not None:
+        if args.reads < 1:
+            ap.error("--reads wants a positive op count")
+        if not (0.0 <= args.read_frac <= 1.0):
+            ap.error("--read-frac must be in [0, 1]")
+        if args.backend != "fast":
+            ap.error("--reads drives the fast batched backend through the "
+                     "KVS facade (core/readpath.py)")
+        if args.value_words < 3:
+            ap.error("--reads needs --value-words >= 3 (words 0-1 carry "
+                     "the write uid)")
+        if (args.acceptance or args.drill or args.fleet_groups
+                or args.serve is not None or args.bench_latency
+                or args.chaos is not None or args.chaos_schedule
+                or args.freeze):
+            ap.error("--reads is its own drive; drop --acceptance/--drill/"
+                     "--fleet-groups/--serve/--chaos/--freeze")
     chaos_on = args.chaos is not None or args.chaos_schedule
     if chaos_on:
         if args.backend not in ("fast", "fast-sharded"):
@@ -569,6 +666,9 @@ def main(argv=None) -> int:
 
     if args.serve is not None:
         return _run_serve(args, cfg)
+
+    if args.reads is not None:
+        return _run_reads(args, cfg)
 
     if args.bench_latency:
         return _run_bench_latency(args, cfg)

@@ -1,0 +1,247 @@
+"""Round-16: the local-read fast path (ROADMAP item 4).
+
+Hermes' signature property (PAPER.md) is that reads are LOCAL: any
+replica serves a Valid key from its own table with zero wire traffic.
+After 15 rounds the rebuild still answered every client get through the
+round's session lanes — one key per (replica, session) slot per round,
+paying the full intake/arbiter/broadcast machinery for an op that needs
+none of it.  This module is the read side built to the same standard as
+the write round: ONE jitted dispatch answers a whole batch of keys
+against the resident FastState.
+
+Design rules (the op-diet discipline of rounds 2-15 applied to reads):
+
+  * ZERO round impact — the read program is a separate dispatch that
+    never touches the round chain, so the round census stays exactly
+    12/4 sparse batched and 15/7 sharded (scripts/check_op_census.py
+    gates it; the read program's own census is budgeted separately
+    under OP_BUDGET.json's ``read_path``/``read_scan`` sections).
+  * ONE sparse op for a whole multi-get — the bank row gather.  The
+    row layout (core/faststep.py BANK_*) colocates [pts | sst | val],
+    so the Valid check, the value words, AND the packed ts the RYW
+    fence compares all come from that single gather; the byte->word
+    unpack is the strided static form XLA fuses like a slice.
+  * ZERO sparse ops for a range scan — contiguous rows move with one
+    ``dynamic_slice`` (start traced, size static), which the cost model
+    prices as dense work, not a launch-taxed sparse op.
+  * Fixed compiled shapes — batches pad to power-of-two buckets
+    (min ``MIN_BATCH``) so an arbitrary client batch size cannot
+    trigger a recompile per call; padded rows read slot 0 and are
+    masked out host-side.
+
+The answer is (valid, val, pts) per key:
+
+  ``valid``  the key's state is types.VALID *at this replica* — the
+             ONLY state that may serve a local read (SURVEY.md §3.2).
+             Invalid/Write/Trans/Replay keys are NOT answered here; the
+             client layer (kvs.KVS.multi_get) falls back to the round
+             path for them instead of returning possibly-stale bytes.
+  ``val``    the row's value words (words 0-1 = the unique write id,
+             the linearizability witness the checker keys on).
+  ``pts``    the row's packed (ver<<10|fc) timestamp — what the
+             read-your-writes fence compares against the session's own
+             committed-write timestamps (kvs.KVS.multi_get).
+
+Consistency argument (why a between-rounds host read of a VALID row is
+linearizable): the table's winner-row scatter writes ts, state and
+value TOGETHER at commit, and later rounds only ever replace a row with
+a strictly higher-ts row (the vpts scatter-max arbitration).  The host
+calls this program between round k-1's completion and round k's
+harvest, so the observed row is exactly the state device reads of round
+k would see — the read linearizes at the round-k read point
+(inv = resp = 2k in the recorder's doubled clock), after commits(k-1)
+and before commits(k).  A key whose write is still in flight is not
+VALID and never answered locally, which is precisely the reference's
+read-stall rule.  The stale-read checker (checker/linearizability.
+stale_read) verifies the property on recorded histories instead of
+assuming it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import faststep as fst
+from hermes_tpu.core import types as t
+
+# Smallest compiled batch bucket: batches pad up to powers of two from
+# here, so a client mixing batch sizes compiles at most
+# log2(n_keys/MIN_BATCH) + 1 programs per (cfg, backend).
+MIN_BATCH = 256
+
+
+def batch_bucket(n: int) -> int:
+    """The compiled batch shape serving a client batch of ``n`` keys."""
+    b = MIN_BATCH
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ReadAnswer(NamedTuple):
+    """Device answer of one read dispatch (host fetches all three)."""
+
+    valid: jnp.ndarray  # (B,) bool — state == VALID at the serving replica
+    val: jnp.ndarray    # (B, V) int32 value words (0-1 = write uid)
+    pts: jnp.ndarray    # (B,) int32 packed row timestamp (RYW fence input)
+
+
+def _answer_rows(rows8):
+    """[pts | sst | val] byte rows -> ReadAnswer columns (dense
+    slice+elementwise; XLA fuses it into the gather/slice producer)."""
+    rows32 = fst._bank_to_i32(rows8)
+    state = fst.sst_state(rows32[..., fst.BANK_SST])
+    return ReadAnswer(
+        valid=state == t.VALID,
+        val=rows32[..., fst.BANK_VAL:],
+        pts=rows32[..., fst.BANK_PTS],
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def build_multi_get(cfg: HermesConfig, backend: str = "batched",
+                    batch: int = MIN_BATCH):
+    """Compile the batched multi-get: ``fn(table, slots, replica) ->
+    ReadAnswer`` for a fixed ``(batch,)`` slot vector.
+
+    ``slots`` are dense key ids clamped to [0, n_keys) on device (an
+    untrusted index must never gather out of bounds — the round-3 wire
+    clamp rule applied to the read path); padded entries should carry
+    slot 0 and be masked by the caller.  ``replica`` selects whose table
+    copy serves: ignored in batched mode (the shard's replicas share
+    the authoritative table — any live replica's local read observes
+    it), row-offset ``replica * K`` in sharded mode (each shard owns
+    its own rows; the caller picks a healthy replica).  ONE dynamic
+    gather per dispatch — OP_BUDGET.json's ``read_path`` ceiling."""
+    k = cfg.n_keys
+
+    def mget(table: fst.FastTable, slots, replica):
+        slots = jnp.clip(slots, 0, k - 1)
+        if backend == "sharded":
+            slots = replica * k + slots
+        return _answer_rows(table.bank[slots])
+
+    return jax.jit(mget, static_argnames=())
+
+
+@functools.lru_cache(maxsize=None)
+def build_scan(cfg: HermesConfig, backend: str = "batched",
+               size: int = MIN_BATCH):
+    """Compile the range scan: ``fn(table, lo, replica) -> ReadAnswer``
+    over ``size`` contiguous slots starting at ``lo``.  Contiguous rows
+    move with one ``dynamic_slice`` (start traced, extent static) — no
+    sparse op at all (``read_scan`` budgets sparse_total = 0); jax
+    clamps the start so a tail window reads the last ``size`` rows and
+    the caller masks to the requested [lo, hi)."""
+    k = cfg.n_keys
+
+    def scan(table: fst.FastTable, lo, replica):
+        start = lo if backend != "sharded" else replica * k + lo
+        rows8 = jax.lax.dynamic_slice_in_dim(table.bank, start, size)
+        return _answer_rows(rows8)
+
+    return jax.jit(scan)
+
+
+def read_census(cfg: HermesConfig, backend: str = "batched",
+                batch: int = 4096) -> dict:
+    """StableHLO op census of ONE read dispatch (multi-get) at ``batch``
+    keys — the measurement half of the ``read_path`` budget
+    (scripts/check_op_census.py), abstract lowering only."""
+    from hermes_tpu.obs.profile import census_text
+
+    table = _abstract_table(cfg, backend)
+    fn = build_multi_get(cfg, backend, batch)
+    txt = fn.lower(table, jax.ShapeDtypeStruct((batch,), jnp.int32),
+                   jnp.int32(0)).as_text()
+    return census_text(txt)
+
+
+def scan_census(cfg: HermesConfig, backend: str = "batched",
+                size: int = 4096) -> dict:
+    """Census of one range-scan dispatch (``read_scan`` budget)."""
+    from hermes_tpu.obs.profile import census_text
+
+    table = _abstract_table(cfg, backend)
+    fn = build_scan(cfg, backend, size)
+    txt = fn.lower(table, jnp.int32(0), jnp.int32(0)).as_text()
+    return census_text(txt)
+
+
+def _abstract_table(cfg: HermesConfig, backend: str):
+    n_local = cfg.n_replicas if backend == "sharded" else None
+    fs = jax.eval_shape(lambda: fst.init_fast_state(cfg, n_local=n_local))
+    return fs.table
+
+
+class LocalReader:
+    """Host-side driver of the read programs over one FastRuntime.
+
+    Owns the per-(shape) compiled-program cache and the serving-replica
+    choice: local reads may only be served by a HEALTHY replica (live
+    and unfrozen — a fenced replica must not serve reads, the lease
+    rule of SURVEY.md §5.3).  Returns numpy-backed ReadAnswers trimmed
+    to the client batch; ``None`` when no replica may serve (callers
+    fall back to the round path for everything)."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self.cfg = rt.cfg
+        self.backend = "sharded" if rt.backend == "sharded" else "batched"
+        self.dispatches = 0
+        self.keys_served = 0
+
+    def _serving_replica(self) -> Optional[int]:
+        healthy = self.rt.healthy_replicas()
+        return healthy[0] if healthy else None
+
+    def multi_get(self, slots) -> Optional[ReadAnswer]:
+        """One read dispatch for an (n,) int array of dense slots."""
+        import numpy as np
+
+        rep = self._serving_replica()
+        if rep is None:
+            return None
+        slots = np.asarray(slots, np.int32)
+        n = slots.shape[0]
+        b = batch_bucket(n)
+        fn = build_multi_get(self.cfg, self.backend, b)
+        padded = np.zeros(b, np.int32)
+        padded[:n] = slots
+        ans = fn(self.rt.fs.table, padded, jnp.int32(rep))
+        ans = jax.device_get(ans)
+        self.dispatches += 1
+        self.keys_served += n
+        return ReadAnswer(valid=np.asarray(ans.valid)[:n],
+                          val=np.asarray(ans.val)[:n],
+                          pts=np.asarray(ans.pts)[:n])
+
+    def scan(self, lo: int, hi: int) -> Optional[ReadAnswer]:
+        """One scan dispatch over dense slots [lo, hi)."""
+        import numpy as np
+
+        if not (0 <= lo < hi <= self.cfg.n_keys):
+            raise ValueError(f"scan range [{lo}, {hi}) outside "
+                             f"[0, {self.cfg.n_keys})")
+        rep = self._serving_replica()
+        if rep is None:
+            return None
+        n = hi - lo
+        size = min(batch_bucket(n), self.cfg.n_keys)
+        fn = build_scan(self.cfg, self.backend, size)
+        # dynamic_slice clamps the start: issue the window so the
+        # requested rows are always inside it, then trim host-side
+        start = min(lo, self.cfg.n_keys - size)
+        ans = jax.device_get(fn(self.rt.fs.table, jnp.int32(start),
+                                jnp.int32(rep)))
+        off = lo - start
+        self.dispatches += 1
+        self.keys_served += n
+        return ReadAnswer(valid=np.asarray(ans.valid)[off:off + n],
+                          val=np.asarray(ans.val)[off:off + n],
+                          pts=np.asarray(ans.pts)[off:off + n])

@@ -40,7 +40,8 @@ import numpy as np
 
 from hermes_tpu.config import FleetConfig
 from hermes_tpu.fleet.router import FleetRouter
-from hermes_tpu.kvs import C_REJECTED, BatchFutures, Completion, Future, KVS
+from hermes_tpu.kvs import (C_REJECTED, BatchFutures, Completion, Future,
+                            KVS, MultiGetResult)
 
 
 @dataclasses.dataclass
@@ -136,6 +137,39 @@ class FleetBatch:
         view.code, view.value, view.uid = self.code, self.value, self.uid
         view.found, view.step = self.found, self.step
         return view.completion(i)
+
+
+class FleetReads(MultiGetResult):
+    """Merged view over per-group ``MultiGetResult``s of one fleet
+    multi-get/scan (round-16): the inherited columns in FLEET submission
+    order, filled as the owning groups answer their shares — locally
+    from the device-resident fast path where keys are Valid, via the
+    round path otherwise.  Keys on a draining fleet range complete
+    immediately as C_REJECTED (the fleet-level reject the router's
+    drain promises).  Only ``_pull`` differs from the single-group
+    result: it merges MANY sub-results at their fleet index positions."""
+
+    def __init__(self, keys: np.ndarray, groups: np.ndarray, u: int):
+        super().__init__(keys, u)
+        self.group = groups      # owning group per key (-1 = fleet-rejected)
+        self._subs: List[tuple] = []  # (gid, MultiGetResult, fleet indices)
+
+    def _pull(self) -> None:
+        for _g, sub, gix in self._subs:
+            sub._pull()
+            done = (sub.code != 0) & (self.code[gix] == 0)
+            if done.any():
+                di = gix[done]
+                self.code[di] = sub.code[done]
+                self.value[di] = sub.value[done]
+                self.found[di] = sub.found[done]
+                self.local[di] = sub.local[done]
+                self.step[di] = sub.step[done]
+
+    @property
+    def local_served(self) -> int:
+        self._pull()
+        return int(np.count_nonzero(self.local))
 
 
 class Fleet:
@@ -302,6 +336,133 @@ class Fleet:
                 bf = grp.kvs.submit_batch(kinds[gix], slots[gix], uval[gix])
             fb._subs.append((grp.gid, bf, gix))
         return fb
+
+    # -- local-read fast path (round-16) -------------------------------------
+
+    def _read_session(self, grp: _Group, session):
+        """The fence token a fleet read hands each group's KVS: an int
+        fleet session id maps to the group's (replica, session) lane
+        exactly like the write path; any other hashable token passes
+        through verbatim (the serving front-end's per-tenant fencing —
+        fences pinned via ``pin_read_fence`` live under the same token
+        in every group, keyed by group-local slots)."""
+        if session is None:
+            return None
+        return self._lane(grp, session) if isinstance(session, int) \
+            else session
+
+    def _reject_draining(self, fr: FleetReads, keys: np.ndarray) -> np.ndarray:
+        """C_REJECTED every key on a draining fleet range (the facade
+        reject the router's drain promises — same as the write paths);
+        returns the draining mask."""
+        draining = np.asarray(self.router.draining(keys), bool)
+        if draining.any():
+            fr.code[draining] = C_REJECTED
+            fr.found[draining] = False
+            fr.group[draining] = -1
+            self.rejected_ops += int(draining.sum())
+        return draining
+
+    def multi_get(self, keys, session=None, wait: bool = True,
+                  max_steps: int = 50_000) -> FleetReads:
+        """Batched fleet read: fan the key vector to the owning groups'
+        device-resident fast paths (``kvs.KVS.multi_get``) and merge the
+        answers in FLEET key order.  ``session`` is a fleet session id
+        (int — lane-mapped per group like the write path) or an opaque
+        fence token (see ``pin_read_fence``); read-your-writes fencing
+        composes with routing either way.  Draining fleet ranges reject
+        (C_REJECTED); with ``wait`` the round-path fallbacks are driven
+        to completion fleet-wide."""
+        keys = np.atleast_1d(np.asarray(keys, np.int64))
+        n = keys.shape[0]
+        u = self.cfg.base.value_words - 2
+        gids, slots = self.router.locate(keys)
+        gids = np.asarray(gids, np.int32).copy()
+        fr = FleetReads(keys.copy(), gids, u)
+        if n == 0:
+            return fr
+        draining = self._reject_draining(fr, keys)
+        for grp in self.groups:
+            mine = (gids == grp.gid) & ~draining
+            if not mine.any():
+                continue
+            gix = np.nonzero(mine)[0]
+            with grp.ctx():
+                sub = grp.kvs.multi_get(
+                    np.asarray(slots)[gix],
+                    session=self._read_session(grp, session), wait=False)
+            # the group echoed local dense slots; the fleet columns echo
+            # the fleet keys (fr.key), so the sub result is only read
+            # for its answer columns
+            fr._subs.append((grp.gid, sub, gix))
+        if wait:
+            self.run_reads(fr, max_steps=max_steps)
+        return fr
+
+    def scan(self, lo: int, hi: int, session=None, wait: bool = True,
+             max_steps: int = 50_000) -> FleetReads:
+        """Fleet range scan over fleet keys ``[lo, hi)``: contiguous
+        group shares ride the zero-sparse-op slice program
+        (``kvs.KVS.scan``); shares fragmented by migrations fall back to
+        the gather program.  Answers merge in fleet key order."""
+        if not (0 <= lo < hi <= self.cfg.total_keys):
+            raise ValueError(f"fleet scan range [{lo}, {hi}) outside "
+                             f"[0, {self.cfg.total_keys})")
+        keys = np.arange(lo, hi, dtype=np.int64)
+        u = self.cfg.base.value_words - 2
+        gids, slots = self.router.locate(keys)
+        gids = np.asarray(gids, np.int32).copy()
+        slots = np.asarray(slots)
+        fr = FleetReads(keys, gids, u)
+        draining = self._reject_draining(fr, keys)
+        for grp in self.groups:
+            mine = (gids == grp.gid) & ~draining
+            if not mine.any():
+                continue
+            gix = np.nonzero(mine)[0]
+            share = slots[gix]
+            lane = self._read_session(grp, session)
+            contiguous = (share.size == 1
+                          or (np.diff(share) == 1).all())
+            with grp.ctx():
+                if contiguous:
+                    sub = grp.kvs.scan(int(share[0]), int(share[-1]) + 1,
+                                       session=lane, wait=False)
+                else:
+                    # migrations fragmented this share's local slots:
+                    # the gather program serves it (still one dispatch)
+                    sub = grp.kvs.multi_get(share, session=lane,
+                                            wait=False)
+            fr._subs.append((grp.gid, sub, gix))
+        if wait:
+            self.run_reads(fr, max_steps=max_steps)
+        return fr
+
+    def pin_read_fence(self, session, fleet_key: int, ts) -> None:
+        """Pin a per-token read-your-writes fence on the group owning
+        ``fleet_key`` (the KVS.pin_read_fence hook, routed): later
+        ``multi_get(..., session=token)`` reads of the key must observe
+        ``ts`` or fall back to the round path."""
+        g, slot = self.router.locate(int(fleet_key))
+        self.groups[int(g)].kvs.pin_read_fence(session, int(slot), ts)
+
+    def run_reads(self, fr: FleetReads, max_steps: int = 50_000) -> bool:
+        """Drive a FleetReads' round-path fallbacks to completion (a
+        no-op when every key answered locally — the common case)."""
+        for _ in range(max_steps):
+            if fr.all_done():
+                return True
+            self.step()
+        self.flush()
+        return fr.all_done()
+
+    def read_stats(self) -> dict:
+        """Fleet-wide fast-path accounting (sum of group counters)."""
+        agg: Dict[str, int] = {}
+        for grp in self.groups:
+            for k, v in grp.kvs.read_stats().items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
 
     # -- stepping ------------------------------------------------------------
 

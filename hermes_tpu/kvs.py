@@ -99,6 +99,12 @@ class Completion:
     # mode reads of unwritten slots return the zero-initialized value with
     # found=True, matching a preloaded-table store.
     found: bool = True
+    # committed updates only (round-16): the globally re-anchored
+    # protocol (ver, fc) of this write — what a caller hands back to
+    # ``KVS.pin_read_fence`` to make its later local reads RYW-fenced
+    # under its own session token (the serving front-end does exactly
+    # this per tenant)
+    ts: Optional[Tuple[int, int]] = None
 
 
 class Future:
@@ -141,6 +147,11 @@ class BatchFutures:
         # completed without a round) — parity with the per-op path's
         # Completion.step, so batched callers keep step observability
         self.step = np.full(n, -1, np.int32)
+        # committed updates' re-anchored protocol timestamps (round-16):
+        # the batch-path analogue of Completion.ts, so batched writers
+        # can pin read fences too
+        self.tsv = np.zeros(n, np.int64)
+        self.tsf = np.zeros(n, np.int32)
 
     def __len__(self) -> int:
         return self.code.shape[0]
@@ -170,6 +181,7 @@ class BatchFutures:
             done.value = self.value[i].tolist()
         if c in (t.C_WRITE, t.C_RMW):
             done.uid = (int(self.uid[i, 0]), int(self.uid[i, 1]))
+            done.ts = (int(self.tsv[i]), int(self.tsf[i]))
         return done
 
     def future(self, i: int) -> Future:
@@ -177,6 +189,66 @@ class BatchFutures:
         if self.code[i] != 0:
             fut._result = self.completion(i)
         return fut
+
+
+class MultiGetResult:
+    """Result of one ``KVS.multi_get``/``scan`` call (round-16): the same
+    preallocated-column shape as BatchFutures —
+
+      ``key``   (n,) the CLIENT keys echoed (fleet/sparse callers see the
+                keys they submitted, never dense slots)
+      ``code``  (n,) 0 pending, else types.C_READ / kvs.C_REJECTED
+      ``value`` (n, value_words-2) payload words (uid words stripped,
+                like Completion.value)
+      ``found`` (n,) bool (sparse mode: False for never-written keys)
+      ``local`` (n,) bool — answered by the device-resident fast path
+                (False = round-trip fallback or immediate refusal)
+      ``step``  (n,) protocol round the answer is anchored to
+
+    Keys the fast path could not serve (Invalid at the serving replica,
+    read-your-writes fence unsatisfied, or no healthy replica) ride a
+    fallback ``BatchFutures`` through the normal round path — drive it
+    with ``KVS.step()`` / ``run_reads`` until ``all_done()``."""
+
+    def __init__(self, keys: np.ndarray, u: int):
+        n = keys.shape[0]
+        self.key = keys
+        self.code = np.zeros(n, np.int32)
+        self.value = np.zeros((n, u), np.int32)
+        self.found = np.ones(n, bool)
+        self.local = np.zeros(n, bool)
+        self.step = np.full(n, -1, np.int32)
+        self._fallback: Optional[Tuple[BatchFutures, np.ndarray]] = None
+
+    def __len__(self) -> int:
+        return self.key.shape[0]
+
+    def _pull(self) -> None:
+        if self._fallback is None:
+            return
+        bf, gix = self._fallback
+        done = (bf.code != 0) & (self.code[gix] == 0)
+        if done.any():
+            di = gix[done]
+            self.code[di] = bf.code[done]
+            self.value[di] = bf.value[done]
+            self.found[di] = bf.found[done]
+            self.step[di] = bf.step[done]
+
+    def done_count(self) -> int:
+        self._pull()
+        return int(np.count_nonzero(self.code))
+
+    def all_done(self) -> bool:
+        return self.done_count() == len(self)
+
+    @property
+    def local_served(self) -> int:
+        return int(np.count_nonzero(self.local))
+
+    @property
+    def fallbacks(self) -> int:
+        return 0 if self._fallback is None else int(self._fallback[1].size)
 
 
 class KVS:
@@ -305,6 +377,21 @@ class KVS:
             self.index: Optional[KeyIndex] = KeyIndex(cfg.n_keys)
         else:
             self.index = None
+        # local-read fast path (round-16, core/readpath.py): one jitted
+        # dispatch answers a whole multi_get/scan against the resident
+        # FastState table — zero round involvement.  _ryw is the
+        # read-your-writes fence: per (replica, session) lane, the
+        # globally-re-anchored (ver, fc) of its latest COMMITTED write
+        # per dense slot; a local read of that slot must observe a row
+        # timestamp >= the fence or it falls back to the round path
+        # (which stalls until the key revalidates).  Entries prune on
+        # first satisfaction — the table's row ts only ever grows, so a
+        # once-satisfied fence stays satisfied.
+        self._reader = None
+        self._ryw: Dict[Tuple[int, int], Dict[int, Tuple[int, int]]] = {}
+        self.local_reads = 0
+        self.fallback_reads = 0
+        self.ryw_fallbacks = 0
 
     # -- client ops ----------------------------------------------------------
 
@@ -630,9 +717,14 @@ class KVS:
             self._slot_inject[rows, cols] = -1
             self._dirty = True
 
-    def _resolve(self, done_mask, code, rval, wval, round_idx: int) -> int:
+    def _resolve(self, done_mask, code, rval, wval, round_idx: int,
+                 ver=None, fc=None) -> int:
         """Resolve the futures of one round's completed slots (the slots
-        were already retired by _retire).  Returns the op count."""
+        were already retired by _retire).  Returns the op count.
+        ``ver``/``fc`` (when the caller fetched them) feed the round-16
+        read-your-writes fence: a per-op committed update pins its
+        re-anchored timestamp so the session's later local reads must
+        observe it or fall back to the round path."""
         ndone = 0
         # batch-owned slots: results land in the BatchFutures columns with
         # three fancy-index stores, then the slots retire vectorized
@@ -650,6 +742,9 @@ class KVS:
                 bf.value[gi] = rval[rr, cc, 2:]
                 bf.uid[gi] = wval[rr, cc, :2]
                 bf.step[gi] = round_idx
+                if ver is not None:
+                    bf.tsv[gi] = ver[rr, cc]
+                    bf.tsf[gi] = fc[rr, cc]
                 if b["cursor"] >= b["opc"].shape[0] and bf.all_done():
                     del self._bat[bid]
             self._slot_bid[rows, cols] = -1
@@ -675,6 +770,13 @@ class KVS:
                 done.value = rval[r, s, 2:].tolist()
             if c in (t.C_WRITE, t.C_RMW):
                 done.uid = (int(wval[r, s, 0]), int(wval[r, s, 1]))
+                if ver is not None:
+                    done.ts = (int(ver[r, s]), int(fc[r, s]))
+                    # RYW fence (round-16): this lane's later local reads
+                    # of the slot must observe ts >= this committed write
+                    slot = (client_key if self.index is None
+                            else self.index.slot(client_key, insert=False))
+                    self._ryw.setdefault((r, s), {})[int(slot)] = done.ts
             fut._result = done
             if self._queues.get((r, s)):
                 self._ready.add((r, s))
@@ -866,7 +968,8 @@ class KVS:
         done_mask = self._done_mask(code, np.asarray(comp.key))
         self._retire(done_mask)
         n = self._resolve(done_mask, code, np.asarray(comp.rval),
-                          np.asarray(comp.wval), self.rt.step_idx - 1)
+                          np.asarray(comp.wval), self.rt.step_idx - 1,
+                          ver=np.asarray(comp.ver), fc=np.asarray(comp.fc))
         self._watchdog()
         return n
 
@@ -913,7 +1016,9 @@ class KVS:
         self._pending = None
         comp_np = self.rt.harvest_comp(pcomp, round_idx=pk)
         return self._resolve(done_mask, code, np.asarray(comp_np.rval),
-                             np.asarray(comp_np.wval), pk)
+                             np.asarray(comp_np.wval), pk,
+                             ver=np.asarray(comp_np.ver),
+                             fc=np.asarray(comp_np.fc))
 
     def run_until(self, futures: Sequence[Future], max_steps: int = 10_000) -> bool:
         """Step until every future resolves (or the step budget runs out)."""
@@ -923,6 +1028,238 @@ class KVS:
             self.step()
         self.flush()  # pipelined: the last round's resolution may be deferred
         return all(f.done() for f in futures)
+
+    # -- local-read fast path (round-16, core/readpath.py) -------------------
+
+    def _get_reader(self):
+        if self._reader is None:
+            from hermes_tpu.core.readpath import LocalReader
+
+            self._reader = LocalReader(self.rt)
+        return self._reader
+
+    def _record_local_reads(self, slots: np.ndarray, vals: np.ndarray) -> None:
+        """Feed locally-served reads into the recorded history (both
+        recorder kinds) so the fast path is linearizability-CHECKED, not
+        assumed: each read linearizes at the upcoming round's read point
+        (inv = resp = 2 * step in the doubled clock — after the last
+        harvested round's commits, before the next round's)."""
+        rec = self.rt.recorder
+        if rec is None or slots.size == 0:
+            return
+        from hermes_tpu.core import state as st
+
+        n = slots.shape[0]
+        step = np.full((1, n), self.rt.step_idx, np.int32)
+        rec.record_step(st.Completions(
+            code=np.full((1, n), t.C_READ, np.int32),
+            key=slots.reshape(1, n).astype(np.int32),
+            wval=np.zeros((1, n, self.cfg.value_words), np.int32),
+            rval=vals.reshape(1, n, -1).astype(np.int32),
+            ver=np.zeros((1, n), np.int32),
+            fc=np.zeros((1, n), np.int32),
+            invoke_step=step,
+            commit_step=step,
+        ))
+
+    def _ryw_unserved(self, session, slots: np.ndarray, serve: np.ndarray,
+                      pts: np.ndarray) -> None:
+        """Clear ``serve`` bits whose row timestamp has not yet caught up
+        with the session's own committed writes (the read-your-writes
+        fence): the fallback round-path read stalls until the key
+        revalidates at >= the fence ts, so the session can never observe
+        a value older than a write it saw commit.  Satisfied entries
+        prune — the row ts only grows.  ``session`` is any hashable
+        token: the per-op write path pins fences under its (replica,
+        session) lane automatically; batch-path / serving callers pin
+        under their own token via ``pin_read_fence``."""
+        fence = self._ryw.get(session) if session is not None else None
+        if not fence:
+            return
+        from hermes_tpu.core import faststep as fst
+
+        base = self._ver_base_of(slots)
+        for j in np.nonzero(serve)[0]:
+            slot = int(slots[j])
+            want = fence.get(slot)
+            if want is None:
+                continue
+            row = (int(pts[j]) >> fst.PTS_FC_BITS) + int(base[j]), \
+                int(pts[j]) & fst.FC_MASK
+            if row < want:
+                serve[j] = False
+                self.ryw_fallbacks += 1
+            else:
+                del fence[slot]
+
+    def _ver_base_of(self, slots: np.ndarray) -> np.ndarray:
+        """Per-slot rebase delta re-anchoring device-era row timestamps
+        into the recorder's global version space (FastRuntime._ver_base;
+        zero before the first rebase)."""
+        vb = getattr(self.rt, "_ver_base", None)
+        if vb is None:
+            return np.zeros(slots.shape[0], np.int64)
+        return vb[np.asarray(slots)]
+
+    def _serve_reads(self, res: MultiGetResult, slots: np.ndarray,
+                     pend: np.ndarray, session, ans) -> None:
+        """Shared tail of multi_get/scan: fill locally-answerable rows of
+        ``res`` from a ReadAnswer, route the rest through the round path
+        as a fallback read batch."""
+        pi = np.nonzero(pend)[0]
+        if pi.size == 0:
+            return
+        serve = np.zeros(pi.size, bool)
+        if ans is not None:
+            serve = np.asarray(ans.valid).copy()
+            self._ryw_unserved(session, slots[pi], serve,
+                               np.asarray(ans.pts))
+            si = pi[serve]
+            if si.size:
+                vals = np.asarray(ans.val)[serve]
+                res.code[si] = t.C_READ
+                res.value[si] = vals[:, 2:]
+                res.local[si] = True
+                res.step[si] = self.rt.step_idx
+                self.local_reads += int(si.size)
+                self._record_local_reads(slots[si], vals)
+        fb = pi[~serve]
+        if fb.size:
+            # Invalid at the serving replica (a write is in flight), RYW
+            # fence unsatisfied, or no healthy replica: the round path
+            # serves these — its read stalls until the key is Valid,
+            # exactly the reference's read-stall rule, so no stale bytes
+            # can ever take this exit either
+            self.fallback_reads += int(fb.size)
+            bf = self.submit_batch(
+                np.full(fb.size, t.OP_READ, np.int32),
+                np.asarray(res.key)[fb])
+            res._fallback = (bf, fb)
+
+    def multi_get(self, keys, session: Optional[Tuple[int, int]] = None,
+                  wait: bool = True, max_steps: int = 50_000
+                  ) -> MultiGetResult:
+        """Batched device-resident read (round-16): ONE jitted dispatch
+        answers every Valid key of ``keys`` straight from the resident
+        table — zero wire traffic, zero round involvement (Hermes' local
+        read, PAPER.md).  Keys the fast path must not answer (Invalid —
+        a write is in flight; read-your-writes fence unsatisfied for
+        ``session``; fenced/migrating ranges; no healthy replica) fall
+        back to the normal round path instead of returning stale bytes.
+        ``session`` is the calling (replica, session) lane — its own
+        committed writes fence its reads.  With ``wait`` (default) the
+        fallback batch is driven to completion before returning."""
+        # sparse client keys are unsigned 64-bit: coerce EXPLICITLY — a
+        # bare asarray of a >int64 python int silently promotes the whole
+        # batch to float64 and shears the low bits off every key
+        keys_arr = np.atleast_1d(
+            np.asarray(keys, np.uint64) if self.index is not None
+            else np.asarray(keys))
+        n = keys_arr.shape[0]
+        u = self.cfg.value_words - 2
+        res = MultiGetResult(keys_arr.copy(), u)
+        if n == 0:
+            return res
+        if self.index is not None:
+            slots = self.index.get_slots(keys_arr, insert=False)
+            miss = slots < 0
+            if miss.any():
+                # absent sparse keys: answered not-found immediately, no
+                # dense slot claimed (the get() rule)
+                res.code[miss] = t.C_READ
+                res.found[miss] = False
+                res.step[miss] = self.rt.step_idx
+                slots = np.where(miss, 0, slots)
+        else:
+            kmin = int(keys_arr.min())
+            kmax = int(keys_arr.max())
+            if not (0 <= kmin and kmax < self.cfg.n_keys):
+                raise ValueError(f"keys out of range [0, {self.cfg.n_keys})")
+            slots = keys_arr.astype(np.int32)
+        pend = res.code == 0
+        if self._fence_mask.any():
+            fenced = pend & self._fence_mask[slots]
+            if fenced.any():
+                res.code[fenced] = C_REJECTED
+                res.found[fenced] = False
+                self.rejected_ops += int(fenced.sum())
+                pend &= ~fenced
+        if pend.any():
+            # the ReadAnswer is aligned with the pending subset — exactly
+            # the order _serve_reads consumes
+            ans = self._get_reader().multi_get(slots[np.nonzero(pend)[0]])
+            self._serve_reads(res, slots, pend, session, ans)
+        if wait and res._fallback is not None:
+            self.run_batch(res._fallback[0], max_steps=max_steps)
+            res._pull()
+        return res
+
+    def scan(self, lo: int, hi: int,
+             session: Optional[Tuple[int, int]] = None, wait: bool = True,
+             max_steps: int = 50_000) -> MultiGetResult:
+        """Range scan over dense slots ``[lo, hi)`` via the zero-sparse-op
+        contiguous read program (one dynamic_slice — core/readpath.py).
+        Dense mode echoes slot ids as keys; sparse mode clamps to the
+        allocated frontier and echoes the CLIENT key of each slot
+        (slots allocate in first-write order, so a sparse scan is a
+        write-order scan).  Same Valid/RYW/fence fallback rules as
+        ``multi_get``."""
+        if not (0 <= lo < hi <= self.cfg.n_keys):
+            raise ValueError(
+                f"scan range [{lo}, {hi}) outside [0, {self.cfg.n_keys})")
+        u = self.cfg.value_words - 2
+        if self.index is not None:
+            hi = min(hi, self.index.n_used)
+            if lo >= hi:
+                return MultiGetResult(np.zeros(0, np.uint64), u)
+            keys_arr = self.index._rev[lo:hi].copy()
+        else:
+            keys_arr = np.arange(lo, hi, dtype=np.int64)
+        slots = np.arange(lo, hi, dtype=np.int32)
+        res = MultiGetResult(keys_arr, u)
+        pend = np.ones(hi - lo, bool)
+        if self._fence_mask.any():
+            fenced = self._fence_mask[lo:hi]
+            if fenced.any():
+                res.code[fenced] = C_REJECTED
+                res.found[fenced] = False
+                self.rejected_ops += int(fenced.sum())
+                pend &= ~fenced
+        ans = self._get_reader().scan(lo, hi)
+        if ans is not None and not pend.all():
+            pi = np.nonzero(pend)[0]  # align with the pending subset
+            ans = type(ans)(valid=np.asarray(ans.valid)[pi],
+                            val=np.asarray(ans.val)[pi],
+                            pts=np.asarray(ans.pts)[pi])
+        self._serve_reads(res, slots, pend, session, ans)
+        if wait and res._fallback is not None:
+            self.run_batch(res._fallback[0], max_steps=max_steps)
+            res._pull()
+        return res
+
+    def pin_read_fence(self, session, client_key: int,
+                       ts: Tuple[int, int]) -> None:
+        """Pin a read-your-writes fence under an arbitrary session token
+        (round-16): the caller observed a commit with protocol timestamp
+        ``ts`` (Completion.ts / BatchFutures.tsv+tsf) and wants every
+        later ``multi_get(..., session=token)`` on the key to observe it
+        or fall back to the round path.  The per-op future path pins its
+        (replica, session) lane automatically; this is the hook for
+        batch writers and the serving front-end's per-tenant fencing."""
+        slot = (int(client_key) if self.index is None
+                else self.index.slot(int(client_key), insert=False))
+        if slot < 0:
+            return  # absent sparse key: nothing committed to fence on
+        self._ryw.setdefault(session, {})[slot] = (int(ts[0]), int(ts[1]))
+
+    def read_stats(self) -> dict:
+        """Fast-path accounting: locally-served vs round-path fallback
+        reads, RYW fence misses, and read dispatches issued."""
+        rd = self._reader
+        return dict(local_reads=self.local_reads,
+                    fallback_reads=self.fallback_reads,
+                    ryw_fallbacks=self.ryw_fallbacks,
+                    read_dispatches=0 if rd is None else rd.dispatches)
 
     # -- elastic operations (round-10, hermes_tpu/elastic) -------------------
 

@@ -42,18 +42,18 @@ class LoopbackServer:
         self.wire_tx = 0
         self._out: List[bytes] = []
 
-    def _roundtrip_req(self, req: wire.Request) -> wire.Request:
+    def _roundtrip_req(self, req):
         from hermes_tpu.transport import codec
 
         raw = codec.frame_unpack(codec.frame_pack(np.frombuffer(
-            wire.encode_request(req, self.u), np.uint8))).tobytes()
+            wire.encode_any_request(req, self.u), np.uint8))).tobytes()
         self.wire_rx += len(raw) + codec.FRAME_OVERHEAD
-        return wire.decode_request(raw, self.u)
+        return wire.decode_any_request(raw, self.u)
 
-    def submit(self, req: wire.Request) -> Optional[wire.Response]:
-        """One client request through the wire codec + admission.
-        Immediate refusals come back decoded; admitted ops resolve via
-        ``pump``."""
+    def submit(self, req) -> Optional[object]:
+        """One client request (single-op Request or round-16 batched
+        ReadRequest) through the wire codec + admission.  Immediate
+        refusals come back decoded; admitted ops resolve via ``pump``."""
         rsp = self.fe.submit(self._roundtrip_req(req))
         if rsp is None:
             return None
@@ -70,13 +70,13 @@ class LoopbackServer:
         self._encode_out(self.fe.pop_responses())
         return ok
 
-    def _encode_out(self, rsps) -> List[wire.Response]:
+    def _encode_out(self, rsps) -> List[object]:
         out = []
         for rsp in rsps:
-            raw = wire.encode_response(rsp, self.u)
+            raw = wire.encode_any_response(rsp, self.u)
             self.wire_tx += len(raw)
             self._out.append(raw)
-            out.append(wire.decode_response(raw, self.u))
+            out.append(wire.decode_any_response(raw, self.u))
         return out
 
     def response_log(self) -> bytes:
@@ -142,9 +142,11 @@ class TcpRpcServer:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                             _struct.pack("ll", 1, 0))
             # CRC failures on implausible frame lengths tear the stream
-            # down instead of desyncing it: requests are fixed-size
+            # down instead of desyncing it; plausible = the fixed
+            # single-op size OR a round-16 variable read-request size
+            # (a corrupted-but-plausible frame is skipped + counted)
             fsock = self._FramedSocket(
-                sock, expect_lens={wire.req_nbytes(self.u)})
+                sock, expect_lens=wire.plausible_request_len(self.u))
             self._conns.append(fsock)
             t = threading.Thread(target=self._reader_loop, args=(fsock,),
                                  daemon=True)
@@ -189,7 +191,7 @@ class TcpRpcServer:
             reqs = []
             for raw in raws:
                 try:
-                    reqs.append(wire.decode_request(raw, self.u))
+                    reqs.append(wire.decode_any_request(raw, self.u))
                 except ValueError:
                     # frame-valid but undecodable (payload-width/magic
                     # mismatch): refuse LOUDLY when the header still
@@ -235,9 +237,9 @@ class TcpRpcServer:
         rsp.req_id = client_rid
         return fsock, rsp
 
-    def _send_out(self, fsock, rsp: wire.Response) -> None:
+    def _send_out(self, fsock, rsp) -> None:
         try:
-            fsock.send(wire.encode_response(rsp, self.u))
+            fsock.send(wire.encode_any_response(rsp, self.u))
         except OSError:
             # send timed out or failed mid-frame: the stream boundary is
             # gone, so the connection is unusable — tear it down
@@ -299,8 +301,8 @@ class RpcClient:
 
         sock = socket.create_connection(addr, timeout=timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.fsock = FramedSocket(sock,
-                                  expect_lens={wire.rsp_nbytes(u)})
+        self.fsock = FramedSocket(
+            sock, expect_lens=wire.plausible_response_len(u))
         self.u = u
         self._next_id = 1
 
@@ -308,19 +310,43 @@ class RpcClient:
         rid, self._next_id = self._next_id, self._next_id + 1
         return rid
 
-    def send(self, req: wire.Request) -> None:
-        self.fsock.send(wire.encode_request(req, self.u))
+    def send(self, req) -> None:
+        self.fsock.send(wire.encode_any_request(req, self.u))
 
-    def recv_next(self) -> Optional[wire.Response]:
+    def recv_next(self) -> Optional[object]:
         raw = self.fsock.recv()
         if raw is None:
             return None
-        return wire.decode_response(raw, self.u)
+        return wire.decode_any_response(raw, self.u)
 
     def call(self, kind: str, key: int, value=None, tenant: int = 0,
              deadline_us: int = 0) -> wire.Response:
         req = wire.Request(kind=kind, req_id=self.next_id(), tenant=tenant,
                            key=key, deadline_us=deadline_us, value=value)
+        self.send(req)
+        rsp = self.recv_next()
+        if rsp is None:
+            raise ConnectionError("server closed mid-call")
+        return rsp
+
+    def call_mget(self, keys, tenant: int = 0,
+                  deadline_us: int = 0) -> wire.ReadResponse:
+        """One batched K_MGET round trip (round-16)."""
+        req = wire.ReadRequest(kind="mget", req_id=self.next_id(),
+                               tenant=tenant, keys=list(keys),
+                               deadline_us=deadline_us)
+        self.send(req)
+        rsp = self.recv_next()
+        if rsp is None:
+            raise ConnectionError("server closed mid-call")
+        return rsp
+
+    def call_scan(self, lo: int, hi: int, tenant: int = 0,
+                  deadline_us: int = 0) -> wire.ReadResponse:
+        """One K_SCAN round trip over keys [lo, hi)."""
+        req = wire.ReadRequest(kind="scan", req_id=self.next_id(),
+                               tenant=tenant, lo=lo, hi=hi,
+                               deadline_us=deadline_us)
         self.send(req)
         rsp = self.recv_next()
         if rsp is None:

@@ -95,6 +95,18 @@ class VirtualClock:
         self.t += ds
 
 
+class _ReadFuture:
+    """Future-shaped adapter over a MultiGetResult/FleetReads: done when
+    every key answered (locally or via the round-path fallback the pump's
+    store.step() drives)."""
+
+    def __init__(self, res):
+        self.res = res
+
+    def done(self) -> bool:
+        return self.res.all_done()
+
+
 class Frontend:
     """One serving front-end over a KVS or Fleet facade."""
 
@@ -221,10 +233,13 @@ class Frontend:
 
     # -- intake --------------------------------------------------------------
 
-    def submit(self, req: wire.Request) -> Optional[wire.Response]:
+    def submit(self, req) -> Optional[object]:
         """Run one request through admission.  Returns an immediate
         refusal Response, or None when admitted (the resolution arrives
-        from a later ``pump``)."""
+        from a later ``pump``).  Accepts the single-op ``wire.Request``
+        and the round-16 batched ``wire.ReadRequest`` (K_MGET/K_SCAN)."""
+        if isinstance(req, wire.ReadRequest):
+            return self._submit_read(req)
         now = self.clock()
         self.requests += 1
         if req.kind not in ("get", "put", "rmw") \
@@ -249,6 +264,64 @@ class Frontend:
             deadline=(now + dl_us * 1e-6) if dl_us else None))
         return None
 
+    def _read_probe_key(self, req: wire.ReadRequest) -> int:
+        """The key the admission ladder judges a batched read by: its
+        first NON-hot key, so rung 2 sheds the batch unless EVERY key is
+        hot — reads shed at rung 2 exactly as today, and a batch cannot
+        smuggle cold keys past the ladder behind one hot one.  A scan
+        range wider than the hot set provably CONTAINS a cold key
+        (len(hot)+1 distinct keys cannot all be hot), so probing that
+        many from lo always finds one — never judge a range by its
+        endpoints, which may both be hot over a cold interior."""
+        hot = self.scfg.hot_key_set
+        if req.kind == "mget":
+            keys = req.keys
+        else:
+            keys = range(req.lo, min(req.hi, req.lo + len(hot) + 1))
+        for k in keys:
+            if k not in hot:
+                return int(k)
+        return int(next(iter(keys)))
+
+    def _submit_read(self, req: wire.ReadRequest):
+        """Admission for one batched read RPC (ONE admission unit: one
+        quota slot, one queue entry, one rate token — the batch is one
+        client-visible op)."""
+        now = self.clock()
+        self.requests += 1
+        bad = (req.kind not in ("mget", "scan")
+               or (req.kind == "mget" and not (
+                   req.keys and len(req.keys) <= wire.MGET_MAX_KEYS
+                   and all(0 <= k < self.n_keys for k in req.keys)))
+               or (req.kind == "scan"
+                   and not (0 <= req.lo < req.hi <= self.n_keys)))
+        if bad:
+            return self._respond(wire.ReadResponse(
+                status=wire.S_REJECTED, req_id=req.req_id), req.tenant,
+                queue=False)
+        self._update_level(None, fresh=False)
+        # degraded mode never sheds reads (rung 1 is write-only), so the
+        # ladder decision for a read depends on queue pressure alone —
+        # and the probe key only matters at rung 2, so the O(batch) cold
+        # hunt is skipped entirely while the queue is below that mark
+        probe = (self._read_probe_key(req)
+                 if self.adm.ladder_level(len(self._intake), False) >= 2
+                 else (req.keys[0] if req.kind == "mget" else req.lo))
+        reason, wait = self.adm.admit(
+            "get", probe, req.tenant, now, len(self._intake), False)
+        if reason != wire.R_NONE:
+            self._count("retry_after")
+            return self._respond(wire.ReadResponse(
+                status=wire.S_RETRY_AFTER, req_id=req.req_id, reason=reason,
+                retry_after_us=int(math.ceil(wait * 1e6))), req.tenant,
+                queue=False)
+        self.adm.note_admitted(req.tenant)
+        dl_us = req.deadline_us or self.scfg.default_deadline_us
+        self._intake.append(dict(
+            req=req, t_admit=now,
+            deadline=(now + dl_us * 1e-6) if dl_us else None))
+        return None
+
     # -- the pump ------------------------------------------------------------
 
     def _issue(self, entry: dict) -> None:
@@ -256,6 +329,23 @@ class Frontend:
         req = entry["req"]
         seq = self._lane_seq[req.tenant]
         self._lane_seq[req.tenant] = seq + 1
+        if isinstance(req, wire.ReadRequest):
+            # batched read (round-16): issued straight to the store's
+            # local-read fast path; only Invalid keys ride round-path
+            # fallback slots, which the pump's store.step() drives.
+            # Read-your-writes is TENANT-scoped here: the frontend pins a
+            # per-tenant fence token on every commit it delivers
+            # (_result_response -> store.pin_read_fence), and the read
+            # carries the same token — lane rotation on the write path
+            # cannot defeat it.
+            args = dict(session=("tenant", req.tenant), wait=False)
+            res = (self.store.multi_get(req.keys, **args)
+                   if req.kind == "mget"
+                   else self.store.scan(req.lo, req.hi, **args))
+            entry["fut"] = _ReadFuture(res)
+            self._pending[req.req_id] = entry
+            self._store_inflight += 1
+            return
         value = req.value if req.kind != "get" else None
         if self.is_fleet:
             session = req.tenant * 7919 + seq
@@ -275,8 +365,33 @@ class Frontend:
                "rmw_abort": wire.S_RMW_ABORT, "lost": wire.S_LOST,
                "rejected": wire.S_REJECTED}
 
-    def _result_response(self, entry: dict) -> wire.Response:
+    def _deadline_rsp(self, req):
+        """The S_DEADLINE refusal in the request's own response layout."""
+        if isinstance(req, wire.ReadRequest):
+            return wire.ReadResponse(status=wire.S_DEADLINE,
+                                     req_id=req.req_id)
+        return wire.Response(status=wire.S_DEADLINE, req_id=req.req_id,
+                             found=False)
+
+    def _result_response(self, entry: dict):
         req = entry["req"]
+        if isinstance(req, wire.ReadRequest):
+            import numpy as np
+
+            from hermes_tpu.kvs import C_REJECTED
+            from hermes_tpu.core import types as t
+
+            res = entry["fut"].res
+            res._pull()
+            served = res.code == t.C_READ
+            return wire.ReadResponse(
+                status=wire.S_OK, req_id=req.req_id,
+                step=int(res.step.max()) if len(res) else -1,
+                found=(np.asarray(res.found) & served).tolist(),
+                local=np.asarray(res.local).tolist(),
+                codes=np.where(res.code == C_REJECTED, wire.RK_REJECTED,
+                               wire.RK_OK).tolist(),
+                values=np.asarray(res.value).tolist())
         c = entry["fut"].result()
         rsp = wire.Response(status=self._STATUS[c.kind], req_id=req.req_id,
                             found=c.found, step=c.step)
@@ -284,6 +399,13 @@ class Frontend:
             rsp.value = c.value
         if c.uid is not None:
             rsp.uid = c.uid
+            if c.ts is not None:
+                # the tenant just SAW this write commit: pin its fence
+                # token so the tenant's later K_MGET/K_SCAN reads must
+                # observe this timestamp or take the round path (RYW
+                # through the serving front-end, per tenant)
+                self.store.pin_read_fence(("tenant", req.tenant),
+                                          req.key, c.ts)
         return rsp
 
     def pump(self) -> List[wire.Response]:
@@ -301,9 +423,8 @@ class Frontend:
                 if entry["deadline"] is not None and now > entry["deadline"]:
                     self.adm.note_resolved(req.tenant, wire.S_DEADLINE)
                     self._count("deadline")
-                    self._respond(wire.Response(
-                        status=wire.S_DEADLINE, req_id=req.req_id,
-                        found=False), req.tenant, now - entry["t_admit"])
+                    self._respond(self._deadline_rsp(req), req.tenant,
+                                  now - entry["t_admit"])
                 else:
                     keep.append(entry)
             self._intake = keep
@@ -319,8 +440,7 @@ class Frontend:
             late = (entry["deadline"] is not None
                     and now > entry["deadline"])
             if fut.done():
-                rsp = (wire.Response(status=wire.S_DEADLINE, req_id=rid,
-                                     found=False) if late
+                rsp = (self._deadline_rsp(entry["req"]) if late
                        else self._result_response(entry))
                 if late:
                     self._count("deadline")
@@ -334,9 +454,8 @@ class Frontend:
                 # the protocol finishes it (quota freed, lane not yet)
                 self.adm.note_resolved(entry["req"].tenant, wire.S_DEADLINE)
                 self._count("deadline")
-                self._respond(wire.Response(
-                    status=wire.S_DEADLINE, req_id=rid, found=False),
-                    entry["req"].tenant, now - entry["t_admit"])
+                self._respond(self._deadline_rsp(entry["req"]),
+                              entry["req"].tenant, now - entry["t_admit"])
                 self._abandoned.append(entry)
                 done_ids.append(rid)
         for rid in done_ids:

@@ -177,13 +177,24 @@ def _sha(b: bytes) -> str:
 
 def committed_uids(fe: Frontend, lb: LoopbackServer) -> List[tuple]:
     """Write uids the CLIENT saw commit (S_OK puts/rmws) — the
-    ``committed_write_lost`` witness set."""
+    ``committed_write_lost`` witness set.  The byte log interleaves
+    fixed-size single-op responses with variable-size round-16 read
+    responses; each record's extent comes from its magic + count."""
+    import struct
+
     out = []
     u = lb.u
     off = 0
     raw = lb.response_log()
     step = wire.rsp_nbytes(u)
-    while off + step <= len(raw):
+    while off + 2 <= len(raw):
+        (magic,) = struct.unpack_from("<H", raw, off)
+        if magic == wire.RRSP_MAGIC:
+            # batched read response: reads never mint uids — skip it by
+            # its count-derived extent
+            (count,) = struct.unpack_from("<H", raw, off + 8)
+            off += wire.rrsp_nbytes(u, count)
+            continue
         rsp = wire.decode_response(raw[off: off + step], u)
         off += step
         if rsp.status == wire.S_OK and rsp.uid is not None:

@@ -40,8 +40,15 @@ import numpy as np
 
 # -- op kinds (wire) ---------------------------------------------------------
 K_GET, K_PUT, K_RMW = 1, 2, 3
+# round-16 batched read verbs: K_MGET carries a count-prefixed key
+# vector, K_SCAN a [lo, hi) fleet/dense key range — both answered by the
+# store's device-resident local-read fast path (kvs.KVS.multi_get /
+# Fleet.multi_get), falling back to the round path per Invalid key
+K_MGET, K_SCAN = 4, 5
 _KIND_NAMES = {K_GET: "get", K_PUT: "put", K_RMW: "rmw"}
 _KIND_CODES = {v: k for k, v in _KIND_NAMES.items()}
+_READ_KIND_NAMES = {K_MGET: "mget", K_SCAN: "scan"}
+_READ_KIND_CODES = {v: k for k, v in _READ_KIND_NAMES.items()}
 
 # -- response statuses -------------------------------------------------------
 S_OK = 0           # op completed (kind's normal completion)
@@ -138,10 +145,11 @@ def peek_req_id(buf: bytes) -> Optional[int]:
     server refuse the request loudly instead of leaving the client to
     time out.  None when even the header is unusable."""
     buf = bytes(buf)
-    if len(buf) < _REQ.size:
+    if len(buf) < _RREQ.size:
         return None
-    magic, _k, _p, req_id, *_rest = _REQ.unpack(buf[: _REQ.size])
-    return req_id if magic == REQ_MAGIC else None
+    magic, _k, _p, req_id = struct.unpack_from("<HBBI", buf, 0)
+    # both request layouts put req_id at the same offset behind their magic
+    return req_id if magic in (REQ_MAGIC, RREQ_MAGIC) else None
 
 
 def decode_request(buf: bytes, u: int) -> Request:
@@ -186,3 +194,229 @@ def decode_response(buf: bytes, u: int) -> Response:
                     found=bool(found), step=step, retry_after_us=retry,
                     uid=(hi, lo) if has_uid else None,
                     value=value if status == S_OK else None)
+
+
+# -- round-16 batched-read structs (K_MGET / K_SCAN) -------------------------
+#
+# Variable-size messages: the CRC frame already carries the byte length
+# (stream boundary), so a count prefix inside the struct is enough for
+# both ends to agree on the vector extent — the payload rows keep the
+# config-width discipline (u int32 words each, derived from the shared
+# config like every other message).  Distinct magics keep the decoders
+# honest: a read response can never be mis-decoded as a single-op one.
+
+RREQ_MAGIC = 0x5255   # 'UR' — batched-read request
+RRSP_MAGIC = 0x5254   # 'TR' — batched-read response
+MGET_MAX_KEYS = 65_535  # count rides a u16
+
+# magic u16 | kind u8 | pad u8 | req_id u32 | tenant u16 | count u16 |
+# deadline_us u32   ...then count*i64 keys (mget) or lo i64, hi i64 (scan)
+_RREQ = struct.Struct("<HBBIHHI")
+# magic u16 | status u8 | reason u8 | req_id u32 | count u16 | pad u16 |
+# step i32 | retry_after_us u32   ...then count rows of
+# [found u8 | local u8 | code u8 | pad u8 | u*i32 payload]
+_RRSP = struct.Struct("<HBBIHHiI")
+
+# per-key row status codes in a read response
+RK_OK = 0        # served (found flag says whether the key ever existed)
+RK_REJECTED = 2  # draining/fenced range: definitively not served here
+
+
+@dataclasses.dataclass
+class ReadRequest:
+    """One batched read RPC: ``mget`` over an explicit key vector or
+    ``scan`` over the key range [lo, hi).  One admission unit — the
+    ladder treats it as a read (rung 2 sheds it unless EVERY key is in
+    the hot set; a range never is)."""
+
+    kind: str                 # 'mget' | 'scan'
+    req_id: int
+    tenant: int
+    keys: Optional[List[int]] = None  # mget
+    lo: int = 0                       # scan
+    hi: int = 0
+    deadline_us: int = 0
+
+    @property
+    def count(self) -> int:
+        return len(self.keys) if self.kind == "mget" else self.hi - self.lo
+
+
+@dataclasses.dataclass
+class ReadResponse:
+    """Answer to one ReadRequest: per-key rows in request key order.
+    Refusals (S_RETRY_AFTER / S_DEADLINE / S_REJECTED) carry count 0."""
+
+    status: int
+    req_id: int
+    reason: int = R_NONE
+    step: int = -1
+    retry_after_us: int = 0
+    found: Optional[List[bool]] = None
+    local: Optional[List[bool]] = None   # served by the fast path
+    codes: Optional[List[int]] = None    # RK_* per key
+    values: Optional[List[List[int]]] = None
+
+    @property
+    def status_name(self) -> str:
+        return STATUS_NAMES[self.status]
+
+    @property
+    def reason_name(self) -> str:
+        return REASON_NAMES[self.reason]
+
+
+def rreq_nbytes(kind: str, count: int) -> int:
+    return _RREQ.size + (8 * count if kind == "mget" else 16)
+
+
+def rrsp_nbytes(u: int, count: int) -> int:
+    return _RRSP.size + count * (4 + 4 * u)
+
+
+def encode_read_request(req: ReadRequest) -> bytes:
+    if req.kind not in _READ_KIND_CODES:
+        raise ValueError(f"unknown read kind {req.kind!r}")
+    if not (0 <= req.deadline_us < 1 << 32):
+        raise ValueError("deadline_us must fit u32 (relative microseconds)")
+    if req.kind == "mget":
+        keys = list(req.keys or ())
+        if not (1 <= len(keys) <= MGET_MAX_KEYS):
+            raise ValueError(
+                f"mget wants 1..{MGET_MAX_KEYS} keys, got {len(keys)}")
+        body = np.asarray(keys, np.int64).tobytes()
+        count = len(keys)
+    else:
+        body = np.asarray([req.lo, req.hi], np.int64).tobytes()
+        count = 0  # the range rides the body; count is mget-only
+    return _RREQ.pack(RREQ_MAGIC, _READ_KIND_CODES[req.kind], 0, req.req_id,
+                      req.tenant, count, req.deadline_us) + body
+
+
+def decode_read_request(buf: bytes) -> ReadRequest:
+    buf = bytes(buf)
+    if len(buf) < _RREQ.size:
+        raise ValueError(f"read request too short ({len(buf)} bytes)")
+    magic, kind, _p, req_id, tenant, count, dl = _RREQ.unpack(
+        buf[: _RREQ.size])
+    if magic != RREQ_MAGIC:
+        raise ValueError(f"bad read-request magic 0x{magic:04x}")
+    if kind not in _READ_KIND_NAMES:
+        raise ValueError(f"unknown wire read kind {kind}")
+    name = _READ_KIND_NAMES[kind]
+    if len(buf) != rreq_nbytes(name, count):
+        raise ValueError(
+            f"read request size {len(buf)} != {rreq_nbytes(name, count)}")
+    body = np.frombuffer(buf[_RREQ.size:], np.int64)
+    if name == "mget":
+        return ReadRequest(kind="mget", req_id=req_id, tenant=tenant,
+                           keys=body.tolist(), deadline_us=dl)
+    return ReadRequest(kind="scan", req_id=req_id, tenant=tenant,
+                       lo=int(body[0]), hi=int(body[1]), deadline_us=dl)
+
+
+def encode_read_response(rsp: ReadResponse, u: int) -> bytes:
+    n = len(rsp.found or ())
+    head = _RRSP.pack(RRSP_MAGIC, rsp.status, rsp.reason, rsp.req_id, n, 0,
+                      rsp.step, rsp.retry_after_us)
+    if n == 0:
+        return head
+    rows = np.zeros((n, 4 + 4 * u), np.uint8)
+    rows[:, 0] = np.asarray(rsp.found, np.uint8)
+    rows[:, 1] = np.asarray(rsp.local or [0] * n, np.uint8)
+    rows[:, 2] = np.asarray(rsp.codes or [RK_OK] * n, np.uint8)
+    vals = np.zeros((n, u), np.int32)
+    if rsp.values is not None:
+        vals[:] = np.asarray(rsp.values, np.int32)
+    rows[:, 4:] = vals.view(np.uint8).reshape(n, 4 * u)
+    return head + rows.tobytes()
+
+
+def decode_read_response(buf: bytes, u: int) -> ReadResponse:
+    buf = bytes(buf)
+    if len(buf) < _RRSP.size:
+        raise ValueError(f"read response too short ({len(buf)} bytes)")
+    magic, status, reason, req_id, n, _p, step, retry = _RRSP.unpack(
+        buf[: _RRSP.size])
+    if magic != RRSP_MAGIC:
+        raise ValueError(f"bad read-response magic 0x{magic:04x}")
+    if len(buf) != rrsp_nbytes(u, n):
+        raise ValueError(
+            f"read response size {len(buf)} != {rrsp_nbytes(u, n)}")
+    out = ReadResponse(status=status, reason=reason, req_id=req_id,
+                       step=step, retry_after_us=retry)
+    if n:
+        rows = np.frombuffer(buf[_RRSP.size:], np.uint8).reshape(n, 4 + 4 * u)
+        out.found = (rows[:, 0] != 0).tolist()
+        out.local = (rows[:, 1] != 0).tolist()
+        out.codes = rows[:, 2].astype(int).tolist()
+        out.values = np.ascontiguousarray(
+            rows[:, 4:]).view(np.int32).reshape(n, u).tolist()
+    return out
+
+
+def plausible_request_len(u: int):
+    """Predicate over frame payload lengths a server may legitimately
+    receive (FramedSocket's corruption-triage hook): the fixed single-op
+    request size, or a read-request size — header + count*i64 keys
+    (mget) / + 2*i64 (scan).  Only consulted when a frame FAILS its CRC,
+    to decide skip-vs-teardown."""
+    fixed = req_nbytes(u)
+
+    def ok(length: int) -> bool:
+        if length == fixed:
+            return True
+        body = length - _RREQ.size
+        return (body >= 8 and body % 8 == 0
+                and body <= 8 * MGET_MAX_KEYS)
+
+    return ok
+
+
+def plausible_response_len(u: int):
+    """Predicate over frame payload lengths a client may legitimately
+    receive: the fixed single-op response size, or a read-response size
+    (header + count rows of 4 + 4u bytes)."""
+    fixed = rsp_nbytes(u)
+    row = 4 + 4 * u
+
+    def ok(length: int) -> bool:
+        if length == fixed or length == _RRSP.size:
+            return True
+        body = length - _RRSP.size
+        return body > 0 and body % row == 0 and body // row <= MGET_MAX_KEYS
+
+    return ok
+
+
+# -- kind/magic dispatch (one decoder entry per direction) -------------------
+
+def encode_any_request(req, u: int) -> bytes:
+    if isinstance(req, ReadRequest):
+        return encode_read_request(req)
+    return encode_request(req, u)
+
+
+def decode_any_request(buf: bytes, u: int):
+    """Decode either request layout off its magic word."""
+    buf = bytes(buf)
+    if len(buf) >= 2:
+        (magic,) = struct.unpack_from("<H", buf, 0)
+        if magic == RREQ_MAGIC:
+            return decode_read_request(buf)
+    return decode_request(buf, u)
+
+
+def encode_any_response(rsp, u: int) -> bytes:
+    if isinstance(rsp, ReadResponse):
+        return encode_read_response(rsp, u)
+    return encode_response(rsp, u)
+
+
+def decode_any_response(buf: bytes, u: int):
+    buf = bytes(buf)
+    if len(buf) >= 2:
+        (magic,) = struct.unpack_from("<H", buf, 0)
+        if magic == RRSP_MAGIC:
+            return decode_read_response(buf, u)
+    return decode_response(buf, u)

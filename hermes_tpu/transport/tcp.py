@@ -52,13 +52,15 @@ class FramedSocket:
     internally serialized, so two threads sharing one FramedSocket can
     never splice frames mid-stream.
 
-    ``expect_lens`` (optional set of plausible payload lengths) is
-    consulted ONLY when a frame fails its CRC: a failing frame whose
-    length field is not a plausible message size most likely had the
-    LENGTH itself corrupted — skipping it would silently misalign the
-    stream cursor — so the stream tears down loudly instead.  Frames
-    with a valid CRC pass through at any length (the server must still
-    see wrong-width-but-intact requests to refuse them decodably)."""
+    ``expect_lens`` (optional: a set of plausible payload lengths, OR a
+    predicate ``len -> bool`` for variable-size protocols like the
+    round-16 K_MGET/K_SCAN frames) is consulted ONLY when a frame fails
+    its CRC: a failing frame whose length field is not a plausible
+    message size most likely had the LENGTH itself corrupted — skipping
+    it would silently misalign the stream cursor — so the stream tears
+    down loudly instead.  Frames with a valid CRC pass through at any
+    length (the server must still see wrong-width-but-intact requests
+    to refuse them decodably)."""
 
     def __init__(self, sock, expect_lens=None):
         from hermes_tpu.transport import codec
@@ -66,8 +68,11 @@ class FramedSocket:
         self._codec = codec
         self.sock = sock
         self.corrupt_dropped = 0
-        self._expect_lens = (None if expect_lens is None
-                             else frozenset(expect_lens))
+        if expect_lens is None or callable(expect_lens):
+            self._plausible = expect_lens
+        else:
+            lens = frozenset(expect_lens)
+            self._plausible = lens.__contains__
         self._send_lock = threading.Lock()
 
     def send(self, payload: bytes) -> None:
@@ -110,8 +115,8 @@ class FramedSocket:
                 payload = codec.frame_unpack(np.frombuffer(
                     hdr + body, np.uint8))
             except codec.FrameCorrupt:
-                if (self._expect_lens is not None
-                        and length not in self._expect_lens):
+                if (self._plausible is not None
+                        and not self._plausible(length)):
                     # the CRC failed AND the length field names no
                     # plausible message: the corruption likely hit the
                     # length itself, so the bytes just consumed straddle
@@ -119,9 +124,8 @@ class FramedSocket:
                     # silently desynchronize the stream
                     raise codec.FrameCorrupt(
                         f"CRC failure on implausible frame length "
-                        f"{length} (expected one of "
-                        f"{sorted(self._expect_lens)}): length field "
-                        f"suspect, stream alignment lost") from None
+                        f"{length}: length field suspect, stream "
+                        f"alignment lost") from None
                 self.corrupt_dropped += 1
                 continue
             return payload.tobytes()

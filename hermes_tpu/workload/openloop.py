@@ -102,7 +102,9 @@ class MixSpec:
     name: str = "uniform"
     read_frac: float = 0.5
     rmw_frac: float = 0.0            # of the update half
-    distribution: str = "uniform"    # uniform | zipfian | hotkey
+    # uniform | zipfian | hotkey | latest (YCSB-D: reads skew to the most
+    # recently WRITTEN keys of this same mix — ycsb.latest_ages)
+    distribution: str = "uniform"
     zipf_theta: float = 0.99
     hot_frac: float = 0.8            # hotkey mode: share of ops on hot set
     hot_keys: int = 4                # hotkey mode: size of the hot set
@@ -131,6 +133,25 @@ def make_mix(spec: MixSpec, n_keys: int, n: int, seed: int,
         key = rng.integers(0, n_keys, size=n, dtype=np.int64)
         key[hot] = rng.integers(0, max(1, spec.hot_keys),
                                 size=int(hot.sum()), dtype=np.int64)
+    elif spec.distribution == "latest":
+        # YCSB-D: reads target the most recently written keys of THIS
+        # mix — a Zipfian(theta)-over-age draw against the running write
+        # log (ycsb.LATEST_WINDOW horizon), clamped to the writes that
+        # exist yet; reads before the first write fall back to uniform.
+        # Pure cursor arithmetic over seeded draws => byte-identical
+        # replays like every other distribution here.
+        from hermes_tpu.workload.ycsb import latest_ages
+
+        key = rng.integers(0, n_keys, size=n, dtype=np.int64)
+        ages = latest_ages(rng, n, spec.zipf_theta)
+        written: list = []
+        for i in range(n):
+            if kind[i] == 0:
+                if written:
+                    key[i] = written[-1 - min(int(ages[i]),
+                                              len(written) - 1)]
+            else:
+                written.append(int(key[i]))
     else:
         raise ValueError(f"unknown distribution {spec.distribution!r}")
     tenant = (np.arange(n, dtype=np.int64) % spec.tenants).astype(np.int32)
@@ -168,14 +189,21 @@ def scenario_seed(repo_root: Optional[str] = None) -> int:
 def scenario_matrix(tenants: int = 4) -> List[MixSpec]:
     """The serving bench/gate scenarios: uniform, zipfian hot-rank, and
     explicit hot-key mixes (CHECKED_ZIPFIAN-anchored seed picks the
-    draws; the SHAPES are fixed)."""
-    return [
+    draws; the SHAPES are fixed), plus the round-16 read-heavy YCSB
+    B/C/D cells (ycsb.READ_MIXES — B = 95/5 zipfian, C = read-only
+    zipfian, D = 95/5 latest-distribution reads)."""
+    from hermes_tpu.workload.ycsb import READ_MIXES
+
+    out = [
         MixSpec(name="uniform", distribution="uniform", tenants=tenants),
         MixSpec(name="zipfian", distribution="zipfian", zipf_theta=0.99,
                 tenants=tenants),
         MixSpec(name="hotkey", distribution="hotkey", hot_frac=0.8,
                 hot_keys=4, tenants=tenants),
     ]
+    for name, kw in READ_MIXES.items():
+        out.append(MixSpec(name=f"ycsb_{name}", tenants=tenants, **kw))
+    return out
 
 
 class ClosedLoop:

@@ -46,6 +46,37 @@ def scrambled_zipfian(
     return perm[ranks]
 
 
+# Round-16 read-heavy mixes (the read-side scenario set beside the
+# write-centric acceptance configs above).  YCSB-B/C/D per the YCSB core
+# workloads: B = 95/5 read/update zipfian, C = read-only zipfian, D =
+# 95/5 read/update with LATEST-distribution reads (reads skew to the
+# most recently written keys — openloop.make_mix's 'latest' draw; this
+# store has no insert op, so D's insert half is modeled as updates, the
+# standard adaptation for update-in-place stores).  One table feeds the
+# bench cells (bench.py --reads), the serving scenario matrix
+# (workload.openloop.scenario_matrix), and the cli quickstart, so the
+# three surfaces cannot drift.
+READ_MIXES = {
+    "b": dict(read_frac=0.95, rmw_frac=0.0, distribution="zipfian"),
+    "c": dict(read_frac=1.0, rmw_frac=0.0, distribution="zipfian"),
+    "d": dict(read_frac=0.95, rmw_frac=0.0, distribution="latest"),
+}
+
+# The recency horizon of the 'latest' draw: reads rank the last this-many
+# writes by a Zipfian(theta) over age (YCSB's ScrambledZipfian-over-
+# recency, windowed so the CDF is precomputable once).
+LATEST_WINDOW = 1024
+
+
+def latest_ages(rng: np.random.Generator, n: int, theta: float = 0.99
+                ) -> np.ndarray:
+    """Zipfian(theta) age draws in [0, LATEST_WINDOW): age 0 = the most
+    recent write.  Deterministic per rng state; callers clamp to the
+    writes that actually exist yet."""
+    cdf = _zipf_cdf(LATEST_WINDOW, theta)
+    return np.searchsorted(cdf, rng.random(size=n)).astype(np.int64)
+
+
 def sample_keys(
     rng: np.random.Generator, cfg: HermesConfig, size: tuple[int, ...]
 ) -> np.ndarray:

@@ -64,6 +64,8 @@ def main() -> int:
     print(f"censusing bench shape (S={cfg.n_sessions}, C={cfg.lane_budget}, "
           f"K={cfg.n_keys}, fused_sort={cfg.use_fused_sort}) + mega path...",
           file=sys.stderr)
+    from hermes_tpu.core import readpath
+
     measured = {
         "batched": prof.op_census(cfg, "batched"),
         "sharded": prof.op_census(cfg, "sharded", mesh),
@@ -72,6 +74,12 @@ def main() -> int:
         # kernel interiors the plain census cannot see)
         "batched_mega": prof.op_census(mega, "batched"),
         "sharded_mega": prof.op_census(mega, "sharded", mesh),
+        # round-16: the local-read fast path is a SEPARATE dispatch —
+        # the round sections above not moving IS the zero-round-impact
+        # proof; these police the read programs' own op diet (one
+        # gather for a whole multi-get, zero sparse ops for a scan)
+        "read_path": readpath.read_census(cfg, "batched"),
+        "read_scan": readpath.scan_census(cfg, "batched"),
     }
 
     with open(args.budget) as f:
@@ -124,6 +132,10 @@ def main() -> int:
                               "sparse_total"],
                           mega_serial_iter_bound=measured["batched_mega"][
                               "pallas_serial_iter_bound"],
+                          sparse_read_path=measured["read_path"][
+                              "sparse_total"],
+                          sparse_read_scan=measured["read_scan"][
+                              "sparse_total"],
                           budget_failures=failures, census_drift=drift)))
     return 0 if out["ok"] else 1
 

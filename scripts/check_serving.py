@@ -2,7 +2,7 @@
 seeded, gated failure mode — the front-end must shed loudly, honor
 deadlines, and degrade gracefully rather than wedge.
 
-Four assertions, CPU-smoke sized (joins the eight earlier gates in
+Five assertions, CPU-smoke sized (joins the eight earlier gates in
 scripts/run_gates.py — gates run SERIALLY, never beside pytest):
 
   1. overload soak, both engines — an open-loop Poisson soak at >= 2x
@@ -26,7 +26,13 @@ scripts/run_gates.py — gates run SERIALLY, never beside pytest):
      overload_storm attached to the arrival shaper via ChaosRunner's
      load= seam) burst the arrival rate mid-soak; the envelope must
      still satisfy (b)+(c), shed visibly (retry_after > 0), and the
-     executed chaos log + response log must replay byte-identically.
+     executed chaos log + response log must replay byte-identically;
+  5. round-16 read soak — a YCSB-B mix with K_MGET batches riding every
+     8th arrival at >= 2x capacity must resolve every request loudly,
+     keep the checker green with ``stale_read == []`` (local reads
+     verified against the write history), and rung 2 must keep ALL-hot
+     batched reads serving while a batch carrying one cold key (and any
+     scan) sheds R_SHED_READ.
 
     env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python scripts/check_serving.py
@@ -247,12 +253,125 @@ def check_overload_storm(report: dict) -> None:
     report["overload_storm_replay_identical"] = True
 
 
+def check_read_soak(report: dict) -> None:
+    """Round-16 read leg: the K_MGET/K_SCAN serving path under 2x
+    overload must (a) resolve every request loudly with the envelope
+    invariants intact, (b) keep the linearizability checker green with
+    stale_read == [] (local reads are VERIFIED against the write
+    history), and (c) keep rung-2 hot-key reads serving while non-hot
+    batched reads shed — the ladder's read semantics applied to the
+    batched verbs."""
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.serving import (LoopbackServer, Frontend, ServingConfig,
+                                    VirtualClock, measure_capacity,
+                                    verify_serving, wire)
+    from hermes_tpu.workload.openloop import MixSpec, hot_set, make_mix
+    from hermes_tpu.workload.ycsb import READ_MIXES
+    from hermes_tpu.serving.soak import committed_uids
+    import numpy as np
+
+    spec = MixSpec(name="ycsb_b", tenants=4, **READ_MIXES["b"])
+    cap = measure_capacity(_store("batched", record=False), _scfg(), spec,
+                           n=240, seed=SEED)
+    rate = 2.0 * cap["ops_per_vs"]
+    store = _store("batched")
+    clock = VirtualClock()
+    fe = Frontend(store, _scfg(), clock=clock)
+    lb = LoopbackServer(fe)
+    n = 400
+    mix = make_mix(spec, fe.n_keys, n, SEED, value_words=fe.u)
+    from hermes_tpu.workload.openloop import ShapedArrivals
+
+    arrivals = ShapedArrivals(rate, n, SEED)
+    round_s = ROUND_US * 1e-6
+    sent = mgets = 0
+    rounds = 0
+    while rounds < 200_000:
+        due = arrivals.due(clock.t)
+        for _ in range(due):
+            if sent >= n:
+                break
+            i = sent
+            sent += 1
+            if i % 8 == 7:
+                # every 8th arrival is a BATCHED read: 8 mix keys
+                # through K_MGET (the round-16 verb under overload)
+                ks = [int(k) for k in mix["key"][max(0, i - 8): i]]
+                lb.submit(wire.ReadRequest(
+                    kind="mget", req_id=i + 1,
+                    tenant=int(mix["tenant"][i]), keys=ks or [0],
+                    deadline_us=DEADLINE_US))
+                mgets += 1
+            else:
+                lb.submit(wire.Request(
+                    kind=("get", "put", "rmw")[int(mix["kind"][i])],
+                    req_id=i + 1, tenant=int(mix["tenant"][i]),
+                    key=int(mix["key"][i]), deadline_us=DEADLINE_US,
+                    value=mix["value"][i].tolist()))
+        lb.pump()
+        clock.advance(round_s)
+        rounds += 1
+        if sent >= n and not (fe._intake or fe._pending or fe._abandoned):
+            break
+    lb.drain()
+    ev = verify_serving(fe)
+    assert mgets > 10, "read soak drove no batched reads"
+    v = store.rt.check()
+    assert v.ok, (
+        f"read soak checker FAIL: "
+        f"{[f.reason[:160] for f in v.failures[:2]]}")
+    stale = lin.stale_read(store.rt.history_ops())
+    assert not stale, f"read soak produced STALE reads: {stale[:3]}"
+    uids = committed_uids(fe, lb)
+    lost = lin.committed_write_lost(uids, store.rt.history_ops(),
+                                    store.rt.recorder.aborted_uids)
+    assert not lost, f"read soak contradicted committed writes: {lost[:3]}"
+    report["read_soak"] = dict(
+        capacity_probe=cap, rate_per_vs=rate, mget_requests=mgets,
+        read_stats=store.read_stats(), **ev)
+
+    # rung-2 retention through the BATCHED verbs: with the queue jammed
+    # past shed_read_frac, an all-hot mget still serves while a batch
+    # carrying one cold key sheds (R_SHED_READ) — a batch cannot smuggle
+    # cold keys behind a hot one
+    spec2 = MixSpec(name="hotkey", distribution="hotkey", hot_keys=4)
+    scfg2 = _scfg(hot_keys=hot_set(spec2), queue_cap=16,
+                  shed_write_frac=0.3, shed_read_frac=0.5, tenant_quota=32)
+    store2 = _store("batched", record=False)
+    clock2 = VirtualClock()
+    fe2 = Frontend(store2, scfg2, clock=clock2)
+    lb2 = LoopbackServer(fe2)
+    # jam the intake queue past the rung-2 watermark without pumping
+    # (hot-key gets — they admit at every rung, so the jam can build)
+    for i in range(int(scfg2.queue_cap * scfg2.shed_read_frac) + 2):
+        r = lb2.submit(wire.Request(kind="get", req_id=1000 + i, tenant=0,
+                                    key=i % 4))
+        assert r is None, "queue jam refused too early"
+    hot_rsp = lb2.submit(wire.ReadRequest(kind="mget", req_id=1, tenant=1,
+                                          keys=[0, 1, 2, 3]))
+    cold_rsp = lb2.submit(wire.ReadRequest(kind="mget", req_id=2, tenant=1,
+                                           keys=[0, 1, 2, 40]))
+    scan_rsp = lb2.submit(wire.ReadRequest(kind="scan", req_id=3, tenant=1,
+                                           lo=0, hi=32))
+    assert hot_rsp is None, "rung 2 shed an ALL-HOT batched read"
+    assert cold_rsp is not None \
+        and cold_rsp.status == wire.S_RETRY_AFTER \
+        and cold_rsp.reason == wire.R_SHED_READ, cold_rsp
+    assert scan_rsp is not None \
+        and scan_rsp.status == wire.S_RETRY_AFTER, scan_rsp
+    lb2.drain()
+    verify_serving(fe2)
+    report["read_rung2"] = dict(hot_admitted=True, cold_shed=True,
+                                scan_shed=True)
+
+
 def main() -> int:
     report: dict = {"gate": "serving"}
     try:
         check_engines(report)
         check_fleet(report)
         check_overload_storm(report)
+        check_read_soak(report)
     except AssertionError as e:
         report["ok"] = False
         report["error"] = str(e)

@@ -120,6 +120,23 @@ SLOW_TESTS = {
     "test_mega_replay_multiblock_ragged_identity",
     "test_mega_pipeline_depth2_chaos_schedule_identity",
     "test_mega_resolution_refusal_falls_back_loudly",
+    # round-16 read path: the quick tier keeps the core local-serve +
+    # checker test, the invalid-fallback branch, the sharded stale-read
+    # red test, the batch-token fence sibling (same fence mechanism as
+    # the lane/tenant variants), the loopback serving e2e, the sparse
+    # scan sibling, and the fleet draining-reject sibling; everything
+    # below pays a fresh multi-second compile for a mechanism its quick
+    # sibling already exercises
+    "test_stale_read_red_batched",
+    "test_fleet_multi_get_merges_in_fleet_key_order",
+    "test_ryw_holds_under_seeded_chaos_depth2",
+    "test_serving_mget_over_real_sockets",
+    "test_multi_get_sparse_absent_not_found_no_slot",
+    "test_serving_ryw_fence_is_tenant_scoped",
+    "test_ryw_fence_redirects_to_round_path",
+    "test_scan_sparse_echoes_client_keys_in_write_order",
+    "test_sharded_multi_get_serves_and_checks",
+    "test_scan_probe_cannot_hide_cold_interior_behind_hot_endpoints",
 }
 
 

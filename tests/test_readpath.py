@@ -1,0 +1,582 @@
+"""Round-16 read side: the local-read fast path (core/readpath.py),
+KVS.multi_get/scan with read-your-writes fencing, the stale-read checker
+extension (red-tested on both engines), the fleet fan/merge, the serving
+K_MGET/K_SCAN verbs, and the read-path op budget."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hermes_tpu.checker import linearizability as lin
+from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
+from hermes_tpu.core import types as t
+from hermes_tpu.kvs import C_REJECTED, KVS
+
+
+def _cfg(**over):
+    kw = dict(n_replicas=3, n_keys=256, value_words=6, n_sessions=8,
+              replay_slots=8, ops_per_session=64,
+              workload=WorkloadConfig(read_frac=0.5, seed=3))
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _put_all(kvs, pairs):
+    futs = [kvs.put(i % kvs.cfg.n_replicas, i % kvs.cfg.n_sessions, k, v)
+            for i, (k, v) in enumerate(pairs)]
+    assert kvs.run_until(futs)
+    return futs
+
+
+# -- KVS fast path -----------------------------------------------------------
+
+
+def test_multi_get_serves_locally_and_checks():
+    kvs = KVS(_cfg(), record=True)
+    _put_all(kvs, [(7, [11, 22, 33]), (9, [44, 55, 66])])
+    res = kvs.multi_get([7, 9, 3])
+    assert res.all_done() and res.local.all()
+    assert res.value[0].tolist()[:3] == [11, 22, 33]
+    assert res.value[1].tolist()[:3] == [44, 55, 66]
+    # slot 3 never written: the preloaded initial value (uid (3, -1))
+    assert res.found[2]
+    assert kvs.read_stats()["local_reads"] == 3
+    v = kvs.rt.check()
+    assert v.ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+def test_scan_dense_range_and_bounds():
+    kvs = KVS(_cfg(), record=True)
+    _put_all(kvs, [(10, [5, 5]), (12, [6, 6])])
+    res = kvs.scan(9, 13)
+    assert res.all_done()
+    assert res.key.tolist() == [9, 10, 11, 12]
+    assert res.value[1].tolist()[:2] == [5, 5]
+    assert res.value[3].tolist()[:2] == [6, 6]
+    with pytest.raises(ValueError):
+        kvs.scan(5, 3)
+    with pytest.raises(ValueError):
+        kvs.scan(0, kvs.cfg.n_keys + 1)
+    assert kvs.rt.check().ok
+
+
+def test_multi_get_sparse_absent_not_found_no_slot():
+    kvs = KVS(_cfg(), sparse_keys=True)
+    big = 0xDEAD_BEEF_0000_0001
+    f = kvs.put(0, 0, big, [9, 9, 9])
+    assert kvs.run_until([f])
+    used = kvs.index.n_used
+    res = kvs.multi_get([big, 0xFFFF_0000])
+    assert res.all_done()
+    assert res.found[0] and res.value[0].tolist()[:3] == [9, 9, 9]
+    assert not res.found[1]
+    # the absent probe claimed no dense slot
+    assert kvs.index.n_used == used
+
+
+def test_scan_sparse_echoes_client_keys_in_write_order():
+    kvs = KVS(_cfg(), sparse_keys=True)
+    keys = [1 << 40, 77, 1 << 50]
+    _put_all(kvs, [(k, [i + 1]) for i, k in enumerate(keys)])
+    res = kvs.scan(0, kvs.cfg.n_keys)
+    assert res.all_done()
+    assert res.key.tolist() == keys  # slots allocate in first-write order
+    assert [r[0] for r in res.value.tolist()] == [1, 2, 3]
+
+
+def test_invalid_key_falls_back_to_round_path():
+    """A key whose write is still in flight is NOT Valid: the fast path
+    must decline it (no stale bytes) and the round-path fallback must
+    resolve once the write commits."""
+    kvs = KVS(_cfg(), record=True)
+    kvs.freeze(2)  # quorum needs every live replica: the put stalls
+    fw = kvs.put(0, 0, 5, [1, 2, 3])
+    for _ in range(4):
+        kvs.step()
+    assert not fw.done()
+    res = kvs.multi_get([5, 6], wait=False)
+    assert not res.local[0]          # in-flight key declined
+    assert res.local[1]              # untouched key served locally
+    assert not res.all_done()
+    assert kvs.read_stats()["fallback_reads"] == 1
+    kvs.rt.thaw(2)
+    assert kvs.run_until([fw])
+    assert kvs.run_batch(res._fallback[0])
+    res._pull()
+    assert res.all_done()
+    assert res.value[0].tolist()[:3] == [1, 2, 3]
+    assert kvs.rt.check().ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+def test_no_healthy_replica_means_no_local_serving():
+    kvs = KVS(_cfg())
+    for r in range(3):
+        kvs.freeze(r)
+    res = kvs.multi_get([1, 2], wait=False)
+    assert not res.local.any()
+    assert res.fallbacks == 2  # everything routed to the round path
+
+
+def test_ryw_fence_redirects_to_round_path():
+    """Red-style fence check: a poisoned fence entry (a committed ts the
+    row can never have reached) must force the lane's local read onto
+    the round path — and the answer is still the committed value."""
+    kvs = KVS(_cfg())
+    f = kvs.put(0, 0, 42, [7, 8, 9])
+    assert kvs.run_until([f])
+    # fence satisfied: a normal session read serves locally and prunes
+    res = kvs.multi_get([42], session=(0, 0))
+    assert res.local[0] and kvs.ryw_fallbacks == 0
+    # poison: pretend the lane saw a commit far in the version future
+    kvs._ryw[(0, 0)] = {42: (1 << 40, 0)}
+    res2 = kvs.multi_get([42], session=(0, 0))
+    assert res2.all_done()
+    assert not res2.local[0]
+    assert kvs.ryw_fallbacks == 1
+    assert res2.value[0].tolist()[:3] == [7, 8, 9]
+    # unfenced sessions are unaffected
+    res3 = kvs.multi_get([42], session=(1, 0))
+    assert res3.local[0]
+
+
+def test_fenced_range_rejects_reads():
+    kvs = KVS(_cfg())
+    kvs.fence_slots(10, 20)
+    res = kvs.multi_get([5, 15])
+    assert res.code[0] == t.C_READ and res.code[1] == C_REJECTED
+    sc = kvs.scan(8, 12)
+    assert (sc.code[:2] == t.C_READ).all()
+    assert (sc.code[2:] == C_REJECTED).all()
+
+
+def test_ryw_holds_under_seeded_chaos_depth2():
+    """Acceptance: read-your-writes under a seeded chaos schedule at
+    pipeline depth 2 — every committed put is immediately observable by
+    the same lane's multi_get, through freeze/thaw windows, and the
+    whole run stays checker-green with stale_read == []."""
+    from hermes_tpu import chaos as chaos_lib
+
+    cfg = _cfg(pipeline_depth=2, n_keys=64)
+    kvs = KVS(cfg, record=True)
+    rng = np.random.default_rng(14)
+    lines = []
+    step = 0
+    for _ in range(4):
+        r = int(rng.integers(0, cfg.n_replicas))
+        fr, th = step + int(rng.integers(1, 4)), step + int(rng.integers(5, 9))
+        lines += [f"@{fr} freeze {r}", f"@{th} thaw {r}"]
+        step = th + 2
+    sched = chaos_lib.Schedule.parse("\n".join(lines))
+    runner = chaos_lib.ChaosRunner(kvs, sched)
+    lane = (0, 1)
+    payload = 1
+    for i in range(40):
+        runner.tick(i)
+        if i % 3 == 0:
+            key = int(rng.integers(0, cfg.n_keys))
+            fut = kvs.put(*lane, key, [payload, i])
+            assert kvs.run_until([fut], max_steps=500)
+            c = fut.result()
+            if c.kind == "put":  # committed and client-visible
+                res = kvs.multi_get([key], session=lane)
+                assert res.all_done()
+                got = res.value[0].tolist()[:2]
+                # RYW: the lane observes its own committed write (or a
+                # newer one — no other writer touches this payload space)
+                assert got == [payload, i], (key, got, payload, i)
+            payload += 1
+        else:
+            kvs.step()
+    for r in range(cfg.n_replicas):
+        kvs.rt.thaw(r)
+    kvs.rt.flush_pipeline()
+    kvs.flush()
+    assert kvs.rt.check().ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+# -- the stale-read checker (red on both engines) ----------------------------
+
+
+def _inject_stale_read(kvs, key: int):
+    """Deliberately record a read of the key's OVERWRITTEN value at a
+    step after the overwrite committed — the exact bug class the checker
+    extension exists to catch."""
+    from hermes_tpu.core import state as st
+
+    f1 = kvs.put(0, 0, key, [1])
+    assert kvs.run_until([f1])
+    uid1 = f1.result().uid
+    f2 = kvs.put(1, 1, key, [2])
+    assert kvs.run_until([f2])
+    for _ in range(3):
+        kvs.step()
+    n = 1
+    rval = np.zeros((1, n, kvs.cfg.value_words), np.int32)
+    rval[0, 0, 0], rval[0, 0, 1] = uid1
+    step = np.full((1, n), kvs.rt.step_idx, np.int32)
+    kvs.rt.recorder.record_step(st.Completions(
+        code=np.full((1, n), t.C_READ, np.int32),
+        key=np.full((1, n), key, np.int32),
+        wval=np.zeros((1, n, kvs.cfg.value_words), np.int32),
+        rval=rval,
+        ver=np.zeros((1, n), np.int32), fc=np.zeros((1, n), np.int32),
+        invoke_step=step, commit_step=step,
+    ))
+
+
+@pytest.mark.parametrize("recorder", [True, "array"])
+def test_stale_read_red_batched(recorder):
+    kvs = KVS(_cfg(), record=recorder)
+    _inject_stale_read(kvs, 13)
+    ev = lin.stale_read(kvs.rt.history_ops())
+    assert ev, "injected stale read not caught on the batched engine"
+    assert ev[0]["key"] == 13
+    # a clean sibling run stays green
+    kvs2 = KVS(_cfg(), record=recorder)
+    _put_all(kvs2, [(13, [1]), (13, [2])])
+    assert kvs2.multi_get([13]).all_done()
+    assert lin.stale_read(kvs2.rt.history_ops()) == []
+
+
+def test_stale_read_red_sharded(cpu_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(cpu_devices[:3]), ("replica",))
+    kvs = KVS(_cfg(), backend="sharded", mesh=mesh, record="array")
+    _inject_stale_read(kvs, 21)
+    ev = lin.stale_read(kvs.rt.history_ops())
+    assert ev, "injected stale read not caught on the sharded engine"
+    assert ev[0]["key"] == 21
+
+
+def test_sharded_multi_get_serves_and_checks(cpu_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(cpu_devices[:3]), ("replica",))
+    kvs = KVS(_cfg(), backend="sharded", mesh=mesh, record="array")
+    _put_all(kvs, [(3, [30, 31]), (200, [40, 41])])
+    res = kvs.multi_get([3, 200])
+    assert res.all_done() and res.local.all()
+    assert res.value[0].tolist()[:2] == [30, 31]
+    assert res.value[1].tolist()[:2] == [40, 41]
+    assert kvs.rt.check().ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+# -- fleet -------------------------------------------------------------------
+
+
+def test_fleet_multi_get_merges_in_fleet_key_order():
+    base = _cfg(n_keys=64, n_sessions=4)
+    fleet_cfg = FleetConfig(groups=2, base=base)
+    from hermes_tpu.fleet import Fleet
+
+    fleet = Fleet(fleet_cfg, record="array")
+    keys = np.array([3, 100, 70, 5], np.int64)
+    vals = (np.arange(16, dtype=np.int32).reshape(4, 4) + 1)
+    fb = fleet.submit_batch(np.full(4, Fleet.PUT, np.int32), keys, vals)
+    assert fleet.run_batch(fb)
+    fr = fleet.multi_get(keys[::-1], session=9)
+    assert fr.all_done() and fr.local.all()
+    # answers land at the FLEET submission positions, spanning groups
+    assert fr.value[0].tolist() == vals[3].tolist()   # key 5 (group 0)
+    assert fr.value[1].tolist() == vals[2].tolist()   # key 70 (group 1)
+    assert fr.value[3].tolist() == vals[0].tolist()   # key 3
+    assert set(fr.group.tolist()) == {0, 1}
+    sc = fleet.scan(60, 68)  # spans the group boundary at 64
+    assert sc.all_done() and set(sc.group.tolist()) == {0, 1}
+    assert fleet.check()["ok"]
+
+
+def test_fleet_multi_get_draining_range_rejects():
+    base = _cfg(n_keys=64, n_sessions=4)
+    from hermes_tpu.fleet import Fleet
+
+    fleet = Fleet(FleetConfig(groups=2, base=base))
+    fleet.router.begin_drain(0, 8)
+    fr = fleet.multi_get([3, 100])
+    assert fr.code[0] == C_REJECTED and fr.group[0] == -1
+    assert fr.code[1] == t.C_READ
+    fleet.router.release(0, 8)
+
+
+# -- serving (K_MGET / K_SCAN) -----------------------------------------------
+
+
+def test_wire_read_structs_roundtrip_and_red():
+    from hermes_tpu.serving import wire
+
+    u = 4
+    req = wire.ReadRequest(kind="mget", req_id=9, tenant=3,
+                           keys=[2, 5, 11], deadline_us=500)
+    assert wire.decode_any_request(wire.encode_any_request(req, u), u) == req
+    sc = wire.ReadRequest(kind="scan", req_id=10, tenant=0, lo=4, hi=20)
+    assert wire.decode_any_request(wire.encode_any_request(sc, u), u) == sc
+    rsp = wire.ReadResponse(
+        status=wire.S_OK, req_id=9, step=7, found=[True, False, True],
+        local=[True, True, False], codes=[0, 0, wire.RK_REJECTED],
+        values=[[1, 2, 3, 4], [0] * 4, [5, 6, 7, 8]])
+    assert wire.decode_any_response(
+        wire.encode_any_response(rsp, u), u) == rsp
+    # refusal carries no rows
+    ref = wire.ReadResponse(status=wire.S_RETRY_AFTER, req_id=9,
+                            reason=wire.R_SHED_READ, retry_after_us=100)
+    assert wire.decode_any_response(
+        wire.encode_any_response(ref, u), u) == ref
+    # red: truncated body / empty mget / bad magic all refuse loudly
+    with pytest.raises(ValueError):
+        wire.decode_read_request(wire.encode_read_request(req)[:-3])
+    with pytest.raises(ValueError):
+        wire.encode_read_request(wire.ReadRequest(
+            kind="mget", req_id=1, tenant=0, keys=[]))
+    with pytest.raises(ValueError):
+        wire.decode_read_response(b"\x00" * wire._RRSP.size, u)
+    # both request layouts expose req_id to the header peek
+    assert wire.peek_req_id(wire.encode_read_request(req)) == 9
+
+
+def test_serving_mget_scan_end_to_end_loopback():
+    from hermes_tpu.serving import (Frontend, LoopbackServer, ServingConfig,
+                                    VirtualClock, verify_serving, wire)
+
+    kvs = KVS(_cfg(n_keys=128), record="array")
+    clock = VirtualClock()
+    fe = Frontend(kvs, ServingConfig(), clock=clock)
+    lb = LoopbackServer(fe)
+    for i, k in enumerate((4, 8, 15)):
+        assert lb.submit(wire.Request(kind="put", req_id=100 + i, tenant=0,
+                                      key=k, value=[k, k + 1])) is None
+    for _ in range(6):
+        lb.pump()
+        clock.advance(0.001)
+    assert lb.submit(wire.ReadRequest(kind="mget", req_id=200, tenant=1,
+                                      keys=[4, 8, 15, 99])) is None
+    assert lb.submit(wire.ReadRequest(kind="scan", req_id=201, tenant=1,
+                                      lo=6, hi=10)) is None
+    rsps = []
+    for _ in range(6):
+        rsps += lb.pump()
+        clock.advance(0.001)
+    reads = {r.req_id: r for r in rsps
+             if isinstance(r, wire.ReadResponse)}
+    assert set(reads) == {200, 201}
+    m = reads[200]
+    assert m.status == wire.S_OK and all(m.local)
+    assert m.values[0][:2] == [4, 5] and m.values[2][:2] == [15, 16]
+    s = reads[201]
+    assert s.values[2][:2] == [8, 9]
+    # malformed: out-of-range key refuses loudly, in the read layout
+    bad = lb.submit(wire.ReadRequest(kind="mget", req_id=202, tenant=1,
+                                     keys=[5, 10_000]))
+    assert isinstance(bad, wire.ReadResponse)
+    assert bad.status == wire.S_REJECTED
+    lb.drain()
+    verify_serving(fe)
+    assert kvs.rt.check().ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+def test_serving_mget_over_real_sockets():
+    from hermes_tpu.serving import (Frontend, RpcClient, ServingConfig,
+                                    TcpRpcServer)
+
+    kvs = KVS(_cfg(n_keys=128))
+    fe = Frontend(kvs, ServingConfig(tenant_rate_per_s=1e6,
+                                     tenant_burst=1e4))
+    srv = TcpRpcServer(fe)
+    try:
+        cli = RpcClient(srv.addr, fe.u)
+        # no deadline: the first pump compiles the round program, which
+        # can take seconds on a cold CPU backend
+        put = cli.call("put", 33, value=[7, 7])
+        assert put.status_name == "ok"
+        rsp = cli.call_mget([33, 34])
+        assert rsp.status_name == "ok"
+        assert rsp.values[0][:2] == [7, 7]
+        sc = cli.call_scan(30, 36)
+        assert sc.status_name == "ok" and len(sc.values) == 6
+        cli.close()
+    finally:
+        srv.close()
+    assert srv.pump_error is None
+
+
+def test_serving_ryw_fence_is_tenant_scoped():
+    """The frontend pins a per-tenant fence token on every commit it
+    delivers, so lane rotation on the write path cannot defeat RYW for
+    batched reads: after a tenant's put resolves, its K_MGET carries the
+    same token; a poisoned fence reroutes the read to the round path
+    and the answer is still the committed value."""
+    from hermes_tpu.serving import (Frontend, LoopbackServer, ServingConfig,
+                                    VirtualClock, wire)
+
+    kvs = KVS(_cfg(n_keys=64))
+    clock = VirtualClock()
+    fe = Frontend(kvs, ServingConfig(), clock=clock)
+    lb = LoopbackServer(fe)
+    assert lb.submit(wire.Request(kind="put", req_id=1, tenant=7, key=9,
+                                  value=[3, 4])) is None
+    rsps = []
+    for _ in range(4):
+        rsps += lb.pump()
+        clock.advance(0.001)
+    assert any(r.status == wire.S_OK and r.uid is not None for r in rsps)
+    token = ("tenant", 7)
+    assert token in kvs._ryw and 9 in kvs._ryw[token]
+    # satisfied fence: served locally, entry pruned
+    assert lb.submit(wire.ReadRequest(kind="mget", req_id=2, tenant=7,
+                                      keys=[9])) is None
+    rsps = []
+    for _ in range(4):
+        rsps += lb.pump()
+        clock.advance(0.001)
+    m = [r for r in rsps if isinstance(r, wire.ReadResponse)][0]
+    assert m.local[0] and m.values[0][:2] == [3, 4]
+    assert 9 not in kvs._ryw.get(token, {})
+    # poisoned fence: the read reroutes (not local), answer still right
+    kvs._ryw[token] = {9: (1 << 40, 0)}
+    assert lb.submit(wire.ReadRequest(kind="mget", req_id=3, tenant=7,
+                                      keys=[9])) is None
+    rsps = []
+    for _ in range(6):
+        rsps += lb.pump()
+        clock.advance(0.001)
+    m = [r for r in rsps if isinstance(r, wire.ReadResponse)][0]
+    assert not m.local[0] and m.values[0][:2] == [3, 4]
+    assert kvs.ryw_fallbacks == 1
+
+
+def test_batch_writers_can_pin_read_fences():
+    """BatchFutures carries the committed timestamps (tsv/tsf), and
+    pin_read_fence installs them under an arbitrary token — the batch
+    path's route to read-your-writes."""
+    kvs = KVS(_cfg())
+    bf = kvs.submit_batch(np.array([KVS.PUT], np.int32), np.array([17]),
+                          np.array([[5, 6, 7, 8]], np.int32))
+    assert kvs.run_batch(bf)
+    c = bf.completion(0)
+    assert c.ts is not None and c.ts[0] > 0
+    kvs.pin_read_fence("my-batch", 17, c.ts)
+    res = kvs.multi_get([17], session="my-batch")
+    assert res.local[0] and res.value[0].tolist()[:2] == [5, 6]
+    assert 17 not in kvs._ryw["my-batch"]  # satisfied -> pruned
+
+
+def test_scan_probe_cannot_hide_cold_interior_behind_hot_endpoints():
+    """Rung 2 must shed a scan whose ENDPOINTS are hot but whose
+    interior is cold (the probe hunts len(hot)+1 keys from lo, which
+    provably contains a cold one)."""
+    from hermes_tpu.serving import (Frontend, LoopbackServer, ServingConfig,
+                                    VirtualClock, wire)
+
+    kvs = KVS(_cfg(n_keys=64))
+    scfg = ServingConfig(hot_keys=(0, 31), queue_cap=16,
+                         shed_write_frac=0.3, shed_read_frac=0.5)
+    fe = Frontend(kvs, scfg, clock=VirtualClock())
+    lb = LoopbackServer(fe)
+    for i in range(10):  # jam past the rung-2 watermark with hot gets
+        assert lb.submit(wire.Request(kind="get", req_id=100 + i, tenant=0,
+                                      key=(0, 31)[i % 2])) is None
+    rsp = lb.submit(wire.ReadRequest(kind="scan", req_id=1, tenant=1,
+                                     lo=0, hi=32))
+    assert rsp is not None and rsp.reason == wire.R_SHED_READ
+    lb.drain()
+
+
+def test_plausible_frame_length_predicates():
+    from hermes_tpu.serving import wire
+
+    u = 4
+    req_ok = wire.plausible_request_len(u)
+    assert req_ok(wire.req_nbytes(u))
+    assert req_ok(wire.rreq_nbytes("mget", 3))
+    assert req_ok(wire.rreq_nbytes("scan", 0))
+    assert not req_ok(wire.req_nbytes(u) + 1)
+    assert not req_ok(wire._RREQ.size + 3)  # not a whole key vector
+    rsp_ok = wire.plausible_response_len(u)
+    assert rsp_ok(wire.rsp_nbytes(u))
+    assert rsp_ok(wire.rrsp_nbytes(u, 0))
+    assert rsp_ok(wire.rrsp_nbytes(u, 5))
+    assert not rsp_ok(wire.rrsp_nbytes(u, 5) + 2)
+
+
+# -- op budget ---------------------------------------------------------------
+
+
+def test_read_programs_hold_their_op_budget():
+    """The read path's own census: ONE dynamic gather for a multi-get,
+    ZERO sparse ops for a scan — and nothing on the collective chain.
+    (The round census being untouched is enforced by the census gate:
+    the batched/sharded sections of OP_BUDGET.json did not move.)"""
+    from hermes_tpu.core import readpath
+
+    cfg = _cfg(n_keys=1024)
+    c = readpath.read_census(cfg, "batched", batch=512)
+    assert c["sparse_total"] == 1
+    assert c["stablehlo.gather"] == 1
+    assert c["collective_total"] == 0
+    s = readpath.scan_census(cfg, "batched", size=512)
+    assert s["sparse_total"] == 0
+    assert s["collective_total"] == 0
+
+
+def test_batch_bucket_pads_to_fixed_shapes():
+    from hermes_tpu.core import readpath
+
+    assert readpath.batch_bucket(1) == readpath.MIN_BATCH
+    assert readpath.batch_bucket(257) == 512
+    assert readpath.batch_bucket(512) == 512
+    kvs = KVS(_cfg())
+    kvs.multi_get(list(range(5)))
+    kvs.multi_get(list(range(9)))  # same bucket: no new compile
+    rd = kvs._reader
+    from hermes_tpu.core.readpath import build_multi_get
+
+    assert build_multi_get.cache_info().currsize >= 1
+
+
+# -- workloads ---------------------------------------------------------------
+
+
+def test_read_mixes_shapes_and_matrix():
+    from hermes_tpu.workload.openloop import make_mix, scenario_matrix
+    from hermes_tpu.workload.ycsb import READ_MIXES
+
+    assert READ_MIXES["b"]["read_frac"] == 0.95
+    assert READ_MIXES["c"]["read_frac"] == 1.0
+    assert READ_MIXES["d"]["distribution"] == "latest"
+    names = [m.name for m in scenario_matrix()]
+    for want in ("ycsb_b", "ycsb_c", "ycsb_d"):
+        assert want in names
+    # measured read ratio tracks the spec
+    from hermes_tpu.workload.openloop import MixSpec
+
+    spec = MixSpec(name="ycsb_b", **READ_MIXES["b"])
+    mix = make_mix(spec, 1024, 4000, seed=5)
+    frac = float(np.mean(mix["kind"] == 0))
+    assert 0.93 < frac < 0.97
+
+
+def test_latest_distribution_reads_chase_the_write_frontier():
+    from hermes_tpu.workload.openloop import MixSpec, make_mix
+    from hermes_tpu.workload.ycsb import READ_MIXES
+
+    spec = MixSpec(name="ycsb_d", **READ_MIXES["d"])
+    n_keys = 1 << 16  # huge keyspace: uniform reads would rarely collide
+    mix = make_mix(spec, n_keys, 3000, seed=7)
+    m2 = make_mix(spec, n_keys, 3000, seed=7)
+    assert mix["key"].tobytes() == m2["key"].tobytes()  # deterministic
+    written = set()
+    hits = reads = 0
+    for i in range(3000):
+        if mix["kind"][i] != 0:
+            written.add(int(mix["key"][i]))
+        elif written:
+            reads += 1
+            hits += int(mix["key"][i]) in written
+    # latest reads overwhelmingly land on already-written keys
+    assert reads > 1000 and hits / reads > 0.9

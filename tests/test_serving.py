@@ -447,7 +447,9 @@ def test_scenario_matrix_and_seed_anchor():
     seed = scenario_seed()
     assert isinstance(seed, int) and seed == scenario_seed()
     names = [s.name for s in scenario_matrix()]
-    assert names == ["uniform", "zipfian", "hotkey"]
+    # round-16: the read-heavy YCSB cells joined the original three
+    assert names == ["uniform", "zipfian", "hotkey",
+                     "ycsb_b", "ycsb_c", "ycsb_d"]
 
 
 def test_tcp_rpc_server_end_to_end():
