@@ -690,6 +690,212 @@ def run_read_bench(n: int | None = None, seed: int = 14) -> dict:
     return out
 
 
+def _values_bench_cfg(on_tpu: bool, max_value_bytes: int = 1024):
+    """Round-17 value-heap bench shape: enough keys to stress the log,
+    depth-2 pipelining, 1 KB max values against an 8 MiB-capped heap
+    (the declared layouts.HEAP_REF reach)."""
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    kw = dict(n_keys=1 << 12, n_sessions=256, n_replicas=3)
+    if on_tpu:
+        kw = dict(n_keys=1 << 14, n_sessions=512, n_replicas=4)
+    return HermesConfig(
+        value_words=3, replay_slots=64, ops_per_session=256,
+        pipeline_depth=2, max_value_bytes=max_value_bytes,
+        heap_bytes=1 << 22,
+        workload=WorkloadConfig(read_frac=0.5, seed=0), **kw)
+
+
+def run_values_bench(n: int | None = None, seed: int = 17) -> dict:
+    """Round-17 value-heap cells (BENCH_VALUES.json): GB/s beside
+    writes/s — the memcached-shaped claims made measurable.
+
+      * ``put_bytes``     — N variable-length puts (ycsb.value_sizes
+                            memcached-shaped draw) through submit_batch:
+                            writes/s AND committed GB/s;
+      * ``get_bytes``     — the same keys back through the batched
+                            local-read path + mirror resolution: reads/s
+                            and served GB/s;
+      * ``device_gather`` — the raw HBM extent-gather program over the
+                            written refs (ONE gather per dispatch —
+                            OP_BUDGET heap_path): device-path GB/s;
+      * ``scan_bytes``    — full-range scans with payload resolution;
+      * ``gc``            — overwrite churn against a SMALL heap: GC
+                            count, reclaimed bytes, post-compaction
+                            utilization (live/used) — the bounded-heap
+                            proof;
+      * ``values_ok``     — spot byte-exact round-trip of sampled ops
+                            against the derived expected payloads (a
+                            FAIL gates the exit code; correctness truth
+                            at depth lives in scripts/check_heap.py).
+    """
+    import numpy as np
+
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.workload.ycsb import value_payload, value_sizes
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = _values_bench_cfg(on_tpu)
+    if n is None:
+        n = 1 << 16 if on_tpu else 1 << 13
+    rng = np.random.default_rng(seed)
+    kvs = KVS(cfg)
+
+    # keys are UNIQUE within each put chunk (a per-chunk sample without
+    # replacement): same-key writes inside one batch commit in arbiter
+    # order, not submission order, so the byte-exactness spot check needs
+    # "last chunk that wrote the key" to name ONE deterministic winner
+    chunk = 4096
+    keys = np.concatenate([
+        rng.permutation(cfg.n_keys)[: min(chunk, n - lo)]
+        for lo in range(0, n, chunk)]).astype(np.int64)
+    vlen = value_sizes(dict(n=n, max_bytes=cfg.max_value_bytes), seed)
+    payloads = [value_payload(seed, i, int(vlen[i])) for i in range(n)]
+    total_bytes = int(vlen.sum())
+
+    # warm the compiled programs out of the timed windows
+    warm = kvs.submit_batch(np.full(64, KVS.PUT, np.int32), keys[:64],
+                            payloads[:64])
+    assert kvs.run_batch(warm)
+    kvs.multi_get(keys[:chunk])
+    kvs.scan(0, cfg.n_keys)
+
+    # cell 1: variable-length puts (writes/s + GB/s)
+    t0 = time.perf_counter()
+    for lo in range(0, n, chunk):
+        bf = kvs.submit_batch(
+            np.full(min(chunk, n - lo), KVS.PUT, np.int32),
+            keys[lo: lo + chunk], payloads[lo: lo + chunk])
+        assert kvs.run_batch(bf), "value puts did not drain"
+    put_wall = time.perf_counter() - t0
+
+    # cell 2: batched local reads with payload resolution (reads/s + GB/s)
+    t0 = time.perf_counter()
+    read_bytes = 0
+    for lo in range(0, n, chunk):
+        res = kvs.multi_get(keys[lo: lo + chunk])
+        assert res.all_done()
+        read_bytes += sum(len(d) for d in res.data if d is not None)
+    get_wall = time.perf_counter() - t0
+
+    # cell 3: the raw device extent gather over the live refs
+    live = kvs.multi_get(np.unique(keys))
+    assert live.all_done(), "live-ref read did not serve locally"
+    refs = np.asarray(live.value)[:, 0]
+    refs = refs[refs != 0].astype(np.int32)
+    reps = 8 if on_tpu else 2
+    kvs.heap.device_gather(refs[: min(1024, refs.size)])  # warm/compile
+    t0 = time.perf_counter()
+    dev_bytes = 0
+    for _ in range(reps):
+        for lo in range(0, refs.size, chunk):
+            _rows, lens = kvs.heap.device_gather(refs[lo: lo + chunk])
+            dev_bytes += int(lens.sum())
+    dev_wall = time.perf_counter() - t0
+
+    # cell 4: range scans with payload resolution
+    scan_reps = 4 if not on_tpu else 16
+    t0 = time.perf_counter()
+    scan_bytes = 0
+    for _ in range(scan_reps):
+        res = kvs.scan(0, cfg.n_keys)
+        assert res.all_done()
+        scan_bytes += sum(len(d) for d in res.data if d is not None)
+    scan_wall = time.perf_counter() - t0
+
+    # cell 5: GC under overwrite churn against a small heap
+    import dataclasses as _dc
+
+    # small enough to force several compactions over the churn, with
+    # headroom for the worst-case live set (64 keys x 1 KiB max)
+    gcfg = _dc.replace(cfg, n_keys=256, n_sessions=64,
+                       heap_bytes=1 << 17)
+    gkvs = KVS(gcfg)
+    n_churn = 4096
+    gkeys = rng.integers(0, 64, size=n_churn).astype(np.int64)
+    glens = value_sizes(dict(n=n_churn, max_bytes=gcfg.max_value_bytes),
+                        seed + 1)
+    t0 = time.perf_counter()
+    for lo in range(0, n_churn, 512):
+        bf = gkvs.submit_batch(
+            np.full(min(512, n_churn - lo), KVS.PUT, np.int32),
+            gkeys[lo: lo + 512],
+            [value_payload(seed + 1, lo + j, int(glens[lo + j]))
+             for j in range(min(512, n_churn - lo))])
+        assert gkvs.run_batch(bf)
+    gc_wall = time.perf_counter() - t0
+    gkvs.heap_gc(reason="bench")
+    gstats = gkvs.heap.stats()
+
+    # spot byte-exactness: latest write per key must read back verbatim
+    last_of = {}
+    for i in range(n):
+        last_of[int(keys[i])] = i
+    sample = rng.choice(np.asarray(list(last_of.keys())),
+                        size=min(256, len(last_of)), replace=False)
+    res = kvs.multi_get(sample.astype(np.int64))
+    assert res.all_done(), "spot-check read did not serve locally"
+    values_ok = all(
+        res.data[j] == payloads[last_of[int(sample[j])]]
+        for j in range(sample.size))
+
+    gb = 1 << 30
+    cells = {
+        "put_bytes": dict(
+            ops=n, bytes=total_bytes, wall_s=round(put_wall, 4),
+            writes_per_sec=round(n / put_wall, 1),
+            gb_per_sec=round(total_bytes / put_wall / gb, 4)),
+        "get_bytes": dict(
+            ops=n, bytes=read_bytes, wall_s=round(get_wall, 4),
+            reads_per_sec=round(n / get_wall, 1),
+            gb_per_sec=round(read_bytes / get_wall / gb, 4)),
+        "device_gather": dict(
+            refs=int(refs.size) * reps, bytes=dev_bytes,
+            wall_s=round(dev_wall, 4),
+            gb_per_sec=round(dev_bytes / dev_wall / gb, 4)),
+        "scan_bytes": dict(
+            keys=scan_reps * cfg.n_keys, bytes=scan_bytes,
+            wall_s=round(scan_wall, 4),
+            gb_per_sec=round(scan_bytes / scan_wall / gb, 4)),
+        "gc": dict(
+            churn_ops=n_churn, wall_s=round(gc_wall, 4),
+            gc_runs=gstats["gc_runs"],
+            reclaimed_bytes=gstats["gc_reclaimed_bytes"],
+            live_bytes=gstats["live_bytes"],
+            post_gc_util=round(gstats["util"], 4) if gstats["util"] else None,
+            heap_bytes=gcfg.heap_bytes),
+    }
+    out = {
+        "cells": cells,
+        "writes_per_sec": cells["put_bytes"]["writes_per_sec"],
+        "put_gb_per_sec": cells["put_bytes"]["gb_per_sec"],
+        "read_gb_per_sec": cells["get_bytes"]["gb_per_sec"],
+        "device_gb_per_sec": cells["device_gather"]["gb_per_sec"],
+        "values_ok": bool(values_ok),
+        "value_size_classes": dict(
+            max_value_bytes=cfg.max_value_bytes,
+            mean_bytes=round(float(vlen.mean()), 1)),
+        "heap": kvs.heap.stats(),
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+        "shape": dict(n_keys=cfg.n_keys, n_sessions=cfg.n_sessions,
+                      n_replicas=cfg.n_replicas,
+                      heap_bytes=cfg.heap_bytes,
+                      max_value_bytes=cfg.max_value_bytes),
+        "seed": seed,
+        "note": ("round-17 value heap: GB/s beside writes/s — puts land "
+                 "extents before the INV issues (round census unchanged), "
+                 "reads resolve refs through the mirror, device_gather is "
+                 "the raw HBM log path (heap_path budget: ONE gather)"),
+    }
+    if not on_tpu:
+        out["tpu_pending"] = (
+            "host-backend stand-in at reduced shape — rerun bench.py "
+            "--values on the chip for the full-scale GB/s cells")
+    return out
+
+
 def run_chaos_soak(seed: int, rounds: int = 120, depth: int = 2,
                    warmup: int = 8) -> dict:
     """Serving rate under chaos (round-9, CHAOS_BENCH.json): the bench-
@@ -827,6 +1033,15 @@ def main() -> None:
                     "read-heavy mixes, and a checker-gated cell with "
                     "stale_read == []; writes BENCH_READS.json (host "
                     "cells carry a tpu_pending note)")
+    ap.add_argument("--values", action="store_true",
+                    help="measure the round-17 value-heap cells instead of "
+                    "the throughput mixes: variable-length put/get GB/s "
+                    "beside writes/s, the raw HBM extent-gather path, and "
+                    "GC-under-churn utilization; writes BENCH_VALUES.json "
+                    "and exits non-zero unless the sampled round trips are "
+                    "byte-exact")
+    ap.add_argument("--values-ops", type=int, default=None,
+                    help="op count for --values (default: platform-scaled)")
     ap.add_argument("--reads-ops", type=int, default=None,
                     help="read volume per --reads cell (default: "
                     "platform-sized)")
@@ -919,6 +1134,27 @@ def main() -> None:
         # slower than 5x the per-op path, or an unverified one, is a FAIL
         if (r["speedup_x"] < r["speedup_floor"] or not r["checker_ok"]
                 or not r["stale_read_clean"]):
+            sys.exit(1)
+        return
+
+    if args.values:
+        r = run_values_bench(n=args.values_ops)
+        with open("BENCH_VALUES.json", "w") as f:
+            json.dump(r, f, indent=1)
+        cell(r)
+        out.write({
+            "metric": "value_put_gb_per_sec",
+            "value": r["put_gb_per_sec"],
+            "unit": "GB/s",
+            "writes_per_sec": r["writes_per_sec"],
+            "read_gb_per_sec": r["read_gb_per_sec"],
+            "device_gb_per_sec": r["device_gb_per_sec"],
+            "gc_runs": r["cells"]["gc"]["gc_runs"],
+            "post_gc_util": r["cells"]["gc"]["post_gc_util"],
+            "values_ok": r["values_ok"],
+        })
+        # byte-inexact round trips make the GB/s numbers meaningless
+        if not r["values_ok"]:
             sys.exit(1)
         return
 

@@ -150,6 +150,17 @@ def seed_mega_replay(cfg: HermesConfig) -> list:
             pts_seed(cfg), iv(0, cfg.full_mask), I8_TOP]
 
 
+def seed_heap_gather(cfg: HermesConfig, batch: int = 1024) -> list:
+    """Bounds for ``hermes_tpu.heap.build_extent_gather(log, refs)``
+    (round-17): the log bytes are opaque (I8_TOP) and the refs span the
+    FULL declared HEAP_REF word — refs arrive from table rows a wire
+    could have corrupted, so the kernel must clamp every derived byte
+    index into the log; the analyzer proves the promised-in-bounds
+    gather from exactly this hull (scripts/check_heap.py runs it)."""
+    hi = layouts.HEAP_REF.field("gran").mask | layouts.HEAP_REF.field("len").mask
+    return [I8_TOP, iv(0, hi)]
+
+
 def seed_stats_block() -> list:
     """One AbsVal per ``core.kernels.stats_block`` argument (step,
     sess_op, invoke_step, commit, abort, read_done) — the same bounds

@@ -180,6 +180,21 @@ def build_parser() -> argparse.ArgumentParser:
                     "--read-frac sets the read share, --distribution/"
                     "--zipf-theta shape the keys (plus 'latest' via "
                     "--read-latest)")
+    ap.add_argument("--value-bytes", type=int, default=None, metavar="N",
+                    help="value-heap quickstart (round-17, hermes_tpu/"
+                    "heap): drive variable-length byte values up to N "
+                    "bytes — memcached-shaped sizes (ycsb.value_sizes) "
+                    "through submit_batch puts and batched multi_get "
+                    "reads, with a compaction at the end — and print one "
+                    "JSON summary line (writes/s, value GB/s, heap "
+                    "stats); --check additionally gates the "
+                    "linearizability checker, the stale-read check, AND "
+                    "the post-compaction heap-utilization bound.  Needs "
+                    "--value-words >= 3; fast batched backend.  "
+                    "--values-ops sizes the drive")
+    ap.add_argument("--values-ops", type=int, default=4096, metavar="N",
+                    help="op count for the --value-bytes drive "
+                    "(default 4096)")
     ap.add_argument("--read-frac", type=float, default=0.95,
                     help="read fraction of the --reads mix (default "
                     "0.95, the YCSB-B shape)")
@@ -291,6 +306,85 @@ def _run_serve(args, cfg) -> int:
         v = kvs.rt.check(max_keys=args.check_keys)
         summary["checked_ok"] = bool(v.ok)
         ok = ok and v.ok
+    summary["ok"] = bool(ok)
+    print(json.dumps(summary, default=str))
+    return 0 if ok else 1
+
+
+#: --value-bytes --check: post-compaction utilization floor (live bytes /
+#: allocated log prefix) — granule rounding is the only honest slack
+VALUES_UTIL_FLOOR = 0.75
+
+
+def _run_values(args, cfg) -> int:
+    """Value-heap quickstart (round-17): N variable-length puts
+    (memcached-shaped sizes) + batched reads + one compaction, one JSON
+    line; --check gates the linearizability checker, the stale-read
+    check, and the post-compaction heap-utilization bound."""
+    import dataclasses
+    import json
+
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.checker.fast import default_record
+    from hermes_tpu.core import layouts
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.workload.ycsb import value_payload, value_sizes
+
+    cfg = dataclasses.replace(cfg, max_value_bytes=args.value_bytes,
+                              heap_bytes=min(layouts.MAX_HEAP_BYTES, 1 << 22))
+    kvs = KVS(cfg, record=default_record(args.check))
+    n = args.values_ops
+    rng = np.random.default_rng(args.seed)
+    lens = value_sizes(dict(n=n, max_bytes=args.value_bytes), args.seed)
+    chunk = min(2048, cfg.n_keys)
+    latest = {}
+    written = 0
+    t0 = time.perf_counter()
+    for lo in range(0, n, chunk):
+        m = min(chunk, n - lo)
+        # unique keys per batch: same-key writes inside one batch commit
+        # in arbiter order, so byte-exactness needs one write per key
+        kk = rng.permutation(cfg.n_keys)[:m].astype(np.int64)
+        pays = [value_payload(args.seed, lo + j, int(lens[lo + j]))
+                for j in range(m)]
+        bf = kvs.submit_batch(np.full(m, KVS.PUT, np.int32), kk, pays)
+        if not kvs.run_batch(bf, max_steps=args.steps or 50_000):
+            print(json.dumps({"ok": False,
+                              "error": "value puts did not drain"}))
+            return 1
+        for k, p in zip(kk, pays):
+            latest[int(k)] = p
+        written += int(sum(len(p) for p in pays))
+    put_wall = time.perf_counter() - t0
+    skeys = np.asarray(sorted(latest), np.int64)
+    t0 = time.perf_counter()
+    res = kvs.multi_get(skeys)
+    if not res.all_done():
+        print(json.dumps({"ok": False, "error": "reads did not drain"}))
+        return 1
+    get_wall = time.perf_counter() - t0
+    exact = all(res.data[j] == latest[int(k)]
+                for j, k in enumerate(skeys))
+    stats = kvs.heap_gc(reason="quickstart")
+    util = (stats["live_bytes"] / stats["used_bytes"]) if stats else None
+    gb = 1 << 30
+    summary = dict(ops=n, value_bytes_cap=args.value_bytes,
+                   bytes_written=written,
+                   wall_s=round(put_wall + get_wall, 3),
+                   writes_per_sec=round(n / put_wall, 1),
+                   put_gb_per_sec=round(written / put_wall / gb, 4),
+                   byte_exact=bool(exact),
+                   heap=kvs.heap.stats(),
+                   post_gc_util=round(util, 4) if util else None)
+    ok = exact
+    if args.check:
+        v = kvs.rt.check(max_keys=args.check_keys)
+        stale = lin.stale_read(kvs.rt.history_ops())
+        summary["checked_ok"] = bool(v.ok)
+        summary["stale_read"] = [repr(e) for e in stale[:4]]
+        summary["util_floor"] = VALUES_UTIL_FLOOR
+        ok = (ok and bool(v.ok) and not stale
+              and util is not None and util >= VALUES_UTIL_FLOOR)
     summary["ok"] = bool(ok)
     print(json.dumps(summary, default=str))
     return 0 if ok else 1
@@ -552,6 +646,24 @@ def main(argv=None) -> int:
                 or args.freeze):
             ap.error("--reads is its own drive; drop --acceptance/--drill/"
                      "--fleet-groups/--serve/--chaos/--freeze")
+    if args.value_bytes is not None:
+        if args.value_bytes < 1:
+            ap.error("--value-bytes wants a positive byte cap")
+        if args.values_ops < 1:
+            ap.error("--values-ops wants a positive op count")
+        if args.backend != "fast":
+            ap.error("--value-bytes drives the fast batched backend "
+                     "through the KVS facade (hermes_tpu/heap)")
+        if args.value_words < 3:
+            ap.error("--value-bytes needs --value-words >= 3 (words 0-1 "
+                     "carry the write uid, word 2 the packed heap ref)")
+        if (args.acceptance or args.drill or args.fleet_groups
+                or args.serve is not None or args.bench_latency
+                or args.reads is not None or args.chaos is not None
+                or args.chaos_schedule or args.freeze):
+            ap.error("--value-bytes is its own drive; drop --acceptance/"
+                     "--drill/--fleet-groups/--serve/--reads/--chaos/"
+                     "--freeze")
     chaos_on = args.chaos is not None or args.chaos_schedule
     if chaos_on:
         if args.backend not in ("fast", "fast-sharded"):
@@ -669,6 +781,9 @@ def main(argv=None) -> int:
 
     if args.reads is not None:
         return _run_reads(args, cfg)
+
+    if args.value_bytes is not None:
+        return _run_values(args, cfg)
 
     if args.bench_latency:
         return _run_bench_latency(args, cfg)

@@ -252,6 +252,27 @@ class HermesConfig:
     # obs timeline as ``degraded``/``degraded_clear``.  0 disables.
     min_healthy_for_writes: int = 0
 
+    # Round-17 value heap (hermes_tpu/heap): variable-length byte values
+    # up to this many bytes per key, stored in an HBM-resident
+    # log-structured append heap (MICA-style, PAPER.md's KVS substrate)
+    # instead of fixed config-width words.  The key's row carries ONE
+    # packed (granule | length) ref word (core/layouts.py HEAP_REF) in
+    # its first payload slot; the extent bytes land in the heap BEFORE
+    # the INV issues, so the round moves only the ref word and the op
+    # census is provably unchanged (scripts/check_op_census.py's round
+    # sections do not move; the heap's own programs are budgeted under
+    # the heap_path/heap_append sections).  0 disables (the pre-round-17
+    # fixed-word store — every existing driver unchanged).  Heap mode
+    # needs value_words >= 3 (payload word 0 carries the ref) and is a
+    # KVS-level subsystem: stream-driven runs have no byte payloads.
+    max_value_bytes: int = 0
+    # Heap log capacity in bytes (heap mode only): granule-aligned
+    # (layouts.HEAP_GRANULE), capped by the declared 19-bit granule
+    # field at layouts.MAX_HEAP_BYTES (8 MiB).  Dead extents (overwritten
+    # values) are reclaimed by compaction at version-rebase boundaries
+    # and on allocation pressure (kvs.KVS.heap_gc).
+    heap_bytes: int = 1 << 22
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or
@@ -329,6 +350,38 @@ class HermesConfig:
             )
         if self.value_words < 2:
             raise ValueError("value_words >= 2 (words 0-1 carry the unique write id)")
+        if self.max_value_bytes < 0:
+            raise ValueError("max_value_bytes must be >= 0 (0 disables the heap)")
+        if self.max_value_bytes:
+            if self.value_words < 3:
+                raise ValueError(
+                    "the value heap needs value_words >= 3 (2 uid words + "
+                    "the packed heap-ref payload word, layouts.HEAP_REF)")
+            if self.max_value_bytes > layouts.MAX_VALUE_BYTES:
+                raise ValueError(
+                    f"max_value_bytes {self.max_value_bytes} exceeds the "
+                    f"declared heap-ref len field "
+                    f"({layouts.MAX_VALUE_BYTES} bytes — core/layouts.py "
+                    "HEAP_REF)")
+            if self.heap_bytes % layouts.HEAP_GRANULE:
+                raise ValueError(
+                    f"heap_bytes must be a multiple of the "
+                    f"{layouts.HEAP_GRANULE}-byte heap granule")
+            if self.heap_bytes > layouts.MAX_HEAP_BYTES:
+                raise ValueError(
+                    f"heap_bytes {self.heap_bytes} exceeds the declared "
+                    f"granule field's reach ({layouts.MAX_HEAP_BYTES} "
+                    "bytes — core/layouts.py HEAP_REF)")
+            # granule 0 is the null-ref sentinel; the log must hold at
+            # least two max-size extents beyond it or the allocator can
+            # never even double-buffer one value across a compaction
+            if self.heap_bytes < layouts.HEAP_GRANULE + 2 * (
+                    (self.max_value_bytes + layouts.HEAP_GRANULE - 1)
+                    // layouts.HEAP_GRANULE) * layouts.HEAP_GRANULE:
+                raise ValueError(
+                    f"heap_bytes {self.heap_bytes} cannot hold two "
+                    f"max_value_bytes={self.max_value_bytes} extents plus "
+                    "the reserved null granule")
         # Unique write ids are (hi=replica, lo=session*G+op) int32 pairs.
         if self.n_sessions * self.ops_per_session >= 2**31:
             raise ValueError("n_sessions * ops_per_session must fit int32")
@@ -374,6 +427,17 @@ class HermesConfig:
         per backend) lives in ``core/megaround.resolve``; the fused-sort
         program is the automatic fallback."""
         return self.mega_round and self.use_fused_sort
+
+    @property
+    def use_heap(self) -> bool:
+        """Round-17 value-heap switch: variable-length byte values through
+        the HBM append log (hermes_tpu/heap)."""
+        return self.max_value_bytes > 0
+
+    @property
+    def heap_granules(self) -> int:
+        """Heap log capacity in granules (granule 0 = the null ref)."""
+        return self.heap_bytes // layouts.HEAP_GRANULE
 
     @property
     def lane_budget(self) -> int:

@@ -168,6 +168,26 @@ BLOCK_META = Layout("block_meta", "INV block scalars (epoch | alive)", (
     Field("epoch", 1, 30),
 ))
 
+#: Round-17 value-heap extent reference (hermes_tpu/heap): the MICA-style
+#: variable-length value of a key travels the protocol as ONE packed word
+#: in the row's first payload slot — ``(granule index << 12) | byte
+#: length`` into the replica's HBM-resident append log.  The heap write
+#: lands the extent BEFORE the INV issues, so the wire moves only this
+#: word and the round census is untouched.  ``len`` bounds
+#: ``config.max_value_bytes`` (exclusive cap 4096); ``gran`` bounds the
+#: log capacity at 2^19 granules x HEAP_GRANULE bytes.  Granule 0 is
+#: reserved: ref word 0 == "no extent" (the zero-initialized bank row),
+#: so appends start at granule 1.  Sign bit stays clear — the word rides
+#: int32 value columns the analyzer's bitpack pass proves.
+HEAP_REF = Layout("heap_ref", "value-heap extent ref (gran | len)", (
+    Field("len", 0, 12),    # extent byte length; bounds max_value_bytes
+    Field("gran", 12, 19),  # granule index; bounds heap_bytes/HEAP_GRANULE
+))
+
+#: Value-heap allocation granule (bytes): extents are granule-aligned so
+#: the 19-bit gran field addresses HEAP_GRANULE * 2^19 = 8 MiB of log.
+HEAP_GRANULE = 16
+
 #: Split-path single-operand compaction key (faststep._coordinate, C < L):
 #: (band | rotation | lane) with lane/rotation widths chosen per shape at
 #: trace time — declared here as a NOTE, not a fixed layout: the analyzer
@@ -210,7 +230,7 @@ STATS_CTR = RowTable("stats_ctr", "stats_block packed counter rows", (
 ), width=8)
 
 ALL = (PTS, SST, INV_PKF, ACK_PKF, FUSED_KEY, LANE_WORD, ARB_WORD,
-       SLOT_ACK, BLOCK_META)
+       SLOT_ACK, BLOCK_META, HEAP_REF)
 for _l in ALL:
     _l.validate()
 STATS_CTR.validate()
@@ -233,6 +253,12 @@ MAX_KEY_VERSIONS = 1 << (PTS.field("ver").bits - 1)
 
 SST_STATE_BITS = SST.field("state").shift + 0  # == 3
 MAX_STEPS = SST.field("step").cap  # analyzer seed bound for ctl.step
+
+#: Value-heap budgets derived from the declared ref word (round-17):
+#: config validation and the heap allocator both read these — a field
+#: edit here moves every bound with it.
+MAX_VALUE_BYTES = HEAP_REF.field("len").cap - 1
+MAX_HEAP_BYTES = HEAP_GRANULE * HEAP_REF.field("gran").cap
 
 #: Anti-starvation rotation stride (fused + split compaction paths): the
 #: priority rotation advances by ROT_STRIDE lanes/keys per round.  The

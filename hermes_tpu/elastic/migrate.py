@@ -130,6 +130,17 @@ def migrate_range(src, dst, lo: int, hi: int, router=None,
     if src_rt.cfg.value_words != dst_rt.cfg.value_words:
         raise ValueError("source and destination value_words differ; rows "
                          "are not portable across value widths")
+    if (src_kvs.heap is None) != (dst_kvs.heap is None):
+        raise ValueError(
+            "source and destination must agree on value-heap mode "
+            "(cfg.max_value_bytes): a packed heap ref is meaningless in a "
+            "fixed-word store and vice versa")
+    if src_kvs.heap is not None and (
+            src_rt.cfg.max_value_bytes > dst_rt.cfg.max_value_bytes):
+        raise ValueError(
+            f"destination max_value_bytes={dst_rt.cfg.max_value_bytes} "
+            f"cannot hold the source's {src_rt.cfg.max_value_bytes}-byte "
+            "extents")
     if (src_kvs.index is None) != (dst_kvs.index is None):
         raise ValueError("source and destination must agree on sparse-key "
                          "mode (the client-key remap needs both indexes)")
@@ -243,7 +254,10 @@ def migrate_range(src, dst, lo: int, hi: int, router=None,
         if path is None:
             tmp_dir = tempfile.mkdtemp(prefix="hermes_migrate_")
             path = os.path.join(tmp_dir, f"range_{lo}_{hi}.npz")
-        manifest = snapshot_lib.save_range(path, src_rt, lo, hi)
+        # the FACADE is passed so heap-mode extents ride the archive
+        # (snapshot.save_range captures the range's live value bytes
+        # beside the rows, under the same checksummed manifest)
+        manifest = snapshot_lib.save_range(path, src_kvs, lo, hi)
         summary["archive_step"] = manifest["step"]
 
         # -- transfer: verify + read back + re-map + re-mint uids -----------
@@ -264,6 +278,36 @@ def migrate_range(src, dst, lo: int, hi: int, router=None,
         rows32[:, fst.BANK_VAL + 1] = np.int32(mig_hi)
         uids = np.stack([dest_slots.astype(np.int32),
                          np.full(dest_slots.size, mig_hi, np.int32)], axis=1)
+        if dst_kvs.heap is not None:
+            # value heap (round-17): re-append the archived extents into
+            # the DESTINATION's log and re-point the rows' ref words —
+            # source refs name source granules and mean nothing here.
+            # Appends before the flip are safe on the abort path: rows
+            # that never become reachable leave dead extents the next
+            # destination GC reclaims.
+            heap_ext = snapshot_lib.read_range_heap(path)
+            if heap_ext is None:
+                raise RuntimeError(
+                    "heap-mode migration needs a heap section in the "
+                    "range archive (source saved without its facade?)")
+            from hermes_tpu.heap import HeapFull
+
+            _lens, extents = heap_ext
+            newrefs = np.zeros(dest_slots.size, np.int32)
+            # newrefs is a GC root WHILE the transfer is still staging: a
+            # HeapFull mid-loop compacts the destination, and the refs
+            # already appended here must survive it remapped
+            with dst_kvs._heap_staging(newrefs):
+                for i, ext in enumerate(extents):
+                    if ext is not None:
+                        try:
+                            newrefs[i] = dst_kvs.heap.append(ext)
+                        except HeapFull:
+                            dst_kvs.heap_gc(reason="migrate")
+                            newrefs[i] = dst_kvs.heap.append(ext)
+            rows32[:, fst.BANK_VAL + 2] = newrefs
+            summary["heap_extents"] = int(sum(
+                1 for e in extents if e is not None))
 
         # -- restore: rows + version re-anchoring + history preload ---------
         snapshot_lib.write_rows(dst_rt, dest_slots, vpts, rows32)

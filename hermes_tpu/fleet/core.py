@@ -103,6 +103,9 @@ class FleetBatch:
         self.uid = np.zeros((n, 2), np.int32)
         self.found = np.ones(n, bool)
         self.step = np.full(n, -1, np.int32)
+        # value heap (round-17): per-op byte payloads merged from the
+        # owning groups' eager resolutions
+        self.data: List[Optional[bytes]] = [None] * n
         # (group, sub BatchFutures, fleet indices of its ops)
         self._subs: List[tuple] = []
 
@@ -120,6 +123,9 @@ class FleetBatch:
                 self.uid[di] = bf.uid[done]
                 self.found[di] = bf.found[done]
                 self.step[di] = bf.step[done]
+                if bf._heap is not None:
+                    for j, i in zip(np.nonzero(done)[0], di):
+                        self.data[int(i)] = bf.data[int(j)]
 
     def done_count(self) -> int:
         self._pull()
@@ -136,6 +142,7 @@ class FleetBatch:
         view = BatchFutures(self.kind, self.key, self.value.shape[1])
         view.code, view.value, view.uid = self.code, self.value, self.uid
         view.found, view.step = self.found, self.step
+        view.data = self.data
         return view.completion(i)
 
 
@@ -165,6 +172,9 @@ class FleetReads(MultiGetResult):
                 self.found[di] = sub.found[done]
                 self.local[di] = sub.local[done]
                 self.step[di] = sub.step[done]
+                if sub._heap is not None:
+                    for j, i in zip(np.nonzero(done)[0], di):
+                        self.data[int(i)] = sub.data[int(j)]
 
     @property
     def local_served(self) -> int:
@@ -200,6 +210,14 @@ class Fleet:
                 "(launch.fleet_meshes builds the (groups, replicas) grid)")
         self.cfg = fcfg
         self.backend = backend
+        # value heap (round-17): heap mode must be fleet-uniform — a
+        # cross-group migration re-appends extents into the destination's
+        # log, which only exists when every group runs one
+        for g in range(fcfg.groups):
+            if fcfg.group_cfg(g).use_heap != fcfg.base.use_heap:
+                raise ValueError(
+                    f"group {g} disagrees with the fleet on value-heap "
+                    "mode (max_value_bytes): heap mode is fleet-uniform")
         self.router = FleetRouter.from_config(fcfg)
         self.groups: List[_Group] = []
         devs = []
@@ -316,10 +334,13 @@ class Fleet:
         gids, slots = self.router.locate(keys)
         gids = np.asarray(gids, np.int32).copy()
         u = self.cfg.base.value_words - 2
+        heap_mode = self.cfg.base.use_heap
         uval = np.zeros((n, u), np.int32)
-        if values is not None:
+        if values is not None and not heap_mode:
             v = np.asarray(values, np.int32)
             uval[:, : v.shape[1]] = v
+        elif values is not None and len(values) != n:
+            raise ValueError(f"values must carry {n} byte payloads")
         fb = FleetBatch(kinds, keys.copy(), gids, u)
         draining = np.asarray(self.router.draining(keys), bool)
         if draining.any():
@@ -333,7 +354,16 @@ class Fleet:
                 continue
             gix = np.nonzero(mine)[0]
             with grp.ctx():
-                bf = grp.kvs.submit_batch(kinds[gix], slots[gix], uval[gix])
+                if heap_mode:
+                    # byte payloads route verbatim: each owning group's
+                    # KVS appends the extent into ITS OWN heap (refs are
+                    # group-local — per-group logs, per-group GC)
+                    share = (None if values is None
+                             else [values[int(i)] for i in gix])
+                    bf = grp.kvs.submit_batch(kinds[gix], slots[gix], share)
+                else:
+                    bf = grp.kvs.submit_batch(kinds[gix], slots[gix],
+                                              uval[gix])
             fb._subs.append((grp.gid, bf, gix))
         return fb
 

@@ -1,0 +1,18 @@
+"""HBM value heap (round-17): MICA-style variable-length values behind
+one packed ref word per key.  See heap/core.py for the design notes."""
+
+from hermes_tpu.heap.core import (  # noqa: F401
+    GRANULE,
+    HeapFull,
+    MIN_BATCH,
+    ValueHeap,
+    analyze_gather,
+    append_census,
+    build_append,
+    build_extent_gather,
+    cap_bytes,
+    gather_census,
+    pack_ref,
+    ref_gran,
+    ref_len,
+)

@@ -1,0 +1,413 @@
+"""Round-17: the HBM value heap (ROADMAP item 3 — MICA-style values).
+
+PAPER.md frames Hermes as membership-based invalidation replication over
+a MICA-style KVS, whose values are variable-length byte payloads in a
+log-structured store.  Through round 16 this rebuild stored values as
+fixed config-width words, so every "memcached-shaped" claim (tens of
+bytes-KB payloads, GB/s served) was untestable.  This module is that
+missing storage layer:
+
+  * ``ValueHeap`` — a per-store append log: extents of up to
+    ``config.max_value_bytes`` bytes land at a granule-aligned bump
+    cursor; each extent is named by ONE packed int32 ref word
+    ``(granule << 12) | byte_length`` (the declared ``layouts.HEAP_REF``
+    word — ref 0 is the null sentinel, granule 0 reserved).  The host
+    mirror is authoritative for writes (the client layer appends BEFORE
+    the INV issues — the out-of-band bulk value transfer of an
+    RDMA/MICA deployment); the device log is the SAME bytes, synced
+    with one dense ``dynamic_update_slice`` of the dirty tail, and
+    serves the batched device-resident read path.
+
+  * ``build_extent_gather`` — ONE dynamic gather answers a whole batch
+    of refs from the device log: unpack (shift/mask the declared
+    fields), clamp every byte index into the log (untrusted refs can
+    never gather out of bounds — the round-3 wire-clamp rule), mask the
+    tail past each extent's length.  Budgeted under OP_BUDGET.json's
+    ``heap_path`` section (sparse_total 1); the append program is dense
+    (``heap_append``: sparse_total 0).  The ROUND census does not move
+    at all: the protocol carries only the ref word in an existing
+    payload slot.
+
+  * ``compact`` — GC: dead extents (overwritten values, lost writes)
+    are reclaimed by copying the LIVE extents (every ref reachable from
+    table rows, staged streams, queued client ops) to the front of a
+    fresh log and remapping the ref words in place.  The client layer
+    (kvs.KVS.heap_gc) drives it at version-rebase boundaries and on
+    allocation pressure, under the same quiesce the rebase uses, with a
+    ``heap_gc`` span and ``heap_util`` gauge on the obs timeline.
+
+Consistency: an extent is immutable once appended (a new value = a new
+extent + a new ref word through the normal INV/ACK/VAL round), so the
+ref word inherits the row's linearizability — readers observe (uid, ref)
+atomically from the committed row, and the bytes behind a ref never
+change until a compaction, which only runs with the store quiesced and
+every completion resolved.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from hermes_tpu.config import HermesConfig
+from hermes_tpu.core import layouts
+
+GRANULE = layouts.HEAP_GRANULE
+_LEN = layouts.HEAP_REF.field("len")
+_GRAN = layouts.HEAP_REF.field("gran")
+
+
+class HeapFull(RuntimeError):
+    """The append log is out of granules even after compaction: the LIVE
+    value bytes exceed ``config.heap_bytes``.  Loud by design — a full
+    store must refuse writes, never silently drop payload bytes."""
+
+
+def pack_ref(gran: int, length: int) -> int:
+    """Pack an extent ref word from the declared fields."""
+    return (int(gran) << _GRAN.shift) | int(length)
+
+
+def ref_len(ref) -> int:
+    """Extent byte length of a packed ref (field ``len``)."""
+    return ref & _LEN.mask
+
+
+def ref_gran(ref) -> int:
+    """Granule index of a packed ref (field ``gran``)."""
+    return (ref >> _GRAN.shift) & (_GRAN.cap - 1)
+
+
+def cap_bytes(cfg: HermesConfig) -> int:
+    """Word-aligned per-extent gather width (the compiled row extent)."""
+    return 4 * ((cfg.max_value_bytes + 3) // 4)
+
+
+# --------------------------------------------------------------------------
+# Device programs (compiled per shape, cached — the readpath discipline)
+# --------------------------------------------------------------------------
+
+#: Smallest compiled ref-batch bucket (matches readpath.MIN_BATCH's role).
+MIN_BATCH = 256
+
+
+def _batch_bucket(n: int) -> int:
+    b = MIN_BATCH
+    while b < n:
+        b <<= 1
+    return b
+
+
+@functools.lru_cache(maxsize=None)
+def build_extent_gather(heap_bytes: int, cap: int, batch: int):
+    """Compile the batched extent gather: ``fn(log, refs) -> (rows, lens)``
+    answering ``batch`` packed refs with ONE dynamic gather of ``cap``
+    bytes each from the ``(heap_bytes,)`` int8 log.  Refs are UNTRUSTED:
+    the granule and length unpack through the declared field masks and
+    every byte index clamps into the log (promised-in-bounds — the
+    analyzer's scatter/gather pass proves it from the seeded ref bound,
+    scripts/check_heap.py), and bytes past each extent's length are
+    masked to zero so an over-wide gather can never leak a neighbor's
+    bytes."""
+    import jax
+    import jax.numpy as jnp
+
+    def gather(log, refs):
+        refs = refs.astype(jnp.int32)
+        lens = jnp.clip(refs & jnp.int32(_LEN.mask), 0, cap)
+        gran = (refs >> _GRAN.shift) & jnp.int32(_GRAN.cap - 1)
+        start = gran * jnp.int32(GRANULE)
+        off = jnp.arange(cap, dtype=jnp.int32)
+        idx = jnp.minimum(start[:, None] + off[None, :],
+                          jnp.int32(heap_bytes - 1))
+        rows = log[idx]  # the ONE sparse op (heap_path budget)
+        rows = jnp.where(off[None, :] < lens[:, None], rows, jnp.int8(0))
+        return rows, lens
+
+    return jax.jit(gather)
+
+
+@functools.lru_cache(maxsize=None)
+def build_append(heap_bytes: int, chunk: int):
+    """Compile the log append: ``fn(log, chunk_bytes, start) -> log`` —
+    one dense ``dynamic_update_slice`` of a ``chunk``-byte tail (the
+    ``heap_append`` budget: ZERO sparse ops).  The log buffer is donated:
+    appends bump a cursor, they never copy the heap."""
+    import jax
+    import jax.numpy as jnp
+
+    def append(log, data, start):
+        return jax.lax.dynamic_update_slice(log, data, (start,))
+
+    return jax.jit(append, donate_argnums=(0,))
+
+
+def gather_census(cfg: HermesConfig, batch: int = 1024) -> dict:
+    """StableHLO op census of ONE extent-gather dispatch (the
+    measurement half of OP_BUDGET.json's ``heap_path`` section)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hermes_tpu.obs.profile import census_text
+
+    fn = build_extent_gather(cfg.heap_bytes, cap_bytes(cfg), batch)
+    txt = fn.lower(jax.ShapeDtypeStruct((cfg.heap_bytes,), jnp.int8),
+                   jax.ShapeDtypeStruct((batch,), jnp.int32)).as_text()
+    return census_text(txt)
+
+
+def append_census(cfg: HermesConfig, chunk: int = 4096) -> dict:
+    """Census of one log-append dispatch (``heap_append``: dense only)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hermes_tpu.obs.profile import census_text
+
+    fn = build_append(cfg.heap_bytes, chunk)
+    txt = fn.lower(jax.ShapeDtypeStruct((cfg.heap_bytes,), jnp.int8),
+                   jax.ShapeDtypeStruct((chunk,), jnp.int8),
+                   jnp.int32(0)).as_text()
+    return census_text(txt)
+
+
+def analyze_gather(cfg: HermesConfig, batch: int = 1024) -> list:
+    """Run the static invariant analyzer over the extent-gather program
+    with the config-seeded ref bound (analysis/seeds.seed_heap_gather):
+    the bitpack pass proves the field unpacks respect the declared
+    layout and the gather indices are promised-in-bounds.  Returns the
+    findings list (empty = clean)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hermes_tpu.analysis import seeds as seeds_lib
+    from hermes_tpu.analysis.interp import Ctx, eval_jaxpr
+    from hermes_tpu.analysis.passes import default_passes
+
+    fn = build_extent_gather(cfg.heap_bytes, cap_bytes(cfg), batch)
+    closed = jax.make_jaxpr(fn)(
+        jax.ShapeDtypeStruct((cfg.heap_bytes,), jnp.int8),
+        jax.ShapeDtypeStruct((batch,), jnp.int32))
+    passes = default_passes()
+    ctx = Ctx(cfg=cfg, mesh_axes={}, passes=passes, donated=frozenset())
+    eval_jaxpr(closed.jaxpr, list(seeds_lib.seed_heap_gather(cfg, batch)),
+               ctx, consts=list(closed.consts))
+    findings = []
+    for p in passes:
+        p.finalize(ctx)
+        for f in p.results():
+            f.engine = "heap/gather"
+            findings.append(f)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# The heap
+# --------------------------------------------------------------------------
+
+
+class ValueHeap:
+    """One store's value log: host mirror (authoritative, append-ordered)
+    + lazily-synced device log.  NOT thread-safe — it lives under the
+    KVS's single-threaded step loop like every other host structure."""
+
+    def __init__(self, cfg: HermesConfig):
+        if not cfg.use_heap:
+            raise ValueError("ValueHeap needs cfg.max_value_bytes > 0")
+        self.cfg = cfg
+        self.capacity = cfg.heap_bytes
+        self.granules = cfg.heap_granules
+        self.cap = cap_bytes(cfg)
+        self._mirror = np.zeros(cfg.heap_bytes, np.uint8)
+        self._cursor = 1       # granules; granule 0 = the null-ref sentinel
+        self._synced = 1       # granules already uploaded to the device log
+        self._dev = None       # lazy device-resident log
+        self.appends = 0
+        self.append_bytes = 0
+        self.gc_runs = 0
+        self.gc_reclaimed_bytes = 0
+        self.live_bytes = 0    # as of the last compaction (gauge input)
+        self.gather_dispatches = 0
+
+    # -- allocation ----------------------------------------------------------
+
+    def used_bytes(self) -> int:
+        return self._cursor * GRANULE
+
+    def free_bytes(self) -> int:
+        return (self.granules - self._cursor) * GRANULE
+
+    def _granules_for(self, nbytes: int) -> int:
+        return max(1, (nbytes + GRANULE - 1) // GRANULE)
+
+    def append(self, data) -> int:
+        """Land one extent at the bump cursor; returns its packed ref
+        word.  Raises ``HeapFull`` when the log is out of granules (the
+        caller compacts and retries — kvs.KVS drives that) and
+        ``ValueError`` on an over-long payload (a config contract, not a
+        capacity condition)."""
+        raw = bytes(data)
+        if len(raw) > self.cfg.max_value_bytes:
+            raise ValueError(
+                f"value is {len(raw)} bytes > max_value_bytes="
+                f"{self.cfg.max_value_bytes}")
+        need = self._granules_for(len(raw))
+        if self._cursor + need > self.granules:
+            raise HeapFull(
+                f"value heap out of space: {len(raw)}-byte extent needs "
+                f"{need} granule(s), {self.granules - self._cursor} free "
+                f"of {self.granules} (heap_bytes={self.capacity})")
+        ref = pack_ref(self._cursor, len(raw))
+        start = self._cursor * GRANULE
+        self._mirror[start:start + len(raw)] = np.frombuffer(raw, np.uint8)
+        self._cursor += need
+        self.appends += 1
+        self.append_bytes += len(raw)
+        return ref
+
+    # -- reads ---------------------------------------------------------------
+
+    def _check_ref(self, ref: int) -> Tuple[int, int]:
+        gran, ln = ref_gran(ref), ref_len(ref)
+        if not (1 <= gran < self._cursor) or gran * GRANULE + ln > \
+                self._cursor * GRANULE:
+            raise ValueError(
+                f"dangling heap ref 0x{ref:08x} (gran={gran}, len={ln}, "
+                f"cursor={self._cursor}): the extent is not inside the "
+                "allocated log — row corruption or a missed GC remap")
+        return gran, ln
+
+    def read(self, ref: int) -> bytes:
+        """The extent bytes behind one packed ref (host mirror)."""
+        gran, ln = self._check_ref(int(ref))
+        start = gran * GRANULE
+        return self._mirror[start:start + ln].tobytes()
+
+    def read_many(self, refs) -> List[Optional[bytes]]:
+        """Mirror reads for a ref vector; ``None`` for null refs (the
+        never-written row)."""
+        return [None if int(r) == 0 else self.read(int(r)) for r in refs]
+
+    # -- the device log ------------------------------------------------------
+
+    def device_log(self):
+        """The HBM-resident log, dirty tail synced with ONE dense
+        ``dynamic_update_slice`` (no per-extent uploads: appends since
+        the last sync are contiguous by construction)."""
+        import jax.numpy as jnp
+
+        if self._dev is None:
+            self._dev = jnp.asarray(self._mirror.view(np.int8))
+            self._synced = self._cursor
+            return self._dev
+        if self._synced < self._cursor:
+            lo, hi = self._synced * GRANULE, self._cursor * GRANULE
+            chunk = min(_batch_bucket(hi - lo), self.capacity)
+            start = max(0, min(lo, self.capacity - chunk))
+            fn = build_append(self.capacity, chunk)
+            self._dev = fn(
+                self._dev,
+                jnp.asarray(self._mirror[start:start + chunk].view(np.int8)),
+                jnp.int32(start))
+            self._synced = self._cursor
+        return self._dev
+
+    def device_gather(self, refs) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched extent fetch through the DEVICE log (the GB/s path the
+        bench measures and the gate cross-checks against the mirror):
+        returns ``(rows (n, cap) uint8 zero-masked past each length,
+        lens (n,))``."""
+        import jax
+
+        refs = np.asarray(refs, np.int32)
+        n = refs.shape[0]
+        b = _batch_bucket(n)
+        padded = np.zeros(b, np.int32)
+        padded[:n] = refs
+        fn = build_extent_gather(self.capacity, self.cap, b)
+        rows, lens = jax.device_get(fn(self.device_log(), padded))
+        self.gather_dispatches += 1
+        return (np.asarray(rows)[:n].view(np.uint8),
+                np.asarray(lens)[:n])
+
+    # -- compaction (GC) -----------------------------------------------------
+
+    def compact(self, roots) -> Tuple[np.ndarray, np.ndarray]:
+        """Copy the live extents (the unique non-null refs of ``roots``)
+        to the front of a fresh log in allocation order and reset the
+        bump cursor behind them.  Returns ``(old_refs, new_refs)`` sorted
+        by ``old_refs`` — feed any ref array through ``remap`` to follow
+        the move.  The device log is invalidated (re-synced lazily).
+        The caller owns quiescence: every live ref must be IN ``roots``
+        (kvs.KVS.heap_gc collects table rows + staged streams + queued
+        client ops under the rebase quiesce)."""
+        roots = np.asarray(roots, np.int64).ravel()
+        old = np.unique(roots[roots != 0]).astype(np.int64)
+        grans = (old >> _GRAN.shift) & (_GRAN.cap - 1)
+        lens = old & _LEN.mask
+        order = np.argsort(grans, kind="stable")
+        new_mirror = np.zeros(self.capacity, np.uint8)
+        new_refs = np.zeros(old.shape[0], np.int64)
+        cursor = 1
+        for j in order:
+            g, ln = int(grans[j]), int(lens[j])
+            if not (1 <= g < self._cursor):
+                raise ValueError(
+                    f"GC root 0x{int(old[j]):08x} is dangling (gran={g}, "
+                    f"cursor={self._cursor})")
+            need = self._granules_for(ln)
+            src = g * GRANULE
+            dst = cursor * GRANULE
+            new_mirror[dst:dst + ln] = self._mirror[src:src + ln]
+            new_refs[j] = pack_ref(cursor, ln)
+            cursor += need
+        reclaimed = (self._cursor - cursor) * GRANULE
+        self._mirror = new_mirror
+        self._cursor = cursor
+        self._dev = None
+        self._synced = 1
+        self.gc_runs += 1
+        self.gc_reclaimed_bytes += max(0, reclaimed)
+        self.live_bytes = int(lens.sum())
+        return old, new_refs
+
+    @staticmethod
+    def remap(refs, old: np.ndarray, new: np.ndarray) -> np.ndarray:
+        """Apply a compaction's (old, new) ref mapping to an int array;
+        null refs stay null, unknown refs raise (they were not rooted —
+        a GC soundness bug, never silently preserved)."""
+        refs = np.asarray(refs)
+        out = refs.astype(np.int64).copy()
+        nz = out != 0
+        if nz.any():
+            idx = np.searchsorted(old, out[nz])
+            bad = (idx >= old.shape[0])
+            safe = np.where(bad, 0, idx)
+            bad |= old[safe] != out[nz]
+            if bad.any():
+                raise ValueError(
+                    f"{int(bad.sum())} ref(s) missing from the GC root set "
+                    "(first: 0x%08x)" % int(out[nz][bad][0]))
+            out[nz] = new[idx]
+        return out.astype(refs.dtype, copy=False)
+
+    # -- accounting ----------------------------------------------------------
+
+    def stats(self) -> dict:
+        used = self.used_bytes()
+        return dict(
+            capacity_bytes=self.capacity,
+            used_bytes=used,
+            free_bytes=self.free_bytes(),
+            appends=self.appends,
+            append_bytes=self.append_bytes,
+            gc_runs=self.gc_runs,
+            gc_reclaimed_bytes=self.gc_reclaimed_bytes,
+            live_bytes=self.live_bytes,
+            # post-GC utilization: live bytes over the allocated prefix
+            # (1.0 = perfectly compacted modulo granule rounding); the
+            # heap_util GAUGE on the obs timeline is live/capacity —
+            # how full the log is, the operator's headroom number
+            util=(self.live_bytes / used) if self.live_bytes else None,
+        )

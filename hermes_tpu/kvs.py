@@ -35,6 +35,7 @@ Usage::
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -91,6 +92,12 @@ class Completion:
     kind: str
     key: int
     value: Optional[List[int]] = None  # payload read (get / rmw read-part)
+    # value heap (round-17, cfg.max_value_bytes > 0): the variable-length
+    # byte payload behind the row's packed heap ref — what a heap-mode
+    # get/rmw read-part actually returns (``value`` then carries the raw
+    # payload words, word 0 being the ref).  None = the key was never
+    # written (the null ref).
+    data: Optional[bytes] = None
     uid: Optional[Tuple[int, int]] = None  # unique id of the written value
     step: int = -1
     # sparse-key mode only: False when a get probed a key never written
@@ -135,7 +142,8 @@ class BatchFutures:
     ``future(i)`` materializes a classic per-op Future view lazily for
     callers that want one."""
 
-    def __init__(self, kinds: np.ndarray, keys: np.ndarray, u: int):
+    def __init__(self, kinds: np.ndarray, keys: np.ndarray, u: int,
+                 heap=None):
         n = kinds.shape[0]
         self.kind = kinds
         self.key = keys
@@ -143,6 +151,12 @@ class BatchFutures:
         self.value = np.zeros((n, u), np.int32)
         self.uid = np.zeros((n, 2), np.int32)
         self.found = np.ones(n, bool)
+        # heap mode (round-17): per-op byte payloads, resolved EAGERLY at
+        # completion time off the mirror (an extent referenced by a read
+        # stays immutable until the next GC, which flushes completions
+        # first — resolving late could cross a compaction)
+        self._heap = heap
+        self.data: List[Optional[bytes]] = [None] * n
         # completing protocol round per op (-1 while pending / for reads
         # completed without a round) — parity with the per-op path's
         # Completion.step, so batched callers keep step observability
@@ -179,6 +193,7 @@ class BatchFutures:
                           step=int(self.step[i]), found=bool(self.found[i]))
         if c in (t.C_READ, t.C_RMW) and self.found[i]:
             done.value = self.value[i].tolist()
+            done.data = self.data[i]
         if c in (t.C_WRITE, t.C_RMW):
             done.uid = (int(self.uid[i, 0]), int(self.uid[i, 1]))
             done.ts = (int(self.tsv[i]), int(self.tsf[i]))
@@ -210,7 +225,7 @@ class MultiGetResult:
     fallback ``BatchFutures`` through the normal round path — drive it
     with ``KVS.step()`` / ``run_reads`` until ``all_done()``."""
 
-    def __init__(self, keys: np.ndarray, u: int):
+    def __init__(self, keys: np.ndarray, u: int, heap=None):
         n = keys.shape[0]
         self.key = keys
         self.code = np.zeros(n, np.int32)
@@ -219,6 +234,11 @@ class MultiGetResult:
         self.local = np.zeros(n, bool)
         self.step = np.full(n, -1, np.int32)
         self._fallback: Optional[Tuple[BatchFutures, np.ndarray]] = None
+        # heap mode (round-17): the byte payload per key (None = never
+        # written / not served); local answers resolve at serve time,
+        # fallback answers ride the BatchFutures' own eager resolution
+        self._heap = heap
+        self.data: List[Optional[bytes]] = [None] * n
 
     def __len__(self) -> int:
         return self.key.shape[0]
@@ -234,6 +254,9 @@ class MultiGetResult:
             self.value[di] = bf.value[done]
             self.found[di] = bf.found[done]
             self.step[di] = bf.step[done]
+            if self._heap is not None:
+                for j, i in zip(np.nonzero(done)[0], di):
+                    self.data[int(i)] = bf.data[int(j)]
 
     def done_count(self) -> int:
         self._pull()
@@ -392,6 +415,28 @@ class KVS:
         self.local_reads = 0
         self.fallback_reads = 0
         self.ryw_fallbacks = 0
+        # value heap (round-17, hermes_tpu/heap): variable-length byte
+        # values behind ONE packed ref word in payload word 0.  The
+        # extent lands in the heap at submission — BEFORE the INV issues
+        # — so the round moves only the ref word (census unchanged).
+        # Dead extents compact at rebase boundaries (rt.rebase_hook) and
+        # on allocation pressure (append raises HeapFull -> heap_gc ->
+        # one retry) under the same quiesce the version rebase uses.
+        if self.cfg.use_heap:
+            from hermes_tpu.heap import ValueHeap
+
+            self.heap: Optional[ValueHeap] = ValueHeap(self.cfg)
+            self.rt.rebase_hook = self._heap_rebase_hook
+        else:
+            self.heap = None
+        self._in_heap_gc = False
+        # refs appended for work being STAGED right now (a batch mid-
+        # build, a migration mid-transfer): a heap-pressure GC can fire
+        # between two appends of the same call, and refs not yet
+        # registered anywhere else must still be rooted and remapped —
+        # each entry is a 1-D int32 array view whose nonzero entries are
+        # live refs (see _heap_staging)
+        self._staging: List[np.ndarray] = []
 
     # -- client ops ----------------------------------------------------------
 
@@ -495,10 +540,47 @@ class KVS:
 
     def _payload(self, value) -> np.ndarray:
         u = self.cfg.value_words - 2
+        if self.heap is not None:
+            # heap mode: the payload IS bytes; the extent lands in the
+            # log now and only the packed ref word rides the round
+            if not isinstance(value, (bytes, bytearray, memoryview)):
+                raise TypeError(
+                    "heap mode (cfg.max_value_bytes > 0) takes byte "
+                    f"payloads, got {type(value).__name__}; fixed-word "
+                    "values need max_value_bytes=0")
+            out = np.zeros(u, np.int32)
+            out[0] = self._heap_append(bytes(value))
+            return out
         arr = np.asarray(list(value), np.int32)
         if arr.ndim != 1 or arr.shape[0] > u:
             raise ValueError(f"value must be <= {u} int32 words")
         return np.pad(arr, (0, u - arr.shape[0]))
+
+    def _heap_append(self, data: bytes) -> int:
+        """Land one extent, compacting ONCE on allocation pressure (the
+        heap-full -> GC -> retry path); a heap that stays full after
+        compaction is genuinely out of space and HeapFull propagates."""
+        from hermes_tpu.heap import HeapFull
+
+        try:
+            return self.heap.append(data)
+        except HeapFull:
+            if self._in_heap_gc:
+                raise
+            self.heap_gc(reason="full")
+            return self.heap.append(data)
+
+    @contextlib.contextmanager
+    def _heap_staging(self, refs: np.ndarray):
+        """Root the nonzero entries of ``refs`` (a 1-D int32 view) for
+        any GC that fires inside the with-block, and remap them in place
+        when one does — the bridge between 'appended' and 'registered in
+        queues/batches/rows' that a multi-append call needs."""
+        self._staging.append(refs)
+        try:
+            yield refs
+        finally:
+            self._staging.remove(refs)
 
     # -- batched client path (array-in, futures-out) -------------------------
 
@@ -523,12 +605,39 @@ class KVS:
             raise ValueError("keys must be shape (n,)")
         u = self.cfg.value_words - 2
         uval = np.zeros((n, u), np.int32)
-        if values is not None:
+        if values is not None and self.heap is not None:
+            # heap mode: values is a sequence of byte payloads (None /
+            # anything for gets — rows for reads are ignored, as in the
+            # word path); each update's extent lands NOW and only the
+            # packed ref word enters the op stream
+            if len(values) != n:
+                raise ValueError(f"values must carry {n} byte payloads")
+            upd = opc != t.OP_READ
+            # the ref column is a GC root WHILE the batch is still being
+            # staged: a heap-pressure compaction between two appends
+            # must remap the refs already written here
+            with self._heap_staging(uval[:, 0]):
+                for i in np.nonzero(upd)[0]:
+                    v = values[int(i)]
+                    if not isinstance(v, (bytes, bytearray, memoryview)):
+                        raise TypeError(
+                            "heap mode takes byte payloads per update, got "
+                            f"{type(v).__name__} at index {int(i)}")
+                    uval[i, 0] = self._heap_append(bytes(v))
+        elif values is not None:
             v = np.asarray(values, np.int32)
             if v.ndim != 2 or v.shape[0] != n or v.shape[1] > u:
                 raise ValueError(f"values must be (n, <={u}) int32 words")
             uval[:, : v.shape[1]] = v
-        bf = BatchFutures(opc.copy(), keys_arr.copy(), u)
+        elif self.heap is not None and (opc != t.OP_READ).any():
+            # heap mode: an update without a byte payload would commit
+            # the null ref — a silent data-less write; refuse like the
+            # per-op path does
+            raise TypeError(
+                "heap mode (cfg.max_value_bytes > 0) needs a byte payload "
+                "per update op; got values=None with "
+                f"{int((opc != t.OP_READ).sum())} update(s) in the batch")
+        bf = BatchFutures(opc.copy(), keys_arr.copy(), u, heap=self.heap)
         if self._degraded_now():
             # quorum-loss degraded mode (round-11): shed writes loudly
             # BEFORE the sparse-key index mapping — a shed op must not
@@ -742,6 +851,17 @@ class KVS:
                 bf.value[gi] = rval[rr, cc, 2:]
                 bf.uid[gi] = wval[rr, cc, :2]
                 bf.step[gi] = round_idx
+                if self.heap is not None:
+                    # heap mode: resolve read payloads eagerly while the
+                    # referenced extents are provably un-compacted (GC
+                    # flushes every completion before it moves bytes)
+                    ccode = code[rr, cc]
+                    crefs = rval[rr, cc, 2]
+                    for j in np.nonzero(
+                            (ccode == t.C_READ) | (ccode == t.C_RMW))[0]:
+                        ref = int(crefs[j])
+                        bf.data[int(gi[j])] = (
+                            self.heap.read(ref) if ref else None)
                 if ver is not None:
                     bf.tsv[gi] = ver[rr, cc]
                     bf.tsf[gi] = fc[rr, cc]
@@ -768,6 +888,9 @@ class KVS:
             )
             if c in (t.C_READ, t.C_RMW):
                 done.value = rval[r, s, 2:].tolist()
+                if self.heap is not None:
+                    ref = int(rval[r, s, 2])
+                    done.data = self.heap.read(ref) if ref else None
             if c in (t.C_WRITE, t.C_RMW):
                 done.uid = (int(wval[r, s, 0]), int(wval[r, s, 1]))
                 if ver is not None:
@@ -1122,6 +1245,13 @@ class KVS:
                 res.local[si] = True
                 res.step[si] = self.rt.step_idx
                 self.local_reads += int(si.size)
+                if self.heap is not None:
+                    # resolve the served rows' byte payloads off the
+                    # mirror NOW (the row's ref word was read atomically
+                    # with its uid in the one bank gather)
+                    for i, ref in zip(si, vals[:, 2]):
+                        res.data[int(i)] = (self.heap.read(int(ref))
+                                            if int(ref) else None)
                 self._record_local_reads(slots[si], vals)
         fb = pi[~serve]
         if fb.size:
@@ -1157,7 +1287,7 @@ class KVS:
             else np.asarray(keys))
         n = keys_arr.shape[0]
         u = self.cfg.value_words - 2
-        res = MultiGetResult(keys_arr.copy(), u)
+        res = MultiGetResult(keys_arr.copy(), u, heap=self.heap)
         if n == 0:
             return res
         if self.index is not None:
@@ -1211,12 +1341,13 @@ class KVS:
         if self.index is not None:
             hi = min(hi, self.index.n_used)
             if lo >= hi:
-                return MultiGetResult(np.zeros(0, np.uint64), u)
+                return MultiGetResult(np.zeros(0, np.uint64), u,
+                                      heap=self.heap)
             keys_arr = self.index._rev[lo:hi].copy()
         else:
             keys_arr = np.arange(lo, hi, dtype=np.int64)
         slots = np.arange(lo, hi, dtype=np.int32)
-        res = MultiGetResult(keys_arr, u)
+        res = MultiGetResult(keys_arr, u, heap=self.heap)
         pend = np.ones(hi - lo, bool)
         if self._fence_mask.any():
             fenced = self._fence_mask[lo:hi]
@@ -1260,6 +1391,153 @@ class KVS:
                     fallback_reads=self.fallback_reads,
                     ryw_fallbacks=self.ryw_fallbacks,
                     read_dispatches=0 if rd is None else rd.dispatches)
+
+    # -- value-heap GC (round-17, hermes_tpu/heap) ---------------------------
+
+    def _heap_rebase_hook(self) -> None:
+        """Installed as the runtime's ``rebase_hook``: heap compaction
+        rides every version rebase — the store is already quiesced,
+        drained, and flushed at that boundary, so the GC skips its own
+        drain."""
+        if not self._in_heap_gc:
+            self.heap_gc(quiesce=False, reason="rebase")
+
+    def _heap_roots(self):
+        """Every place a live heap ref can hide while the store is
+        drained: table rows (every replica copy — a frozen replica's
+        stale rows keep their extents alive until overwritten, the
+        conservative rule), the staged device stream (ops injected but
+        not yet consumed under quiesce), queued per-op traffic, and
+        staged-but-uninjected batch rows.  Returns (bank_refcol,
+        staged_mask, root_concat)."""
+        from hermes_tpu.core import faststep as fst
+        from hermes_tpu.transport import codec
+
+        bank = np.asarray(jax.device_get(self.rt.fs.table.bank))
+        rows32 = codec.rows_to_words(bank)
+        refcol = rows32[:, fst.BANK_VAL + 2].copy()
+        roots = [refcol.astype(np.int64)]
+        staged_mask = self._kindarr != t.OP_NOP
+        roots.append(self._uval[:, :, 0, 0][staged_mask].astype(np.int64))
+        for rs_key in self._queued_slots:
+            for item in self._queues[rs_key]:
+                if item[3] is not None:
+                    roots.append(np.asarray([item[3][0]], np.int64))
+        for b in self._bat.values():
+            roots.append(b["uval"][b["cursor"]:, 0].astype(np.int64))
+        for arr in self._staging:
+            roots.append(arr[arr != 0].astype(np.int64))
+        return refcol, staged_mask, np.concatenate(roots)
+
+    def heap_gc(self, quiesce: bool = True, reason: str = "full",
+                max_quiesce_rounds: int = 512) -> dict:
+        """Compact the value heap: quiesce-drain in-flight writes (the
+        rebase discipline — FastCtl.quiesce pauses intake/issues while
+        pending broadcasts finish), flush every completion, copy the
+        LIVE extents to the front of a fresh log, and remap the packed
+        ref words everywhere they live (table rows on device, staged
+        stream, client queues, pending batches).  Lands on the obs
+        timeline as a ``heap_gc`` span + ``heap_util`` gauge.
+
+        If in-flight ops cannot drain (a frozen coordinator pins them),
+        the compaction is SKIPPED loudly (``heap_gc_skipped`` event) —
+        an undrainable op's device-side ref cannot be remapped, so
+        moving its extent would corrupt the row it eventually commits.
+        Returns the post-GC heap stats (empty dict when skipped)."""
+        if self.heap is None:
+            raise RuntimeError("heap_gc needs cfg.max_value_bytes > 0")
+        if self._in_heap_gc:
+            return {}
+        rt = self.rt
+        self._in_heap_gc = True
+        try:
+            if rt.obs is not None:
+                with rt.obs.tracer.span("heap_gc", step=rt.step_idx,
+                                        reason=reason):
+                    return self._heap_gc_body(quiesce, reason,
+                                              max_quiesce_rounds)
+            return self._heap_gc_body(quiesce, reason, max_quiesce_rounds)
+        finally:
+            self._in_heap_gc = False
+
+    def _heap_gc_body(self, quiesce: bool, reason: str,
+                      max_quiesce_rounds: int) -> dict:
+        import jax.numpy as jnp
+
+        from hermes_tpu.core import faststep as fst
+        from hermes_tpu.heap import ValueHeap
+        from hermes_tpu.transport import codec
+
+        rt = self.rt
+        if quiesce:
+            prev = rt.quiesce
+            rt.quiesce = True
+            try:
+                for _ in range(max_quiesce_rounds):
+                    if rt._inflight_count() == 0:
+                        break
+                    self.step()
+            finally:
+                rt.quiesce = prev
+        rt.flush_pipeline()
+        self.flush()
+        if rt._inflight_count() != 0:
+            # an undrainable in-flight write holds a device-side ref the
+            # remap cannot reach — refuse to move bytes under it
+            rt._trace("heap_gc_skipped", reason=reason,
+                      inflight=rt._inflight_count())
+            return {}
+        refcol, staged_mask, roots = self._heap_roots()
+        old, new = self.heap.compact(roots)
+        # table rows: remap the ref word column of every replica copy in
+        # one dense byte-column update (4 bytes per row at the payload-
+        # word-0 offset; batched = the one shared copy, sharded = all R)
+        newcol = ValueHeap.remap(refcol, old, new).astype(np.int32)
+        if not np.array_equal(newcol, refcol):
+            col = 4 * (fst.BANK_VAL + 2)
+            col_bytes = codec.words_to_rows(newcol[:, None])
+            tbl = rt.fs.table
+            rt.fs = rt.fs._replace(table=tbl._replace(
+                bank=tbl.bank.at[:, col:col + 4].set(jnp.asarray(col_bytes))))
+        # staged stream rows (injected, unconsumed): remap in place;
+        # idle rows' stale payloads are zeroed so a dead ref can never
+        # masquerade as live at the next collection
+        vals = self._uval[:, :, 0, 0]
+        vals[staged_mask] = ValueHeap.remap(
+            vals[staged_mask], old, new).astype(np.int32)
+        vals[~staged_mask] = 0
+        self._dirty = True
+        # queued per-op payload arrays mutate in place (the deque items
+        # hold the very np array the eventual injection will read)
+        for rs_key in self._queued_slots:
+            for item in self._queues[rs_key]:
+                if item[3] is not None:
+                    item[3][0] = int(ValueHeap.remap(
+                        np.asarray([item[3][0]], np.int64), old, new)[0])
+        for b in self._bat.values():
+            pend = b["uval"][b["cursor"]:, 0]
+            b["uval"][b["cursor"]:, 0] = ValueHeap.remap(
+                pend.astype(np.int64), old, new).astype(np.int32)
+        for arr in self._staging:
+            nz = arr != 0
+            if nz.any():
+                arr[nz] = ValueHeap.remap(
+                    arr[nz].astype(np.int64), old, new).astype(arr.dtype)
+        stats = self.heap.stats()
+        if rt.obs is not None:
+            rt.obs.registry.gauge(
+                "heap_util",
+                help="live heap bytes / heap capacity").set(
+                    stats["live_bytes"] / stats["capacity_bytes"])
+        rt._trace("heap_gc", reason=reason,
+                  live_bytes=stats["live_bytes"],
+                  used_bytes=stats["used_bytes"],
+                  reclaimed_bytes=self.heap.gc_reclaimed_bytes)
+        return stats
+
+    def heap_stats(self) -> Optional[dict]:
+        """Heap accounting (None when the heap is disabled)."""
+        return None if self.heap is None else self.heap.stats()
 
     # -- elastic operations (round-10, hermes_tpu/elastic) -------------------
 

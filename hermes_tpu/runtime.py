@@ -442,6 +442,11 @@ class FastRuntime(_ObsHooks, _ElasticResize):
         # installs its own step here so drained completions are never
         # dropped on the floor
         self.comp_sink = None
+        # round-17 value heap: the client layer hooks the rebase boundary
+        # (the one moment the store is quiesced, drained, and flushed) so
+        # heap compaction rides EVERY version rebase — dead extents are
+        # reclaimed exactly when dead versions are (kvs.KVS.heap_gc)
+        self.rebase_hook = None
         # completion fetch per round (device->host).  At bench shape the
         # Completions tuple is tens of MB — a telemetry-only driver (e.g.
         # scripts/rebase_soak.py) sets this False to poll counters alone;
@@ -796,6 +801,11 @@ class FastRuntime(_ObsHooks, _ElasticResize):
                 self._ver_base = np.zeros(self.cfg.n_keys, np.int64)
             self._ver_base += delta
             self.rebases += 1
+        if self.rebase_hook is not None:
+            # value-heap GC (round-17): the store is quiesced, drained,
+            # and pipeline-flushed right here — the client layer compacts
+            # dead extents while the invariant holds
+            self.rebase_hook()
         return n
 
     def drain(self, max_steps: int = 10_000) -> bool:

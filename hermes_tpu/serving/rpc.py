@@ -38,6 +38,7 @@ class LoopbackServer:
     def __init__(self, frontend: Frontend):
         self.fe = frontend
         self.u = frontend.u
+        self.vbytes = frontend.vbytes
         self.wire_rx = 0
         self.wire_tx = 0
         self._out: List[bytes] = []
@@ -46,9 +47,10 @@ class LoopbackServer:
         from hermes_tpu.transport import codec
 
         raw = codec.frame_unpack(codec.frame_pack(np.frombuffer(
-            wire.encode_any_request(req, self.u), np.uint8))).tobytes()
+            wire.encode_any_request(req, self.u, self.vbytes),
+            np.uint8))).tobytes()
         self.wire_rx += len(raw) + codec.FRAME_OVERHEAD
-        return wire.decode_any_request(raw, self.u)
+        return wire.decode_any_request(raw, self.u, self.vbytes)
 
     def submit(self, req) -> Optional[object]:
         """One client request (single-op Request or round-16 batched
@@ -73,10 +75,11 @@ class LoopbackServer:
     def _encode_out(self, rsps) -> List[object]:
         out = []
         for rsp in rsps:
-            raw = wire.encode_any_response(rsp, self.u)
+            raw = wire.encode_any_response(rsp, self.u, self.vbytes)
             self.wire_tx += len(raw)
             self._out.append(raw)
-            out.append(wire.decode_any_response(raw, self.u))
+            out.append(wire.decode_any_response(raw, self.u,
+                                                 self.vbytes))
         return out
 
     def response_log(self) -> bytes:
@@ -94,6 +97,7 @@ class TcpRpcServer:
 
         self.fe = frontend
         self.u = frontend.u
+        self.vbytes = frontend.vbytes
         self._FramedSocket = FramedSocket
         self._lock = threading.Lock()
         # client req_ids are only unique PER CONNECTION (wire.py): the
@@ -146,7 +150,8 @@ class TcpRpcServer:
             # single-op size OR a round-16 variable read-request size
             # (a corrupted-but-plausible frame is skipped + counted)
             fsock = self._FramedSocket(
-                sock, expect_lens=wire.plausible_request_len(self.u))
+                sock, expect_lens=wire.plausible_request_len(self.u,
+                                                         self.vbytes))
             self._conns.append(fsock)
             t = threading.Thread(target=self._reader_loop, args=(fsock,),
                                  daemon=True)
@@ -191,7 +196,8 @@ class TcpRpcServer:
             reqs = []
             for raw in raws:
                 try:
-                    reqs.append(wire.decode_any_request(raw, self.u))
+                    reqs.append(wire.decode_any_request(raw, self.u,
+                                                        self.vbytes))
                 except ValueError:
                     # frame-valid but undecodable (payload-width/magic
                     # mismatch): refuse LOUDLY when the header still
@@ -206,7 +212,7 @@ class TcpRpcServer:
                             fsock.send(wire.encode_response(
                                 wire.Response(
                                     status=wire.S_REJECTED, req_id=rid,
-                                    found=False), self.u))
+                                    found=False), self.u, self.vbytes))
                         except OSError:
                             fsock.close()
                             return
@@ -239,7 +245,7 @@ class TcpRpcServer:
 
     def _send_out(self, fsock, rsp) -> None:
         try:
-            fsock.send(wire.encode_any_response(rsp, self.u))
+            fsock.send(wire.encode_any_response(rsp, self.u, self.vbytes))
         except OSError:
             # send timed out or failed mid-frame: the stream boundary is
             # gone, so the connection is unusable — tear it down
@@ -296,14 +302,16 @@ class TcpRpcServer:
 class RpcClient:
     """Blocking client over one CRC-framed socket."""
 
-    def __init__(self, addr, u: int, timeout_s: float = 30.0):
+    def __init__(self, addr, u: int, timeout_s: float = 30.0,
+                 vbytes: int = 0):
         from hermes_tpu.transport.tcp import FramedSocket
 
         sock = socket.create_connection(addr, timeout=timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.fsock = FramedSocket(
-            sock, expect_lens=wire.plausible_response_len(u))
+            sock, expect_lens=wire.plausible_response_len(u, vbytes))
         self.u = u
+        self.vbytes = vbytes
         self._next_id = 1
 
     def next_id(self) -> int:
@@ -311,18 +319,19 @@ class RpcClient:
         return rid
 
     def send(self, req) -> None:
-        self.fsock.send(wire.encode_any_request(req, self.u))
+        self.fsock.send(wire.encode_any_request(req, self.u, self.vbytes))
 
     def recv_next(self) -> Optional[object]:
         raw = self.fsock.recv()
         if raw is None:
             return None
-        return wire.decode_any_response(raw, self.u)
+        return wire.decode_any_response(raw, self.u, self.vbytes)
 
     def call(self, kind: str, key: int, value=None, tenant: int = 0,
-             deadline_us: int = 0) -> wire.Response:
+             deadline_us: int = 0, data=None) -> wire.Response:
         req = wire.Request(kind=kind, req_id=self.next_id(), tenant=tenant,
-                           key=key, deadline_us=deadline_us, value=value)
+                           key=key, deadline_us=deadline_us, value=value,
+                           data=data)
         self.send(req)
         rsp = self.recv_next()
         if rsp is None:

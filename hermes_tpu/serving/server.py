@@ -117,6 +117,10 @@ class Frontend:
         self.is_fleet = hasattr(store, "router") and hasattr(store, "groups")
         base = store.cfg.base if self.is_fleet else store.cfg
         self.u = base.value_words - 2
+        # value heap (round-17): > 0 switches the wire to length-prefixed
+        # byte payloads (both ends derive it from the shared config, like
+        # ``u``) and the issue path to store byte puts
+        self.vbytes = base.max_value_bytes
         if self.u < 1:
             raise ValueError("serving needs value_words >= 3 (the store "
                              "carries write uids in words 0-1)")
@@ -247,6 +251,13 @@ class Frontend:
             return self._respond(wire.Response(
                 status=wire.S_REJECTED, req_id=req.req_id), req.tenant,
                 queue=False)
+        if self.vbytes and req.kind != "get" and (
+                req.data is None or len(req.data) > self.vbytes):
+            # heap mode: an update must carry a byte payload the store
+            # can hold — refused loudly at the door, never a deep error
+            return self._respond(wire.Response(
+                status=wire.S_REJECTED, req_id=req.req_id), req.tenant,
+                queue=False)
         degraded = self._degraded_for_key(req.key)
         self._update_level(degraded, fresh=False)
         reason, wait = self.adm.admit(req.kind, req.key, req.tenant, now,
@@ -346,7 +357,11 @@ class Frontend:
             self._pending[req.req_id] = entry
             self._store_inflight += 1
             return
-        value = req.value if req.kind != "get" else None
+        value = None
+        if req.kind != "get":
+            # heap mode stores the request's byte payload verbatim (the
+            # KVS appends the extent and rounds only the packed ref)
+            value = bytes(req.data) if self.vbytes else req.value
         if self.is_fleet:
             session = req.tenant * 7919 + seq
             fut, lane = self.store.route_op(req.kind, session, req.key,
@@ -384,7 +399,7 @@ class Frontend:
             res = entry["fut"].res
             res._pull()
             served = res.code == t.C_READ
-            return wire.ReadResponse(
+            rrsp = wire.ReadResponse(
                 status=wire.S_OK, req_id=req.req_id,
                 step=int(res.step.max()) if len(res) else -1,
                 found=(np.asarray(res.found) & served).tolist(),
@@ -392,11 +407,15 @@ class Frontend:
                 codes=np.where(res.code == C_REJECTED, wire.RK_REJECTED,
                                wire.RK_OK).tolist(),
                 values=np.asarray(res.value).tolist())
+            if self.vbytes:
+                rrsp.data = list(res.data)
+            return rrsp
         c = entry["fut"].result()
         rsp = wire.Response(status=self._STATUS[c.kind], req_id=req.req_id,
                             found=c.found, step=c.step)
         if c.value is not None:
             rsp.value = c.value
+            rsp.data = c.data
         if c.uid is not None:
             rsp.uid = c.uid
             if c.ts is not None:

@@ -183,19 +183,20 @@ def committed_uids(fe: Frontend, lb: LoopbackServer) -> List[tuple]:
     import struct
 
     out = []
-    u = lb.u
+    u, vbytes = lb.u, lb.vbytes
     off = 0
     raw = lb.response_log()
-    step = wire.rsp_nbytes(u)
     while off + 2 <= len(raw):
+        # records are variable even for single ops in heap mode: each
+        # record's extent comes from its own magic/count/length prefix
+        # (wire.response_extent — the one walker primitive)
+        step = wire.response_extent(raw, off, u, vbytes)
         (magic,) = struct.unpack_from("<H", raw, off)
         if magic == wire.RRSP_MAGIC:
-            # batched read response: reads never mint uids — skip it by
-            # its count-derived extent
-            (count,) = struct.unpack_from("<H", raw, off + 8)
-            off += wire.rrsp_nbytes(u, count)
+            # batched read response: reads never mint uids — skip it
+            off += step
             continue
-        rsp = wire.decode_response(raw[off: off + step], u)
+        rsp = wire.decode_response(raw[off: off + step], u, vbytes)
         off += step
         if rsp.status == wire.S_OK and rsp.uid is not None:
             out.append(rsp.uid)

@@ -85,12 +85,57 @@ _RSP = struct.Struct("<HBBIBBHiIii")
 
 
 def req_nbytes(u: int) -> int:
-    """Wire size of one (unframed) request at payload width ``u``."""
+    """Wire size of one (unframed) fixed-word request at payload width
+    ``u`` (heap-mode messages are variable — see the vbytes tail)."""
     return _REQ.size + 4 * u
 
 
 def rsp_nbytes(u: int) -> int:
     return _RSP.size + 4 * u
+
+
+# -- round-17 value-heap payload tail ----------------------------------------
+#
+# With ``vbytes = cfg.max_value_bytes > 0`` (both ends derive it from the
+# shared config, like ``u``), every K_PUT/K_RMW request and K_GET/K_RMW
+# response swaps its fixed word payload for a LENGTH-PREFIXED byte tail:
+# ``dlen u32 | dlen bytes`` — dlen = _DLEN_NONE marks "no payload" (a get
+# request, a put response, the never-written key), distinct from a real
+# zero-length value.  K_MGET/K_SCAN responses keep fixed-stride rows
+# (numpy-packable) of ``found|local|code|pad | dlen u32 | vcap(vbytes)
+# padded bytes``.  The CRC frame already bounds and checksums the whole
+# message, so the prefix only has to carve the tail.
+
+_DLEN_NONE = 0xFFFFFFFF
+
+
+def _vcap(vbytes: int) -> int:
+    """Fixed per-row byte capacity of a heap-mode read-response row
+    (word-aligned so the row stride stays 4-byte aligned)."""
+    return 4 * ((vbytes + 3) // 4)
+
+
+def _pack_tail(data, vbytes: int) -> bytes:
+    if data is None:
+        return struct.pack("<I", _DLEN_NONE)
+    raw = bytes(data)
+    if len(raw) > vbytes:
+        raise ValueError(f"payload is {len(raw)} bytes > max_value_bytes="
+                         f"{vbytes}")
+    return struct.pack("<I", len(raw)) + raw
+
+
+def _unpack_tail(buf: bytes, off: int, vbytes: int):
+    """(data, next_offset) of a length-prefixed tail at ``off``."""
+    if off + 4 > len(buf):
+        raise ValueError("payload tail truncated (no length prefix)")
+    (dlen,) = struct.unpack_from("<I", buf, off)
+    if dlen == _DLEN_NONE:
+        return None, off + 4
+    if dlen > vbytes or off + 4 + dlen > len(buf):
+        raise ValueError(f"payload tail declares {dlen} bytes "
+                         f"(max {vbytes}, have {len(buf) - off - 4})")
+    return buf[off + 4: off + 4 + dlen], off + 4 + dlen
 
 
 @dataclasses.dataclass
@@ -101,6 +146,7 @@ class Request:
     key: int
     deadline_us: int = 0      # RELATIVE to server intake; 0 = none
     value: Optional[List[int]] = None  # payload words (updates)
+    data: Optional[bytes] = None       # heap mode: variable byte payload
 
 
 @dataclasses.dataclass
@@ -113,6 +159,7 @@ class Response:
     retry_after_us: int = 0
     uid: Optional[tuple] = None
     value: Optional[List[int]] = None
+    data: Optional[bytes] = None       # heap mode: variable byte payload
 
     @property
     def status_name(self) -> str:
@@ -123,20 +170,25 @@ class Response:
         return REASON_NAMES[self.reason]
 
 
-def encode_request(req: Request, u: int) -> bytes:
+def encode_request(req: Request, u: int, vbytes: int = 0) -> bytes:
     if req.kind not in _KIND_CODES:
         raise ValueError(f"unknown op kind {req.kind!r}")
     if not (0 <= req.deadline_us < 1 << 32):
         raise ValueError("deadline_us must fit u32 (relative microseconds)")
+    head = _REQ.pack(REQ_MAGIC, _KIND_CODES[req.kind], 0, req.req_id,
+                     req.tenant, 0, req.deadline_us, req.key)
+    if vbytes:
+        # heap mode: the length-prefixed byte tail replaces the fixed
+        # word payload (an update's bytes; None for gets)
+        return head + _pack_tail(
+            req.data if req.kind != "get" else None, vbytes)
     pay = np.zeros(u, np.int32)
     if req.value is not None:
         v = np.asarray(list(req.value), np.int32)
         if v.ndim != 1 or v.shape[0] > u:
             raise ValueError(f"value must be <= {u} int32 words")
         pay[: v.shape[0]] = v
-    return _REQ.pack(REQ_MAGIC, _KIND_CODES[req.kind], 0, req.req_id,
-                     req.tenant, 0, req.deadline_us,
-                     req.key) + pay.tobytes()
+    return head + pay.tobytes()
 
 
 def peek_req_id(buf: bytes) -> Optional[int]:
@@ -152,9 +204,12 @@ def peek_req_id(buf: bytes) -> Optional[int]:
     return req_id if magic in (REQ_MAGIC, RREQ_MAGIC) else None
 
 
-def decode_request(buf: bytes, u: int) -> Request:
+def decode_request(buf: bytes, u: int, vbytes: int = 0) -> Request:
     buf = bytes(buf)
-    if len(buf) != req_nbytes(u):
+    if len(buf) < _REQ.size:
+        raise ValueError(f"request size {len(buf)} too short "
+                         f"(header is {_REQ.size} bytes)")
+    if not vbytes and len(buf) != req_nbytes(u):
         raise ValueError(f"request size {len(buf)} != {req_nbytes(u)} "
                          f"(payload width {u})")
     magic, kind, _p, req_id, tenant, _p2, dl, key = _REQ.unpack(
@@ -163,32 +218,56 @@ def decode_request(buf: bytes, u: int) -> Request:
         raise ValueError(f"bad request magic 0x{magic:04x}")
     if kind not in _KIND_NAMES:
         raise ValueError(f"unknown wire op kind {kind}")
+    if vbytes:
+        data, end = _unpack_tail(buf, _REQ.size, vbytes)
+        if end != len(buf):
+            raise ValueError(f"request size {len(buf)} != {end} "
+                             "(trailing bytes after the payload tail)")
+        return Request(kind=_KIND_NAMES[kind], req_id=req_id, tenant=tenant,
+                       key=key, deadline_us=dl,
+                       data=data if _KIND_NAMES[kind] != "get" else None)
     value = np.frombuffer(buf[_REQ.size:], np.int32).tolist()
     return Request(kind=_KIND_NAMES[kind], req_id=req_id, tenant=tenant,
                    key=key, deadline_us=dl,
                    value=value if _KIND_NAMES[kind] != "get" else None)
 
 
-def encode_response(rsp: Response, u: int) -> bytes:
+def encode_response(rsp: Response, u: int, vbytes: int = 0) -> bytes:
+    hi, lo = rsp.uid if rsp.uid is not None else (0, 0)
+    head = _RSP.pack(RSP_MAGIC, rsp.status, rsp.reason, rsp.req_id,
+                     1 if rsp.found else 0,
+                     1 if rsp.uid is not None else 0, 0, rsp.step,
+                     rsp.retry_after_us, hi, lo)
+    if vbytes:
+        return head + _pack_tail(
+            rsp.data if rsp.status == S_OK else None, vbytes)
     pay = np.zeros(u, np.int32)
     if rsp.value is not None:
         v = np.asarray(list(rsp.value), np.int32)
         pay[: v.shape[0]] = v
-    hi, lo = rsp.uid if rsp.uid is not None else (0, 0)
-    return _RSP.pack(RSP_MAGIC, rsp.status, rsp.reason, rsp.req_id,
-                     1 if rsp.found else 0,
-                     1 if rsp.uid is not None else 0, 0, rsp.step,
-                     rsp.retry_after_us, hi, lo) + pay.tobytes()
+    return head + pay.tobytes()
 
 
-def decode_response(buf: bytes, u: int) -> Response:
+def decode_response(buf: bytes, u: int, vbytes: int = 0) -> Response:
     buf = bytes(buf)
-    if len(buf) != rsp_nbytes(u):
+    if len(buf) < _RSP.size:
+        raise ValueError(f"response size {len(buf)} too short "
+                         f"(header is {_RSP.size} bytes)")
+    if not vbytes and len(buf) != rsp_nbytes(u):
         raise ValueError(f"response size {len(buf)} != {rsp_nbytes(u)}")
     (magic, status, reason, req_id, found, has_uid, _p2, step, retry,
      hi, lo) = _RSP.unpack(buf[: _RSP.size])
     if magic != RSP_MAGIC:
         raise ValueError(f"bad response magic 0x{magic:04x}")
+    if vbytes:
+        data, end = _unpack_tail(buf, _RSP.size, vbytes)
+        if end != len(buf):
+            raise ValueError(f"response size {len(buf)} != {end} "
+                             "(trailing bytes after the payload tail)")
+        return Response(status=status, reason=reason, req_id=req_id,
+                        found=bool(found), step=step, retry_after_us=retry,
+                        uid=(hi, lo) if has_uid else None,
+                        data=data if status == S_OK else None)
     value = np.frombuffer(buf[_RSP.size:], np.int32).tolist()
     return Response(status=status, reason=reason, req_id=req_id,
                     found=bool(found), step=step, retry_after_us=retry,
@@ -256,6 +335,8 @@ class ReadResponse:
     local: Optional[List[bool]] = None   # served by the fast path
     codes: Optional[List[int]] = None    # RK_* per key
     values: Optional[List[List[int]]] = None
+    # heap mode: per-key byte payloads (None = never written / not served)
+    data: Optional[List[Optional[bytes]]] = None
 
     @property
     def status_name(self) -> str:
@@ -270,7 +351,11 @@ def rreq_nbytes(kind: str, count: int) -> int:
     return _RREQ.size + (8 * count if kind == "mget" else 16)
 
 
-def rrsp_nbytes(u: int, count: int) -> int:
+def rrsp_nbytes(u: int, count: int, vbytes: int = 0) -> int:
+    """Read-response size: fixed-stride rows — word payloads at width
+    ``u``, or (heap mode) a u32 length + vcap padded bytes per row."""
+    if vbytes:
+        return _RRSP.size + count * (8 + _vcap(vbytes))
     return _RRSP.size + count * (4 + 4 * u)
 
 
@@ -315,12 +400,30 @@ def decode_read_request(buf: bytes) -> ReadRequest:
                        lo=int(body[0]), hi=int(body[1]), deadline_us=dl)
 
 
-def encode_read_response(rsp: ReadResponse, u: int) -> bytes:
+def encode_read_response(rsp: ReadResponse, u: int, vbytes: int = 0) -> bytes:
     n = len(rsp.found or ())
     head = _RRSP.pack(RRSP_MAGIC, rsp.status, rsp.reason, rsp.req_id, n, 0,
                       rsp.step, rsp.retry_after_us)
     if n == 0:
         return head
+    if vbytes:
+        cap = _vcap(vbytes)
+        rows = np.zeros((n, 8 + cap), np.uint8)
+        rows[:, 0] = np.asarray(rsp.found, np.uint8)
+        rows[:, 1] = np.asarray(rsp.local or [0] * n, np.uint8)
+        rows[:, 2] = np.asarray(rsp.codes or [RK_OK] * n, np.uint8)
+        dlen = np.full(n, _DLEN_NONE, np.uint32)
+        data = rsp.data or [None] * n
+        for i, d in enumerate(data):
+            if d is not None:
+                raw = bytes(d)
+                if len(raw) > vbytes:
+                    raise ValueError(f"row {i} payload is {len(raw)} bytes "
+                                     f"> max_value_bytes={vbytes}")
+                dlen[i] = len(raw)
+                rows[i, 8: 8 + len(raw)] = np.frombuffer(raw, np.uint8)
+        rows[:, 4:8] = dlen.view(np.uint8).reshape(n, 4)
+        return head + rows.tobytes()
     rows = np.zeros((n, 4 + 4 * u), np.uint8)
     rows[:, 0] = np.asarray(rsp.found, np.uint8)
     rows[:, 1] = np.asarray(rsp.local or [0] * n, np.uint8)
@@ -332,7 +435,7 @@ def encode_read_response(rsp: ReadResponse, u: int) -> bytes:
     return head + rows.tobytes()
 
 
-def decode_read_response(buf: bytes, u: int) -> ReadResponse:
+def decode_read_response(buf: bytes, u: int, vbytes: int = 0) -> ReadResponse:
     buf = bytes(buf)
     if len(buf) < _RRSP.size:
         raise ValueError(f"read response too short ({len(buf)} bytes)")
@@ -340,12 +443,28 @@ def decode_read_response(buf: bytes, u: int) -> ReadResponse:
         buf[: _RRSP.size])
     if magic != RRSP_MAGIC:
         raise ValueError(f"bad read-response magic 0x{magic:04x}")
-    if len(buf) != rrsp_nbytes(u, n):
+    if len(buf) != rrsp_nbytes(u, n, vbytes):
         raise ValueError(
-            f"read response size {len(buf)} != {rrsp_nbytes(u, n)}")
+            f"read response size {len(buf)} != {rrsp_nbytes(u, n, vbytes)}")
     out = ReadResponse(status=status, reason=reason, req_id=req_id,
                        step=step, retry_after_us=retry)
-    if n:
+    if n and vbytes:
+        cap = _vcap(vbytes)
+        rows = np.frombuffer(buf[_RRSP.size:], np.uint8).reshape(n, 8 + cap)
+        out.found = (rows[:, 0] != 0).tolist()
+        out.local = (rows[:, 1] != 0).tolist()
+        out.codes = rows[:, 2].astype(int).tolist()
+        dlen = np.ascontiguousarray(rows[:, 4:8]).view(np.uint32).ravel()
+        out.data = []
+        for i in range(n):
+            if dlen[i] == _DLEN_NONE:
+                out.data.append(None)
+            elif dlen[i] > vbytes:
+                raise ValueError(f"row {i} declares {int(dlen[i])} bytes > "
+                                 f"max_value_bytes={vbytes}")
+            else:
+                out.data.append(rows[i, 8: 8 + int(dlen[i])].tobytes())
+    elif n:
         rows = np.frombuffer(buf[_RRSP.size:], np.uint8).reshape(n, 4 + 4 * u)
         out.found = (rows[:, 0] != 0).tolist()
         out.local = (rows[:, 1] != 0).tolist()
@@ -355,16 +474,20 @@ def decode_read_response(buf: bytes, u: int) -> ReadResponse:
     return out
 
 
-def plausible_request_len(u: int):
+def plausible_request_len(u: int, vbytes: int = 0):
     """Predicate over frame payload lengths a server may legitimately
     receive (FramedSocket's corruption-triage hook): the fixed single-op
-    request size, or a read-request size — header + count*i64 keys
-    (mget) / + 2*i64 (scan).  Only consulted when a frame FAILS its CRC,
-    to decide skip-vs-teardown."""
+    request size (heap mode: header + length prefix + up to vbytes), or
+    a read-request size — header + count*i64 keys (mget) / + 2*i64
+    (scan).  Only consulted when a frame FAILS its CRC, to decide
+    skip-vs-teardown."""
     fixed = req_nbytes(u)
 
     def ok(length: int) -> bool:
-        if length == fixed:
+        if vbytes:
+            if _REQ.size + 4 <= length <= _REQ.size + 4 + vbytes:
+                return True
+        elif length == fixed:
             return True
         body = length - _RREQ.size
         return (body >= 8 and body % 8 == 0
@@ -373,15 +496,21 @@ def plausible_request_len(u: int):
     return ok
 
 
-def plausible_response_len(u: int):
+def plausible_response_len(u: int, vbytes: int = 0):
     """Predicate over frame payload lengths a client may legitimately
-    receive: the fixed single-op response size, or a read-response size
-    (header + count rows of 4 + 4u bytes)."""
+    receive: the fixed single-op response size (heap mode: a bounded
+    variable tail), or a read-response size (header + fixed-stride
+    rows)."""
     fixed = rsp_nbytes(u)
-    row = 4 + 4 * u
+    row = (8 + _vcap(vbytes)) if vbytes else (4 + 4 * u)
 
     def ok(length: int) -> bool:
-        if length == fixed or length == _RRSP.size:
+        if vbytes:
+            if _RSP.size + 4 <= length <= _RSP.size + 4 + vbytes:
+                return True
+        elif length == fixed:
+            return True
+        if length == _RRSP.size:
             return True
         body = length - _RRSP.size
         return body > 0 and body % row == 0 and body // row <= MGET_MAX_KEYS
@@ -389,34 +518,48 @@ def plausible_response_len(u: int):
     return ok
 
 
+def response_extent(raw: bytes, off: int, u: int, vbytes: int = 0) -> int:
+    """Byte length of the response record at ``off`` in a response log
+    (either layout, either payload mode) — the walker primitive
+    ``serving.soak.committed_uids`` steps with."""
+    (magic,) = struct.unpack_from("<H", raw, off)
+    if magic == RRSP_MAGIC:
+        (count,) = struct.unpack_from("<H", raw, off + 8)
+        return rrsp_nbytes(u, count, vbytes)
+    if vbytes:
+        (dlen,) = struct.unpack_from("<I", raw, off + _RSP.size)
+        return _RSP.size + 4 + (0 if dlen == _DLEN_NONE else dlen)
+    return rsp_nbytes(u)
+
+
 # -- kind/magic dispatch (one decoder entry per direction) -------------------
 
-def encode_any_request(req, u: int) -> bytes:
+def encode_any_request(req, u: int, vbytes: int = 0) -> bytes:
     if isinstance(req, ReadRequest):
         return encode_read_request(req)
-    return encode_request(req, u)
+    return encode_request(req, u, vbytes)
 
 
-def decode_any_request(buf: bytes, u: int):
+def decode_any_request(buf: bytes, u: int, vbytes: int = 0):
     """Decode either request layout off its magic word."""
     buf = bytes(buf)
     if len(buf) >= 2:
         (magic,) = struct.unpack_from("<H", buf, 0)
         if magic == RREQ_MAGIC:
             return decode_read_request(buf)
-    return decode_request(buf, u)
+    return decode_request(buf, u, vbytes)
 
 
-def encode_any_response(rsp, u: int) -> bytes:
+def encode_any_response(rsp, u: int, vbytes: int = 0) -> bytes:
     if isinstance(rsp, ReadResponse):
-        return encode_read_response(rsp, u)
-    return encode_response(rsp, u)
+        return encode_read_response(rsp, u, vbytes)
+    return encode_response(rsp, u, vbytes)
 
 
-def decode_any_response(buf: bytes, u: int):
+def decode_any_response(buf: bytes, u: int, vbytes: int = 0):
     buf = bytes(buf)
     if len(buf) >= 2:
         (magic,) = struct.unpack_from("<H", buf, 0)
         if magic == RRSP_MAGIC:
-            return decode_read_response(buf, u)
-    return decode_response(buf, u)
+            return decode_read_response(buf, u, vbytes)
+    return decode_response(buf, u, vbytes)

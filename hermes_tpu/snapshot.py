@@ -189,6 +189,13 @@ def save(path: str, rt) -> None:
             arrays["kvs.index.bucket_slot"] = idx._bucket_slot
             arrays["kvs.index.rev"] = idx._rev
             arrays["kvs.index.n_used"] = np.int64(idx.n_used)
+        if getattr(kvs, "heap", None) is not None:
+            # value heap (round-17): the allocated log prefix + bump
+            # cursor ride the same checksummed manifest as the table —
+            # a torn heap blob rejects at load exactly like a torn bank
+            h = kvs.heap
+            arrays["kvs.heap.log"] = h._mirror[: h.used_bytes()].copy()
+            arrays["kvs.heap.cursor"] = np.int64(h._cursor)
     # -- checksummed manifest + tmp/rename (crash consistency, round-9) ----
     manifest = dict(
         version=MANIFEST_VERSION,
@@ -349,6 +356,12 @@ def load(path: str, rt) -> None:
         if kvs.index is not None:
             needed += ["kvs.index.bucket_key", "kvs.index.bucket_slot",
                        "kvs.index.rev", "kvs.index.n_used"]
+        if getattr(kvs, "heap", None) is not None:
+            # heap-mode targets need the log (mode mismatches are already
+            # caught by the config-fingerprint gate — max_value_bytes is
+            # part of the config — so a missing member here means a
+            # truncated archive)
+            needed += ["kvs.heap.log", "kvs.heap.cursor"]
     missing = [k for k in needed if k not in z]
     if missing:
         raise ValueError(
@@ -367,6 +380,20 @@ def load(path: str, rt) -> None:
             idx._bucket_slot[:] = z["kvs.index.bucket_slot"]
             idx._rev[:] = z["kvs.index.rev"]
             idx.n_used = int(z["kvs.index.n_used"])
+        if getattr(kvs, "heap", None) is not None:
+            h = kvs.heap
+            log = np.asarray(z["kvs.heap.log"], np.uint8)
+            h._mirror[:] = 0
+            h._mirror[: log.shape[0]] = log
+            h._cursor = int(z["kvs.heap.cursor"])
+            h._dev = None  # device log re-syncs lazily from the mirror
+            h._synced = 1
+            # accounting restarts with the restored log: counters from
+            # the target's pre-load life would blend two stores (a stale
+            # live_bytes feeds the heap_util gauge until the next GC)
+            h.appends = h.append_bytes = 0
+            h.gc_runs = h.gc_reclaimed_bytes = 0
+            h.live_bytes = 0
     restored = _rebuild(state, z, "state.")
     if hasattr(rt, "fs"):
         rt.fs = restored
@@ -407,22 +434,11 @@ def load(path: str, rt) -> None:
 # _bank_to_i32 defines on device.
 
 
-def _rows_to_i32(rows8: np.ndarray) -> np.ndarray:
-    """Host mirror of faststep._bank_to_i32: int8 byte rows (..., 4*W) ->
-    int32 words (..., W), little-endian byte composition."""
-    u = rows8.view(np.uint8).astype(np.uint32)
-    w = (u[..., 0::4] | (u[..., 1::4] << 8)
-         | (u[..., 2::4] << 16) | (u[..., 3::4] << 24))
-    return np.ascontiguousarray(w).view(np.int32)
-
-
-def _i32_to_rows(rows32: np.ndarray) -> np.ndarray:
-    """Inverse of _rows_to_i32 (host mirror of faststep._i32_to_bank)."""
-    u = np.ascontiguousarray(rows32).view(np.uint32)
-    parts = np.stack([((u >> (8 * k)) & 0xFF) for k in range(4)],
-                     axis=-1).astype(np.uint8)
-    b = parts.reshape(rows32.shape[:-1] + (4 * rows32.shape[-1],))
-    return b.view(np.int8)
+# Round-17: the host byte<->word codec is ONE implementation
+# (transport/codec.rows_to_words — the heap and the serving wire share
+# it); these aliases keep this module's historical names working.
+from hermes_tpu.transport.codec import rows_to_words as _rows_to_i32  # noqa: E402
+from hermes_tpu.transport.codec import words_to_rows as _i32_to_rows  # noqa: E402
 
 
 def _range_rows(rt, lo: int, hi: int):
@@ -473,10 +489,17 @@ def save_range(path: str, rt, lo: int, hi: int) -> dict:
     copies of the range are verified byte-identical.  Carries the range's
     cumulative version-rebase deltas (``ver_base``) so the destination can
     re-anchor recorded versions into the source's global version space.
-    Returns the manifest."""
+    Returns the manifest.
+
+    Value heap (round-17): when the facade is a heap-mode KVS, the
+    range's live extents travel WITH the rows — per-row byte lengths
+    (-1 = no extent) plus one concatenated blob, under the same
+    checksummed manifest, so a migration moves the bytes the ref words
+    name and the destination re-appends them into ITS log."""
+    kvs = None
     if hasattr(rt, "rt") and hasattr(rt, "index"):  # the KVS facade
-        rt.flush()
-        rt = rt.rt
+        kvs, rt = rt, rt.rt
+        kvs.flush()
     if not hasattr(rt, "fs"):
         raise NotImplementedError(
             "save_range reads the faststep table (FastRuntime/KVS); the "
@@ -494,6 +517,21 @@ def save_range(path: str, rt, lo: int, hi: int) -> dict:
         "meta.cfg": np.frombuffer(
             json.dumps(dataclasses.asdict(rt.cfg)).encode(), dtype=np.uint8),
     }
+    heap = getattr(kvs, "heap", None) if kvs is not None else None
+    if heap is not None:
+        from hermes_tpu.core import faststep as fst
+
+        refs = _rows_to_i32(bank)[:, fst.BANK_VAL + 2]
+        lens = np.full(hi - lo, -1, np.int64)
+        parts = []
+        for i, ref in enumerate(refs):
+            if int(ref):
+                ext = heap.read(int(ref))
+                lens[i] = len(ext)
+                parts.append(np.frombuffer(ext, np.uint8))
+        arrays["range.heap_lens"] = lens
+        arrays["range.heap_blob"] = (
+            np.concatenate(parts) if parts else np.zeros(0, np.uint8))
     manifest = dict(
         version=MANIFEST_VERSION,
         scope=f"range:[{lo},{hi})",
@@ -537,6 +575,36 @@ def read_range(path: str):
             f"range archive row count {vpts.shape[0]} != declared "
             f"[{lo}, {hi})")
     return manifest, np.arange(lo, hi, dtype=np.int64), vpts, rows32, ver_base
+
+
+def read_range_heap(path: str):
+    """The value-heap extents of a range archive (round-17): returns
+    ``(lens, extents)`` — per-row byte lengths (-1 = the row has no
+    extent) and the per-row byte payloads (None where absent) — or None
+    when the archive carries no heap section (a fixed-word source).
+    Checksums were already verified by ``read_range``; this re-verifies
+    independently so the two reads cannot get out of sync."""
+    with np.load(path) as z:
+        manifest = _verify_npz(z)
+        if not manifest.get("scope", "full").startswith("range:"):
+            raise ValueError("not a range archive")
+        if "range.heap_lens" not in z:
+            return None
+        lens = np.asarray(z["range.heap_lens"], np.int64)
+        blob = np.asarray(z["range.heap_blob"], np.uint8)
+    have = lens[lens >= 0].sum()
+    if have != blob.shape[0]:
+        raise ValueError(
+            f"range heap blob is {blob.shape[0]} bytes but the lengths "
+            f"declare {int(have)} (truncated/corrupt archive)")
+    out, off = [], 0
+    for ln in lens:
+        if ln < 0:
+            out.append(None)
+        else:
+            out.append(blob[off:off + int(ln)].tobytes())
+            off += int(ln)
+    return lens, out
 
 
 def write_rows(rt, dest_slots, vpts, rows32) -> None:

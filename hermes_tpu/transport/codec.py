@@ -136,3 +136,69 @@ def stack(blocks):
     """Stack per-source blocks into an inbound block with leading R axis."""
     first = blocks[0]
     return type(first)(*[np.stack([np.asarray(b[i]) for b in blocks]) for i in range(len(first))])
+
+
+# --------------------------------------------------------------------------
+# The host byte<->word codec (round-17: ONE implementation).
+#
+# The fast engines store values as int8 BYTE rows on device and int32 words
+# at every host boundary (faststep._bank_to_i32 defines the byte order:
+# little-endian word composition).  The host-side mirror of that codec used
+# to live as private helpers in snapshot.py; the value heap (variable-
+# length extents, ragged byte lengths) and the serving wire need it too, so
+# it lives here now — snapshot.py aliases these.  Discipline: every
+# conversion is a pure byte REINTERPRET (numpy views over contiguous
+# buffers), never an astype — an astype of int8 bytes through a signed
+# intermediate shears/sign-extends the tail bytes exactly the way the
+# analyzer's dtype pass bans on device (tests/test_heap.py property-tests
+# the adversarial lengths 0 / 1 / word-1 / word / word+1 / max with
+# high-bit bytes in every position).
+# --------------------------------------------------------------------------
+
+
+def rows_to_words(rows8: np.ndarray) -> np.ndarray:
+    """int8 byte rows (..., 4*W) -> int32 words (..., W): host mirror of
+    faststep._bank_to_i32 (little-endian byte composition)."""
+    u = rows8.view(np.uint8).astype(np.uint32)
+    w = (u[..., 0::4] | (u[..., 1::4] << 8)
+         | (u[..., 2::4] << 16) | (u[..., 3::4] << 24))
+    return np.ascontiguousarray(w).view(np.int32)
+
+
+def words_to_rows(rows32: np.ndarray) -> np.ndarray:
+    """Inverse of ``rows_to_words`` (host mirror of faststep._i32_to_bank)."""
+    u = np.ascontiguousarray(rows32).view(np.uint32)
+    parts = np.stack([((u >> (8 * k)) & 0xFF) for k in range(4)],
+                     axis=-1).astype(np.uint8)
+    b = parts.reshape(rows32.shape[:-1] + (4 * rows32.shape[-1],))
+    return b.view(np.int8)
+
+
+def bytes_to_words(data, n_words=None) -> np.ndarray:
+    """Ragged bytes -> zero-padded little-endian int32 words.  ``n_words``
+    fixes the output width (the config-width discipline: both ends derive
+    it from the same config); default is the tightest fit.  Byte-exact
+    round trip with ``words_to_bytes`` for EVERY length including 0 and
+    non-word-multiples — the tail bytes ride a zero-padded buffer view,
+    never a sign-extending arithmetic conversion."""
+    raw = bytes(data)
+    need = (len(raw) + 3) // 4
+    if n_words is None:
+        n_words = need
+    elif need > n_words:
+        raise ValueError(f"{len(raw)} bytes exceed {n_words} int32 words")
+    buf = np.zeros(4 * n_words, np.uint8)
+    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    return buf.view(np.dtype("<i4")).copy()
+
+
+def words_to_bytes(words, length=None) -> bytes:
+    """int32 words -> the first ``length`` bytes (little-endian); default
+    the full word span.  Inverse of ``bytes_to_words``."""
+    w = np.ascontiguousarray(np.asarray(words, np.int32).ravel())
+    raw = w.astype(np.dtype("<i4"), copy=False).tobytes()
+    if length is None:
+        return raw
+    if length > len(raw):
+        raise ValueError(f"length {length} exceeds the {len(raw)}-byte span")
+    return raw[:length]

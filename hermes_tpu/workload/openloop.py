@@ -109,6 +109,11 @@ class MixSpec:
     hot_frac: float = 0.8            # hotkey mode: share of ops on hot set
     hot_keys: int = 4                # hotkey mode: size of the hot set
     tenants: int = 4
+    # round-17 value heap: > 0 adds a seeded memcached-shaped per-op
+    # value-size column (``vlen``, ycsb.value_sizes) capped here; the
+    # per-op bytes derive from ycsb.value_payload(seed, i, vlen[i])
+    value_bytes: int = 0
+    size_theta: float = 0.99
 
 
 def make_mix(spec: MixSpec, n_keys: int, n: int, seed: int,
@@ -157,7 +162,18 @@ def make_mix(spec: MixSpec, n_keys: int, n: int, seed: int,
     tenant = (np.arange(n, dtype=np.int64) % spec.tenants).astype(np.int32)
     value = rng.integers(1, 1 << 20, size=(n, value_words),
                          dtype=np.int64).astype(np.int32)
-    return dict(kind=kind, key=key, tenant=tenant, value=value)
+    mix = dict(kind=kind, key=key, tenant=tenant, value=value)
+    if spec.value_bytes > 0:
+        # heap mode (round-17): per-op byte LENGTHS ride the mix
+        # (memcached-shaped, seeded — ycsb.value_sizes); the bytes
+        # themselves derive from ycsb.value_payload so a soak never
+        # materializes n * max_value_bytes of payload up front
+        from hermes_tpu.workload.ycsb import value_sizes
+
+        mix["vlen"] = value_sizes(
+            dict(n=n, max_bytes=spec.value_bytes, theta=spec.size_theta),
+            seed)
+    return mix
 
 
 def hot_set(spec: MixSpec) -> tuple:
@@ -186,12 +202,15 @@ def scenario_seed(repo_root: Optional[str] = None) -> int:
         return 14
 
 
-def scenario_matrix(tenants: int = 4) -> List[MixSpec]:
+def scenario_matrix(tenants: int = 4, value_bytes: int = 0) -> List[MixSpec]:
     """The serving bench/gate scenarios: uniform, zipfian hot-rank, and
     explicit hot-key mixes (CHECKED_ZIPFIAN-anchored seed picks the
     draws; the SHAPES are fixed), plus the round-16 read-heavy YCSB
     B/C/D cells (ycsb.READ_MIXES — B = 95/5 zipfian, C = read-only
-    zipfian, D = 95/5 latest-distribution reads)."""
+    zipfian, D = 95/5 latest-distribution reads).  ``value_bytes > 0``
+    (round-17, heap-mode stores) appends the memcached-shaped
+    variable-size value scenario — zipfian keys AND zipfian-over-size-
+    classes payloads (ycsb.value_sizes)."""
     from hermes_tpu.workload.ycsb import READ_MIXES
 
     out = [
@@ -203,6 +222,10 @@ def scenario_matrix(tenants: int = 4) -> List[MixSpec]:
     ]
     for name, kw in READ_MIXES.items():
         out.append(MixSpec(name=f"ycsb_{name}", tenants=tenants, **kw))
+    if value_bytes > 0:
+        out.append(MixSpec(name="values", distribution="zipfian",
+                           zipf_theta=0.99, tenants=tenants,
+                           value_bytes=value_bytes))
     return out
 
 
@@ -218,6 +241,7 @@ class ClosedLoop:
         self.mix = make_mix(spec, n_keys, n, seed, value_words)
         self.n = n
         self.cursor = 0
+        self._seed = int(seed)
 
     def next_op(self) -> Optional[dict]:
         if self.cursor >= self.n:
@@ -225,6 +249,12 @@ class ClosedLoop:
         i = self.cursor
         self.cursor += 1
         m = self.mix
-        return dict(kind=("get", "put", "rmw")[int(m["kind"][i])],
-                    key=int(m["key"][i]), tenant=int(m["tenant"][i]),
-                    value=m["value"][i].tolist())
+        op = dict(kind=("get", "put", "rmw")[int(m["kind"][i])],
+                  key=int(m["key"][i]), tenant=int(m["tenant"][i]),
+                  value=m["value"][i].tolist())
+        if "vlen" in m:
+            # heap mode: the op's byte payload, derived not stored
+            from hermes_tpu.workload.ycsb import value_payload
+
+            op["data"] = value_payload(self._seed, i, int(m["vlen"][i]))
+        return op

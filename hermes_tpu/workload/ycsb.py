@@ -67,6 +67,52 @@ READ_MIXES = {
 # recency, windowed so the CDF is precomputable once).
 LATEST_WINDOW = 1024
 
+# Round-17 memcached-shaped value-size classes (bytes): the heap's
+# workload truth.  Facebook's memcached traces (Atikoglu et al., and the
+# distribution PAPER.md's "tens of bytes to KBs" echoes) put most values
+# in the tens-of-bytes classes with a long tail into KBs — a Zipfian over
+# ASCENDING size classes reproduces that shape: rank 0 (most probable) is
+# the smallest class.
+VALUE_SIZE_CLASSES = (16, 32, 64, 128, 256, 512, 1024, 2048)
+
+
+def value_sizes(spec: dict, seed: int) -> np.ndarray:
+    """Seeded memcached-shaped value-size draw (round-17): ``spec`` is
+    ``{"n": count, "max_bytes": cap, "classes": sizes?, "theta": t?}`` —
+    a Zipfian(theta) over the size classes <= cap, smallest class most
+    probable.  Deterministic: same (spec, seed) => byte-identical array
+    (``tobytes`` equality, test-asserted), the chaos-schedule replay
+    discipline applied to payload shapes.  Returns (n,) int64 byte
+    lengths."""
+    n = int(spec["n"])
+    cap = int(spec.get("max_bytes", VALUE_SIZE_CLASSES[-1]))
+    if cap < 1:
+        raise ValueError("max_bytes must be >= 1")
+    classes = tuple(c for c in spec.get("classes", VALUE_SIZE_CLASSES)
+                    if c <= cap)
+    if not classes:
+        classes = (cap,)
+    theta = float(spec.get("theta", 0.99))
+    rng = np.random.default_rng(
+        (int(seed) * 0xA24BAED4963EE407 + 5) & 0xFFFFFFFFFFFFFFFF)
+    cdf = _zipf_cdf(len(classes), theta)
+    ranks = np.searchsorted(cdf, rng.random(size=n))
+    return np.asarray(classes, np.int64)[ranks]
+
+
+def value_payload(seed: int, i: int, nbytes: int) -> bytes:
+    """Deterministic per-op payload bytes: a counter-hash fill (the
+    device-stream _mix32 applied to byte indices), so a checked run can
+    recompute any op's expected bytes from (seed, op index, length)
+    without storing them."""
+    if nbytes <= 0:
+        return b""
+    idx = np.arange((nbytes + 3) // 4, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        words = _mix32(idx ^ np.uint32((seed * 0x9E3779B9 + i * 0x85EBCA6B)
+                                       & 0xFFFFFFFF))
+    return words.tobytes()[:nbytes]
+
 
 def latest_ages(rng: np.random.Generator, n: int, theta: float = 0.99
                 ) -> np.ndarray:

@@ -1,0 +1,319 @@
+"""Round-17 value-heap gate (CI, the TENTH gate): variable-length values
+are a storage layer under the whole stack, so the gate proves the layer
+end to end, CPU-smoke sized (joins the nine earlier gates in
+scripts/run_gates.py — gates run SERIALLY, never beside pytest):
+
+  1. heap soak, both engines — seeded memcached-shaped overwrite churn
+     (ycsb.value_sizes) at pipeline depth 2 against a DELIBERATELY small
+     log, so allocation-pressure GC and an explicit rebase-boundary GC
+     both fire mid-load: every surviving value must read back byte-exact
+     (multi_get AND the raw device extent gather, cross-checked against
+     the host mirror), the linearizability checker stays green with
+     ``stale_read == []``, and post-compaction utilization (live bytes /
+     allocated prefix) must hold the UTIL_FLOOR — the bounded-heap
+     proof: compaction actually reclaims, the log cannot creep;
+  2. fleet migration with extents — a 2-group heap-mode fleet moves a
+     live range between groups: the extents must re-appear byte-exact
+     behind the destination group's OWN refs, and the fleet checker +
+     invariants must hold;
+  3. torn-heap-snapshot red test — a clean snapshot restores every
+     payload byte-exact, and the SAME archive with one bit flipped in
+     the heap log member must REFUSE to load on its manifest checksum
+     (a torn heap is a torn snapshot, never silently served);
+  4. census-unchanged — the write-round programs of a heap-mode config
+     must lower to EXACTLY the same op census as the fixed-word config
+     (batched 12 / sharded 15 sparse, mega 4 / 7 — the protocol carries
+     only the packed HEAP_REF word, the extent lands before the INV
+     issues), and the heap's own dispatches must hold their OP_BUDGET
+     sections (heap_path: ONE gather; heap_append: zero sparse ops).
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_heap.py
+
+Prints one JSON line (also written to HEAP_SOAK.json); exit non-zero on
+any violation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import zipfile
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+SEED = 17
+#: Post-compaction utilization floor: live bytes over the allocated
+#: prefix.  Compaction packs extents back-to-back, so the only slack is
+#: granule rounding (< 16 bytes per extent) — 0.75 leaves margin for a
+#: small-value draw while still catching a compactor that leaks extents.
+UTIL_FLOOR = 0.75
+
+
+def _cfg(**over):
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    kw = dict(
+        n_replicas=3, n_keys=64, n_sessions=8, replay_slots=8,
+        ops_per_session=96, value_words=3, pipeline_depth=2,
+        max_value_bytes=256, heap_bytes=1 << 13,
+        workload=WorkloadConfig(read_frac=0.5, seed=SEED),
+    )
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _store(backend: str):
+    from hermes_tpu.kvs import KVS
+
+    if backend == "sharded":
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:3]), ("replica",))
+        return KVS(_cfg(), backend="sharded", mesh=mesh, record="array")
+    return KVS(_cfg(), record=True)
+
+
+def check_heap_soak(report: dict) -> None:
+    import numpy as np
+
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.workload.ycsb import value_payload, value_sizes
+
+    for backend in ("batched", "sharded"):
+        store = _store(backend)
+        cfg = store.cfg
+        rng = np.random.default_rng(SEED)
+        latest = {}
+        rounds = 24
+        per = 16
+        lens = value_sizes(dict(n=rounds * per,
+                                max_bytes=cfg.max_value_bytes), SEED)
+        for r in range(rounds):
+            keys = rng.permutation(cfg.n_keys)[:per].astype(np.int64)
+            pays = [value_payload(SEED, r * per + j, int(lens[r * per + j]))
+                    for j in range(per)]
+            bf = store.submit_batch(np.full(per, KVS.PUT, np.int32),
+                                    keys, pays)
+            assert store.run_batch(bf, max_steps=4000), (
+                f"{backend}: churn round {r} did not drain")
+            for k, p in zip(keys, pays):
+                latest[int(k)] = p
+            if r == rounds // 2:
+                assert store.heap_gc(reason="gate-midload"), (
+                    f"{backend}: mid-load GC skipped on a drained store")
+        pressure_gcs = store.heap.gc_runs
+        assert pressure_gcs >= 2, (
+            f"{backend}: churn against a {cfg.heap_bytes}-byte log ran "
+            f"only {pressure_gcs} GC(s) — the pressure path never engaged")
+        stats = store.heap_gc(reason="gate-final")
+        assert stats, f"{backend}: final GC skipped"
+        util = stats["live_bytes"] / stats["used_bytes"]
+        assert util >= UTIL_FLOOR, (
+            f"{backend}: post-compaction utilization {util:.3f} < "
+            f"{UTIL_FLOOR} — compaction is leaking dead extents")
+        assert stats["used_bytes"] <= cfg.heap_bytes, backend
+
+        # byte-exactness: the client path AND the raw device log agree
+        # with the authoritative mirror for every surviving key
+        skeys = np.asarray(sorted(latest), np.int64)
+        res = store.multi_get(skeys)
+        assert res.all_done()
+        for j, k in enumerate(skeys):
+            assert res.data[j] == latest[int(k)], (
+                f"{backend}: key {int(k)} bytes diverged after GC")
+        refs = np.asarray(res.value)[:, 0].astype(np.int32)
+        rows, dlens = store.heap.device_gather(refs)
+        for j, k in enumerate(skeys):
+            got = rows[j, : int(dlens[j])].tobytes()
+            assert got == latest[int(k)], (
+                f"{backend}: device log diverged from mirror at key "
+                f"{int(k)}")
+        v = store.rt.check()
+        assert v.ok, (f"{backend} checker FAIL: "
+                      f"{[f.reason[:160] for f in v.failures[:2]]}")
+        stale = lin.stale_read(store.rt.history_ops())
+        assert stale == [], f"{backend}: stale reads {stale[:2]}"
+        report[f"{backend}_soak"] = dict(
+            churn_ops=rounds * per, keys_live=int(skeys.size),
+            gc_runs=int(store.heap.gc_runs),
+            reclaimed_bytes=int(store.heap.gc_reclaimed_bytes),
+            post_gc_util=round(util, 4), util_floor=UTIL_FLOOR,
+            checker_ok=True, stale_read=0)
+
+
+def check_fleet_migration(report: dict) -> None:
+    import numpy as np
+
+    from hermes_tpu.config import FleetConfig
+    from hermes_tpu.fleet import Fleet, verify_fleet
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.workload.ycsb import value_payload, value_sizes
+
+    base = _cfg(n_keys=48, n_sessions=4, replay_slots=4,
+                heap_bytes=1 << 14)
+    fleet = Fleet(FleetConfig(groups=2, base=base,
+                              ranges=((0, 32), (32, 64))), record=True)
+    n = 40
+    keys = np.arange(n, dtype=np.int64)
+    lens = value_sizes(dict(n=n, max_bytes=base.max_value_bytes), SEED + 1)
+    pays = [value_payload(SEED + 1, i, int(lens[i])) for i in range(n)]
+    fb = fleet.submit_batch(np.full(n, KVS.PUT, np.int32), keys, pays)
+    for _ in range(6000):
+        if fb.all_done():
+            break
+        fleet.step()
+    assert fb.all_done(), "fleet puts did not drain"
+    summary = fleet.migrate(0, 8, 1)
+    assert summary.get("heap_extents", 0) == 8, (
+        f"migration moved {summary.get('heap_extents')} extents, wanted 8")
+    res = fleet.multi_get(keys)
+    for _ in range(6000):
+        if res.all_done():
+            break
+        fleet.step()
+    assert res.all_done()
+    for i in range(n):
+        assert res.data[i] == pays[i], (
+            f"fleet key {i} bytes diverged across the migration")
+    verdicts = fleet.check()
+    assert verdicts["ok"], f"fleet checker FAIL {verdicts}"
+    verify_fleet(fleet)
+    report["fleet_migration"] = dict(
+        keys=n, migrated_extents=int(summary["heap_extents"]),
+        byte_exact=True, checker_ok=True)
+
+
+def check_torn_snapshot(report: dict) -> None:
+    import tempfile
+
+    import numpy as np
+
+    from hermes_tpu import snapshot
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.workload.ycsb import value_payload, value_sizes
+
+    store = KVS(_cfg())
+    n = 32
+    lens = value_sizes(dict(n=n, max_bytes=256), SEED + 2)
+    pays = [value_payload(SEED + 2, i, int(lens[i])) for i in range(n)]
+    bf = store.submit_batch(np.full(n, KVS.PUT, np.int32),
+                            np.arange(n, dtype=np.int64), pays)
+    assert store.run_batch(bf)
+    with tempfile.TemporaryDirectory(prefix="hermes_heap_gate_") as d:
+        p = os.path.join(d, "heap.npz")
+        snapshot.save(p, store)
+        tgt = KVS(_cfg())
+        snapshot.load(p, tgt)
+        res = tgt.multi_get(np.arange(n, dtype=np.int64))
+        assert res.all_done()
+        for i in range(n):
+            assert res.data[i] == pays[i], (
+                f"key {i} bytes diverged across snapshot restore")
+        torn = os.path.join(d, "torn.npz")
+        with zipfile.ZipFile(p) as zin, zipfile.ZipFile(torn, "w") as zout:
+            for name in zin.namelist():
+                data = bytearray(zin.read(name))
+                if name.startswith("kvs.heap.log"):
+                    data[len(data) // 2] ^= 0xFF
+                zout.writestr(name, bytes(data))
+        try:
+            snapshot.load(torn, KVS(_cfg()))
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(
+                "a bit-flipped heap log LOADED — the torn-snapshot "
+                "checksum is not covering the value heap")
+    report["torn_snapshot"] = dict(restore_byte_exact=True, torn_red=True)
+
+
+def check_census_unchanged(report: dict) -> None:
+    """The round census must not know the heap exists."""
+    import bench
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from hermes_tpu import heap as heap_lib
+    from hermes_tpu.obs import profile as prof
+
+    cfg = bench._cfg("a")
+    heap_cfg = dataclasses.replace(
+        cfg, value_words=max(3, cfg.value_words), max_value_bytes=1024,
+        heap_bytes=1 << 22)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+    pairs = {
+        "batched": (prof.op_census(cfg, "batched"),
+                    prof.op_census(heap_cfg, "batched")),
+        "sharded": (prof.op_census(cfg, "sharded", mesh),
+                    prof.op_census(heap_cfg, "sharded", mesh)),
+        "batched_mega": (
+            prof.op_census(dataclasses.replace(cfg, mega_round=True),
+                           "batched"),
+            prof.op_census(dataclasses.replace(heap_cfg, mega_round=True),
+                           "batched")),
+        "sharded_mega": (
+            prof.op_census(dataclasses.replace(cfg, mega_round=True),
+                           "sharded", mesh),
+            prof.op_census(dataclasses.replace(heap_cfg, mega_round=True),
+                           "sharded", mesh)),
+    }
+    with open("OP_BUDGET.json") as f:
+        budget = json.load(f)
+    for engine, (word, heap) in pairs.items():
+        assert word == heap, (
+            f"{engine}: heap mode MOVED the round census — the protocol "
+            f"is carrying value bytes (fixed-word {word} vs heap {heap})")
+        assert heap["sparse_total"] <= budget[engine]["sparse_total"], (
+            f"{engine}: sparse_total {heap['sparse_total']} over budget")
+    gather = heap_lib.gather_census(heap_cfg, batch=1024)
+    append = heap_lib.append_census(heap_cfg, chunk=4096)
+    for name, cen in (("heap_path", gather), ("heap_append", append)):
+        for k, ceiling in budget[name].items():
+            assert cen[k] <= ceiling, (
+                f"{name}.{k}: {cen[k]} exceeds the budget ceiling "
+                f"{ceiling}")
+    findings = heap_lib.analyze_gather(heap_cfg, batch=1024)
+    assert findings == [], (
+        f"extent gather analyzer findings: {[str(f) for f in findings[:3]]}")
+    report["census_unchanged"] = dict(
+        engines={e: p[0]["sparse_total"] for e, p in pairs.items()},
+        heap_path_sparse=gather["sparse_total"],
+        heap_append_sparse=append["sparse_total"],
+        analyzer_findings=0)
+
+
+def main() -> int:
+    report: dict = {"gate": "heap"}
+    try:
+        check_census_unchanged(report)
+        check_torn_snapshot(report)
+        check_heap_soak(report)
+        check_fleet_migration(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report, default=str))
+        return 1
+    report["ok"] = True
+    out = os.path.join(os.path.dirname(__file__), "..", "HEAP_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    print(json.dumps(report, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,17 @@ def main() -> int:
         "read_path": readpath.read_census(cfg, "batched"),
         "read_scan": readpath.scan_census(cfg, "batched"),
     }
+    # round-17: the value heap's own dispatches (hermes_tpu/heap) — the
+    # extent gather must answer a whole ref batch with ONE sparse op and
+    # the log append must stay dense; the round sections above not
+    # moving is the proof the protocol still carries only the packed
+    # HEAP_REF word (the extent lands before the INV issues)
+    from hermes_tpu import heap as heap_lib
+
+    hcfg = dataclasses.replace(cfg, value_words=max(3, cfg.value_words),
+                               max_value_bytes=1024, heap_bytes=1 << 22)
+    measured["heap_path"] = heap_lib.gather_census(hcfg, batch=1024)
+    measured["heap_append"] = heap_lib.append_census(hcfg, chunk=4096)
 
     with open(args.budget) as f:
         budget = {k: v for k, v in json.load(f).items()
@@ -135,6 +146,10 @@ def main() -> int:
                           sparse_read_path=measured["read_path"][
                               "sparse_total"],
                           sparse_read_scan=measured["read_scan"][
+                              "sparse_total"],
+                          sparse_heap_path=measured["heap_path"][
+                              "sparse_total"],
+                          sparse_heap_append=measured["heap_append"][
                               "sparse_total"],
                           budget_failures=failures, census_drift=drift)))
     return 0 if out["ok"] else 1

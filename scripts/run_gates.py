@@ -1,7 +1,7 @@
 """One serial runner for every CI gate (round-11 satellite).
 
-The nine gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
-netchaos, fleet, serving — MUST run serially and never beside a pytest run: the
+The ten gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
+netchaos, fleet, serving, heap — MUST run serially and never beside a pytest run: the
 obs-overhead gate measures per-round wall time against an ablation
 baseline and is contention-sensitive (a parallel pytest's CPU load turns a
 behavior-identical change into a spurious overhead failure).  That rule
@@ -45,6 +45,7 @@ GATES = (
     ("netchaos", "check_netchaos.py"),
     ("fleet", "check_fleet.py"),
     ("serving", "check_serving.py"),
+    ("heap", "check_heap.py"),
 )
 
 

@@ -137,6 +137,17 @@ SLOW_TESTS = {
     "test_scan_sparse_echoes_client_keys_in_write_order",
     "test_sharded_multi_get_serves_and_checks",
     "test_scan_probe_cannot_hide_cold_interior_behind_hot_endpoints",
+    # round-17 value heap: the quick tier keeps the batched round trip,
+    # the pressure/rebase GC churn, and the unit/codec/wire coverage;
+    # these heavier soaks (fleet composition, sharded engine, chaos at
+    # depth 2, the migration/snapshot/serving drills) ride the full
+    # suite + scripts/check_heap.py, which re-proves each end to end
+    "test_fleet_heap_roundtrip_and_cross_group_migration",
+    "test_gc_under_chaos_traffic_depth2",
+    "test_kvs_sharded_put_get_scan_byte_exact",
+    "test_migrate_range_moves_extents_byte_exact",
+    "test_snapshot_roundtrip_and_torn_heap_red",
+    "test_serving_loopback_heap_end_to_end",
 }
 
 

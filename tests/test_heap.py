@@ -1,0 +1,596 @@
+"""Round-17 value heap (hermes_tpu/heap): MICA-style variable-length
+values behind one packed HEAP_REF word per key.
+
+Covers the declared layout, the host byte<->word codec on adversarial
+ragged lengths, the ValueHeap allocator/compactor, analyzer + op-budget
+proofs for the device programs, byte-exact end-to-end round trips on
+BOTH engines (per-op, batched, multi_get, scan), GC at rebase and under
+seeded chaos traffic at pipeline depth 2, snapshot restore with a
+torn-heap red test, range migration with extents, the fleet composition,
+the serving wire's length-prefixed framing, and the workload size draw.
+"""
+
+import dataclasses
+import zipfile
+
+import numpy as np
+import pytest
+
+from hermes_tpu import heap as H
+from hermes_tpu import snapshot
+from hermes_tpu.checker import linearizability as lin
+from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
+from hermes_tpu.core import layouts
+from hermes_tpu.kvs import KVS
+from hermes_tpu.transport import codec
+
+
+def _cfg(**over):
+    kw = dict(n_replicas=3, n_keys=128, value_words=3, n_sessions=8,
+              replay_slots=8, ops_per_session=64,
+              max_value_bytes=256, heap_bytes=1 << 15,
+              workload=WorkloadConfig(read_frac=0.5, seed=3))
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _pay(i: int, n: int) -> bytes:
+    """Deterministic high-bit-heavy payload of length n."""
+    return bytes(((i * 37 + j * 151 + 128) & 0xFF) for j in range(n))
+
+
+def _sharded(cpu_devices, **over):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(cpu_devices[:3]), ("replica",))
+    return KVS(_cfg(**over), backend="sharded", mesh=mesh, record="array")
+
+
+# -- the declared layout -----------------------------------------------------
+
+
+def test_heap_ref_layout_and_pack_roundtrip():
+    f_len = layouts.HEAP_REF.field("len")
+    f_gran = layouts.HEAP_REF.field("gran")
+    assert f_len.shift == 0 and f_gran.shift == f_len.bits
+    # the declared budgets derive from the fields — an edit moves both
+    assert layouts.MAX_VALUE_BYTES == f_len.cap - 1
+    assert layouts.MAX_HEAP_BYTES == layouts.HEAP_GRANULE * f_gran.cap
+    for gran, ln in [(1, 0), (1, 1), (5, 255), (f_gran.cap - 1,
+                                                layouts.MAX_VALUE_BYTES)]:
+        ref = H.pack_ref(gran, ln)
+        assert H.ref_gran(ref) == gran and H.ref_len(ref) == ln
+        assert ref > 0  # sign bit stays clear: the word rides int32 columns
+        assert ref <= 0x7FFFFFFF
+
+
+def test_config_validates_heap_mode():
+    with pytest.raises(ValueError, match="value_words"):
+        HermesConfig(n_replicas=3, n_keys=8, n_sessions=2, value_words=2,
+                     max_value_bytes=64)
+    with pytest.raises(ValueError, match="granule"):
+        _cfg(heap_bytes=(1 << 15) + 1)
+    with pytest.raises(ValueError, match="len field|exceeds"):
+        _cfg(max_value_bytes=layouts.MAX_VALUE_BYTES + 1)
+    with pytest.raises(ValueError, match="two"):
+        _cfg(max_value_bytes=256, heap_bytes=layouts.HEAP_GRANULE * 2)
+    cfg = _cfg()
+    assert cfg.use_heap and cfg.heap_granules == cfg.heap_bytes // 16
+
+
+# -- the byte<->word codec on adversarial ragged lengths ---------------------
+
+# 0, 1, word-1, word, word+1 — plus mid sizes and the max — with high-bit
+# bytes in every position: the exact shear/sign-extension surface.
+RAGGED = (0, 1, 3, 4, 5, 7, 8, 9, 63, 64, 65, 255, 256)
+
+
+@pytest.mark.parametrize("n", RAGGED)
+def test_codec_bytes_words_roundtrip_ragged(n):
+    rng = np.random.default_rng(n)
+    for raw in (bytes([0xFF] * n), bytes([0x80] * n),
+                rng.integers(0, 256, n).astype(np.uint8).tobytes()):
+        words = codec.bytes_to_words(raw)
+        assert words.dtype == np.int32
+        assert codec.words_to_bytes(words, len(raw)) == raw
+        # fixed-width (config-width) form round-trips identically
+        wide = codec.bytes_to_words(raw, n_words=(n + 3) // 4 + 2)
+        assert codec.words_to_bytes(wide, len(raw)) == raw
+
+
+def test_codec_bytes_words_bounds():
+    with pytest.raises(ValueError, match="exceed"):
+        codec.bytes_to_words(b"x" * 9, n_words=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        codec.words_to_bytes(np.zeros(1, np.int32), length=5)
+    assert codec.words_to_bytes(codec.bytes_to_words(b"")) == b""
+
+
+def test_codec_rows_words_inverse_and_snapshot_alias():
+    rng = np.random.default_rng(7)
+    rows8 = rng.integers(-128, 128, size=(5, 3, 16)).astype(np.int8)
+    w = codec.rows_to_words(rows8)
+    assert w.shape == (5, 3, 4) and w.dtype == np.int32
+    np.testing.assert_array_equal(codec.words_to_rows(w), rows8)
+    # snapshot.py's historical names alias the ONE implementation
+    assert snapshot._rows_to_i32 is codec.rows_to_words
+    assert snapshot._i32_to_rows is codec.words_to_rows
+    # word composition is little-endian (the device _bank_to_i32 order)
+    one = np.array([0x11, 0x22, 0x33, -1], np.int8)
+    assert int(codec.rows_to_words(one)[0]) == np.int32(0xFF332211 - (1 << 32))
+
+
+# -- the workload size draw --------------------------------------------------
+
+
+def test_value_sizes_deterministic_and_shaped():
+    from hermes_tpu.workload.ycsb import (VALUE_SIZE_CLASSES, value_payload,
+                                          value_sizes)
+
+    spec = dict(n=4096, max_bytes=1024)
+    a = value_sizes(spec, 17)
+    b = value_sizes(spec, 17)
+    assert a.tobytes() == b.tobytes()  # replay-identical, the chaos rule
+    assert a.tobytes() != value_sizes(spec, 18).tobytes()
+    assert set(np.unique(a)) <= {c for c in VALUE_SIZE_CLASSES if c <= 1024}
+    # memcached shape: the smallest class is the most probable
+    counts = {int(c): int((a == c).sum()) for c in np.unique(a)}
+    assert counts[16] == max(counts.values())
+    assert int(a.max()) <= 1024
+    p = value_payload(17, 5, 100)
+    assert len(p) == 100 and p == value_payload(17, 5, 100)
+    assert p != value_payload(17, 6, 100)
+    assert value_payload(17, 5, 0) == b""
+
+
+def test_make_mix_carries_vlen_and_matrix_values_cell():
+    from hermes_tpu.workload.openloop import MixSpec, make_mix, scenario_matrix
+
+    spec = MixSpec(name="values", distribution="zipfian", value_bytes=512)
+    mix = make_mix(spec, 64, 256, 9, value_words=1)
+    assert "vlen" in mix and int(mix["vlen"].max()) <= 512
+    names = [s.name for s in scenario_matrix(value_bytes=512)]
+    assert "values" in names
+    assert "values" not in [s.name for s in scenario_matrix()]
+
+
+# -- ValueHeap unit ----------------------------------------------------------
+
+
+def test_heap_append_read_ragged_and_full():
+    heap = H.ValueHeap(_cfg(heap_bytes=1 << 10, max_value_bytes=64))
+    refs = {n: heap.append(_pay(n, n)) for n in (0, 1, 15, 16, 17, 64)}
+    for n, ref in refs.items():
+        assert heap.read(ref) == _pay(n, n)
+    with pytest.raises(ValueError, match="max_value_bytes"):
+        heap.append(b"x" * 65)
+    with pytest.raises(H.HeapFull):
+        for _ in range(64):
+            heap.append(b"y" * 64)
+    with pytest.raises(ValueError, match="dangling"):
+        heap.read(H.pack_ref(heap._cursor + 1, 4))
+
+
+def test_heap_compact_remap_and_unrooted_ref():
+    heap = H.ValueHeap(_cfg(heap_bytes=1 << 12, max_value_bytes=64))
+    live, dead = [], []
+    for i in range(12):
+        dead.append(heap.append(_pay(i, 40)))       # overwritten
+        live.append(heap.append(_pay(100 + i, 33)))  # survives
+    used0 = heap.used_bytes()
+    old, new = heap.compact(np.asarray(live, np.int64))
+    remapped = H.ValueHeap.remap(np.asarray(live, np.int64), old, new)
+    for i, ref in enumerate(remapped):
+        assert heap.read(int(ref)) == _pay(100 + i, 33)
+    assert heap.used_bytes() < used0
+    assert heap.stats()["util"] is not None
+    assert heap.live_bytes == 33 * 12
+    # null refs stay null; an unrooted ref must raise, never survive
+    assert H.ValueHeap.remap(np.zeros(3, np.int64), old, new).sum() == 0
+    with pytest.raises(ValueError, match="root"):
+        H.ValueHeap.remap(np.asarray([dead[0]], np.int64), old, new)
+
+
+def test_heap_device_gather_matches_mirror_and_clamps():
+    heap = H.ValueHeap(_cfg())
+    refs = [heap.append(_pay(i, n)) for i, n in enumerate(RAGGED)]
+    rows, lens = heap.device_gather(np.asarray(refs, np.int32))
+    for i, n in enumerate(RAGGED):
+        assert int(lens[i]) == n
+        assert rows[i, :n].tobytes() == _pay(i, n)
+        assert not rows[i, n:].any()  # masked past the extent: no leaks
+    # untrusted refs clamp in bounds instead of faulting (wire-clamp rule)
+    hostile = np.asarray([H.pack_ref(heap.granules - 1, 256), -1], np.int32)
+    rows, lens = heap.device_gather(hostile)
+    assert rows.shape[1] == heap.cap
+
+
+# -- analyzer + op budget ----------------------------------------------------
+
+
+def test_heap_gather_analyzer_clean_and_census_budget():
+    import json
+
+    cfg = _cfg()
+    assert H.analyze_gather(cfg, batch=256) == []
+    g = H.gather_census(cfg, batch=256)
+    a = H.append_census(cfg, chunk=1024)
+    with open("OP_BUDGET.json") as f:
+        budget = json.load(f)
+    for name, cen in (("heap_path", g), ("heap_append", a)):
+        for k, ceiling in budget[name].items():
+            assert cen[k] <= ceiling, (name, k, cen[k], ceiling)
+    assert g["sparse_total"] == 1   # ONE gather answers the whole batch
+    assert a["sparse_total"] == 0   # the append is dense
+
+
+# -- KVS end to end (both engines) -------------------------------------------
+
+
+def _roundtrip_kvs(kvs):
+    n = 48
+    keys = np.arange(n, dtype=np.int64)
+    pays = [_pay(i, (i * 7) % 200) for i in range(n)]
+    bf = kvs.submit_batch(np.full(n, KVS.PUT, np.int32), keys, pays)
+    assert kvs.run_batch(bf)
+    res = kvs.multi_get(keys)
+    assert res.all_done()
+    assert all(res.data[i] == pays[i] for i in range(n))
+    sc = kvs.scan(0, n)
+    assert sc.all_done()
+    assert all(sc.data[i] == pays[i] for i in range(n))
+    # batched completions carry the bytes too
+    c = bf.future(3).result()
+    assert c.uid is not None
+    return keys, pays
+
+
+def test_kvs_batched_put_get_scan_byte_exact():
+    kvs = KVS(_cfg(), record=True)
+    keys, pays = _roundtrip_kvs(kvs)
+    # per-op path: put/get/rmw completions carry .data
+    f = kvs.put(0, 0, 7, b"\x00\x80\xff new")
+    assert kvs.run_until([f])
+    g = kvs.get(0, 0, 7)
+    assert kvs.run_until([g])
+    assert g.result().data == b"\x00\x80\xff new"
+    r = kvs.rmw(0, 1, 7, b"after-rmw")
+    assert kvs.run_until([r])
+    c = r.result()
+    if c.kind == "rmw":  # read-part: the displaced bytes
+        assert c.data == b"\x00\x80\xff new"
+        g = kvs.get(0, 0, 7)
+        assert kvs.run_until([g])
+        assert g.result().data == b"after-rmw"
+    assert kvs.rt.check().ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+def test_kvs_rejects_word_payloads_in_heap_mode():
+    kvs = KVS(_cfg())
+    with pytest.raises(TypeError, match="byte payloads"):
+        kvs.put(0, 0, 1, [1, 2])
+    with pytest.raises(TypeError, match="byte payloads"):
+        kvs.submit_batch(np.full(2, KVS.PUT, np.int32),
+                         np.asarray([1, 2], np.int64), [b"ok", [3]])
+    with pytest.raises(ValueError, match="max_value_bytes"):
+        kvs.put(0, 0, 1, b"z" * 257)
+    # an update batch without payloads would commit null refs — refused
+    with pytest.raises(TypeError, match="values=None"):
+        kvs.submit_batch(np.full(2, KVS.PUT, np.int32),
+                         np.asarray([1, 2], np.int64))
+    # a read-only batch legitimately carries no values
+    bf = kvs.submit_batch(np.full(2, KVS.GET, np.int32),
+                          np.asarray([1, 2], np.int64))
+    assert kvs.run_batch(bf)
+
+
+def test_kvs_sharded_put_get_scan_byte_exact(cpu_devices):
+    kvs = _sharded(cpu_devices)
+    _roundtrip_kvs(kvs)
+    assert kvs.rt.check().ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+# -- GC ----------------------------------------------------------------------
+
+
+def test_heap_gc_on_pressure_and_explicit():
+    # a heap sized to force collection mid-load: overwrite churn must
+    # stay serviceable, with every surviving value byte-exact
+    kvs = KVS(_cfg(n_keys=32, heap_bytes=1 << 12, max_value_bytes=128),
+              record=True)
+    rng = np.random.default_rng(5)
+    latest = {}
+    for round_ in range(12):
+        keys = rng.permutation(32)[:16].astype(np.int64)
+        pays = [_pay(round_ * 64 + int(k), int(rng.integers(1, 128)))
+                for k in keys]
+        bf = kvs.submit_batch(np.full(16, KVS.PUT, np.int32), keys, pays)
+        assert kvs.run_batch(bf)
+        for k, p in zip(keys, pays):
+            latest[int(k)] = p
+    assert kvs.heap.gc_runs >= 1, "churn never triggered a pressure GC"
+    st = kvs.heap_gc(reason="test")
+    assert st and st["live_bytes"] <= st["used_bytes"]
+    res = kvs.multi_get(np.asarray(sorted(latest), np.int64))
+    assert res.all_done()
+    for j, k in enumerate(sorted(latest)):
+        assert res.data[j] == latest[k], k
+    assert kvs.rt.check().ok
+
+
+def test_heap_gc_rides_version_rebase():
+    kvs = KVS(_cfg())
+    bf = kvs.submit_batch(np.full(8, KVS.PUT, np.int32),
+                          np.arange(8, dtype=np.int64),
+                          [_pay(i, 20) for i in range(8)])
+    assert kvs.run_batch(bf)
+    # overwrite: half the extents die
+    bf = kvs.submit_batch(np.full(8, KVS.PUT, np.int32),
+                          np.arange(8, dtype=np.int64),
+                          [_pay(100 + i, 24) for i in range(8)])
+    assert kvs.run_batch(bf)
+    runs0 = kvs.heap.gc_runs
+    assert kvs.rt.rebase_versions() >= 0
+    assert kvs.heap.gc_runs == runs0 + 1, "rebase did not drive the GC"
+    res = kvs.multi_get(np.arange(8, dtype=np.int64))
+    assert res.all_done()
+    assert all(res.data[i] == _pay(100 + i, 24) for i in range(8))
+
+
+@pytest.mark.parametrize("engine", ["batched", "sharded"])
+def test_gc_under_chaos_traffic_depth2(engine, cpu_devices):
+    """Satellite: seeded chaos schedule at pipeline depth 2, rebase-GC
+    runs MID-LOAD on both engines — checker green, values byte-exact
+    after compaction, stale_read == []."""
+    from jax.sharding import Mesh
+
+    from hermes_tpu import chaos as chaos_lib
+
+    cfg = _cfg(pipeline_depth=2, n_keys=64, heap_bytes=1 << 13,
+               max_value_bytes=128)
+    if engine == "sharded":
+        mesh = Mesh(np.array(cpu_devices[:3]), ("replica",))
+        kvs = KVS(cfg, backend="sharded", mesh=mesh, record="array")
+    else:
+        kvs = KVS(cfg, record=True)
+    rng = np.random.default_rng(23)
+    lines, step = [], 0
+    for _ in range(3):
+        r = int(rng.integers(0, cfg.n_replicas))
+        fr = step + int(rng.integers(1, 4))
+        th = fr + int(rng.integers(3, 6))
+        lines += [f"@{fr} freeze {r}", f"@{th} thaw {r}"]
+        step = th + 2
+    runner = chaos_lib.ChaosRunner(kvs, chaos_lib.Schedule.parse(
+        "\n".join(lines)))
+    latest = {}
+    gcs = 0
+    for i in range(30):
+        runner.tick(i)
+        keys = rng.permutation(cfg.n_keys)[:8].astype(np.int64)
+        pays = [_pay(i * 101 + int(k), int(rng.integers(0, 120)))
+                for k in keys]
+        bf = kvs.submit_batch(np.full(8, KVS.PUT, np.int32), keys, pays)
+        assert kvs.run_batch(bf, max_steps=2000)
+        for k, p in zip(keys, pays):
+            latest[int(k)] = p
+        if i in (9, 19):  # rebase-GC mid-load (frozen windows included)
+            if kvs.heap_gc(reason="chaos-test"):
+                gcs += 1
+    for r in range(cfg.n_replicas):
+        kvs.rt.thaw(r)
+    kvs.rt.flush_pipeline()
+    kvs.flush()
+    assert gcs >= 1, "no mid-load GC completed (schedule left none viable)"
+    res = kvs.multi_get(np.asarray(sorted(latest), np.int64))
+    assert res.all_done()
+    for j, k in enumerate(sorted(latest)):
+        assert res.data[j] == latest[k], k
+    assert kvs.rt.check().ok
+    assert lin.stale_read(kvs.rt.history_ops()) == []
+
+
+# -- snapshot ----------------------------------------------------------------
+
+
+def test_snapshot_roundtrip_and_torn_heap_red(tmp_path):
+    kvs = KVS(_cfg())
+    n = 24
+    pays = [_pay(i, (i * 11) % 200) for i in range(n)]
+    bf = kvs.submit_batch(np.full(n, KVS.PUT, np.int32),
+                          np.arange(n, dtype=np.int64), pays)
+    assert kvs.run_batch(bf)
+    p = str(tmp_path / "heap.npz")
+    snapshot.save(p, kvs)
+
+    tgt = KVS(_cfg())
+    snapshot.load(p, tgt)
+    res = tgt.multi_get(np.arange(n, dtype=np.int64))
+    assert res.all_done()
+    assert all(res.data[i] == pays[i] for i in range(n))
+
+    # red: a bit-flipped heap log must reject on the manifest checksum —
+    # a torn heap blob is a torn snapshot, never silently served
+    torn = str(tmp_path / "torn.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(torn, "w") as zout:
+        for name in zin.namelist():
+            data = bytearray(zin.read(name))
+            if name.startswith("kvs.heap.log"):
+                data[len(data) // 2] ^= 0xFF
+            zout.writestr(name, bytes(data))
+    with pytest.raises(ValueError, match="checksum|torn"):
+        snapshot.load(torn, KVS(_cfg()))
+
+    # red: a heap-mode target rejects an archive missing the heap section
+    word_cfg = _cfg(max_value_bytes=0)
+    word = KVS(word_cfg)
+    pw = str(tmp_path / "word.npz")
+    snapshot.save(pw, word)
+    with pytest.raises(ValueError, match="heap|missing|fingerprint"):
+        snapshot.load(pw, KVS(_cfg()))
+
+
+# -- migration ---------------------------------------------------------------
+
+
+def test_migrate_range_moves_extents_byte_exact():
+    from hermes_tpu.elastic import migrate_range
+
+    src, dst = KVS(_cfg()), KVS(_cfg())
+    n = 48
+    pays = [_pay(i, (i * 13) % 180) for i in range(n)]
+    bf = src.submit_batch(np.full(n, KVS.PUT, np.int32),
+                          np.arange(n, dtype=np.int64), pays)
+    assert src.run_batch(bf)
+    s = migrate_range(src, dst, 8, 40)
+    assert s["heap_extents"] == 32
+    res = dst.multi_get(np.arange(8, 40, dtype=np.int64))
+    assert res.all_done()
+    assert all(res.data[j] == pays[8 + j] for j in range(32))
+    # destination refs are its OWN granules: its mirror serves them
+    assert dst.heap.appends >= 32
+
+
+def test_migrate_refuses_heap_mode_mismatch():
+    from hermes_tpu.elastic import migrate_range
+
+    src = KVS(_cfg())
+    dst = KVS(_cfg(max_value_bytes=0, value_words=3))
+    with pytest.raises(ValueError, match="heap"):
+        migrate_range(src, dst, 0, 8)
+    small = KVS(_cfg(max_value_bytes=128))
+    with pytest.raises(ValueError, match="cannot hold"):
+        migrate_range(src, small, 0, 8)
+
+
+# -- fleet -------------------------------------------------------------------
+
+
+def test_fleet_heap_roundtrip_and_cross_group_migration():
+    from hermes_tpu.fleet import Fleet
+
+    base = _cfg(n_keys=48, n_sessions=4, replay_slots=4,
+                max_value_bytes=128, heap_bytes=1 << 14)
+    fleet = Fleet(FleetConfig(groups=2, base=base,
+                              ranges=((0, 32), (32, 64))), record=True)
+    keys = np.arange(40, dtype=np.int64)
+    pays = [_pay(i, (i * 5) % 120) for i in range(40)]
+    fb = fleet.submit_batch(np.full(40, KVS.PUT, np.int32), keys, pays)
+    for _ in range(4000):
+        if fb.all_done():
+            break
+        fleet.step()
+    assert fb.all_done()
+    res = fleet.multi_get(keys)
+    for _ in range(4000):
+        if res.all_done():
+            break
+        fleet.step()
+    assert res.all_done()
+    assert all(res.data[i] == pays[i] for i in range(40))
+    s = fleet.migrate(0, 8, 1)
+    assert s["heap_extents"] == 8
+    res = fleet.multi_get(keys)
+    for _ in range(4000):
+        if res.all_done():
+            break
+        fleet.step()
+    assert res.all_done()
+    assert all(res.data[i] == pays[i] for i in range(40))
+    assert fleet.check()["ok"]
+
+
+# -- serving wire ------------------------------------------------------------
+
+
+def test_wire_heap_request_response_roundtrip():
+    from hermes_tpu.serving import wire
+
+    vb = 256
+    for data in (None, b"", b"\x00", b"\xff" * vb):
+        req = wire.Request(kind="put", req_id=3, tenant=1, key=9, data=data)
+        out = wire.decode_request(wire.encode_request(req, 1, vb), 1, vb)
+        assert out.data == data and out.key == 9
+    # a get's tail is always empty on the wire
+    g = wire.Request(kind="get", req_id=4, tenant=0, key=2, data=b"junk")
+    assert wire.decode_request(wire.encode_request(g, 1, vb), 1, vb).data \
+        is None
+    rsp = wire.Response(status=wire.S_OK, req_id=3, found=True,
+                        uid=(1, 2), data=b"\x80abc")
+    out = wire.decode_response(wire.encode_response(rsp, 1, vb), 1, vb)
+    assert out.data == b"\x80abc" and out.uid == (1, 2)
+    # None (never written) survives distinct from b"" (a real empty value)
+    for data in (None, b""):
+        rsp = wire.Response(status=wire.S_OK, req_id=5, found=True, data=data)
+        assert wire.decode_response(
+            wire.encode_response(rsp, 1, vb), 1, vb).data == data
+
+
+def test_wire_heap_read_response_rows_and_adversarial():
+    from hermes_tpu.serving import wire
+
+    vb = 256
+    rr = wire.ReadResponse(status=wire.S_OK, req_id=1,
+                           found=[True, True, False],
+                           local=[True, False, False],
+                           codes=[wire.RK_OK] * 3,
+                           data=[b"\xffhi", b"", None])
+    buf = wire.encode_read_response(rr, 1, vb)
+    assert len(buf) == wire.rrsp_nbytes(1, 3, vb)
+    out = wire.decode_read_response(buf, 1, vb)
+    assert out.data == [b"\xffhi", b"", None]
+    assert out.found == [True, True, False]
+    # adversarial: truncated tail / oversized dlen refuse loudly
+    req = wire.Request(kind="put", req_id=1, tenant=0, key=1, data=b"abcd")
+    enc = wire.encode_request(req, 1, vb)
+    with pytest.raises(ValueError, match="truncated|size|declares"):
+        wire.decode_request(enc[:-2], 1, vb)
+    import struct
+
+    bad = enc[:wire._REQ.size] + struct.pack("<I", vb + 1) + b"x" * (vb + 1)
+    with pytest.raises(ValueError, match="declares"):
+        wire.decode_request(bad, 1, vb)
+
+
+def test_serving_loopback_heap_end_to_end():
+    from hermes_tpu.serving import wire
+    from hermes_tpu.serving.rpc import LoopbackServer
+    from hermes_tpu.serving.server import Frontend
+    from hermes_tpu.serving.soak import committed_uids
+
+    kvs = KVS(_cfg(), record=True)
+    fe = Frontend(kvs)
+    lb = LoopbackServer(fe)
+
+    def drive(req):
+        rsp = lb.submit(req)
+        if rsp is not None:
+            return rsp
+        for _ in range(400):
+            out = lb.pump()
+            if out:
+                return out[0]
+        raise AssertionError("no response")
+
+    pays = {k: _pay(k, 10 + 17 * k) for k in (1, 2, 3)}
+    for rid, (k, p) in enumerate(pays.items(), start=1):
+        rsp = drive(wire.Request(kind="put", req_id=rid, tenant=0, key=k,
+                                 data=p))
+        assert rsp.status == wire.S_OK and rsp.uid is not None
+    rsp = drive(wire.Request(kind="get", req_id=10, tenant=0, key=2))
+    assert rsp.data == pays[2]
+    rsp = drive(wire.ReadRequest(kind="mget", req_id=11, tenant=0,
+                                 keys=[1, 3, 5]))
+    assert rsp.data[0] == pays[1] and rsp.data[1] == pays[3]
+    assert rsp.data[2] is None  # never written
+    rsp = drive(wire.ReadRequest(kind="scan", req_id=12, tenant=0,
+                                 lo=1, hi=4))
+    assert rsp.data == [pays[1], pays[2], pays[3]]
+    # the response-log walker handles variable heap-mode records
+    assert len(committed_uids(fe, lb)) == 3
+    # an update without a payload is refused at the door
+    rsp = drive(wire.Request(kind="put", req_id=13, tenant=0, key=1))
+    assert rsp.status == wire.S_REJECTED
+    assert kvs.rt.check().ok
