@@ -799,6 +799,12 @@ class ChaosRunner:
             result["check_failures"] = [
                 getattr(f, "reason", str(f))[:200]
                 for f in (v.failures + v.undecided)[:3]]
+            if not v.ok and self.rt.obs is not None \
+                    and self.rt.obs.flight.dumps:
+                # checker red: rt.check() just dumped the flight recorder
+                # (round-18, obs/flightrec.py) — surface the archive path
+                # in the chaos result so soak triage finds it
+                result["flight_dump"] = self.rt.obs.flight.dumps[-1]
         result["events"] = self.log
         return result
 

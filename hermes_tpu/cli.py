@@ -101,6 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="with --metrics-out: per-step dispatch/readback "
                     "spans (verbose; faults/drains/intervals are always "
                     "traced)")
+    ap.add_argument("--trace-sample", type=int, default=0, metavar="N",
+                    help="per-op tracing (cfg.trace_sample): mint a trace "
+                    "id for ~1 in N submitted ops with a seeded "
+                    "deterministic sampler; spans land in the --metrics-out "
+                    "run log (obs/tracing.py); 0 disables")
     ap.add_argument("--freeze", action="append", default=[],
                     metavar="R:FROM:TO",
                     help="failure injection: freeze replica R at step FROM, "
@@ -288,7 +293,16 @@ def _run_serve(args, cfg) -> int:
     from hermes_tpu.workload.openloop import MixSpec
 
     kvs = KVS(cfg, record="array" if args.check else False)
-    scfg = ServingConfig()
+    obs = None
+    if args.metrics_out or args.trace_sample:
+        # the traced-serving quickstart (round-18): spans + series ride
+        # the run log; the report renders the per-op critical path
+        from hermes_tpu.obs import Observability
+
+        obs = kvs.rt.attach_obs(Observability(path=args.metrics_out,
+                                              trace_steps=args.trace_steps))
+    scfg = ServingConfig(trace_sample=args.trace_sample,
+                         trace_seed=args.seed)
     spec = MixSpec(name=cfg.workload.distribution,
                    distribution=cfg.workload.distribution,
                    zipf_theta=cfg.workload.zipf_theta,
@@ -297,6 +311,9 @@ def _run_serve(args, cfg) -> int:
         kvs, scfg, spec,
         rate_per_s=args.serve_rate, n=args.serve, seed=args.seed,
         deadline_us=args.serve_deadline_us)
+    if obs is not None:
+        obs.series_snapshot()
+        obs.close()
     summary = {k: v for k, v in res.items() if not k.startswith("_")}
     # the serving invariants (response conservation, per-tenant admission
     # accounting exactness) are asserted by verify_serving INSIDE
@@ -720,6 +737,7 @@ def main(argv=None) -> int:
         op_timeout_rounds=args.op_timeout,
         op_retry_limit=args.op_retries,
         min_healthy_for_writes=args.degraded_floor,
+        trace_sample=args.trace_sample,
         workload=WorkloadConfig(
             distribution=args.distribution,
             zipf_theta=args.zipf_theta,

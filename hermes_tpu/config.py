@@ -244,6 +244,14 @@ class HermesConfig:
     # of one op waits op_timeout_rounds * op_backoff**k rounds.
     op_backoff: int = 2
 
+    # Per-op tracing sample rate (round-18, obs/tracing.py): 0 = off,
+    # N >= 1 = trace ~1 in N submitted ops with a seeded deterministic
+    # sampler (seeded from workload seed; same ops trace on every replay).
+    # Host-only — the sampler, span emission, and id plumbing never touch
+    # the compiled round, so the lowered program and its op census are
+    # identical at any rate (scripts/check_op_census.py proves it).
+    trace_sample: int = 0
+
     # Quorum-loss degraded mode (round-11): with fewer than this many
     # healthy (live, unfrozen, unretired) replicas, NEW puts/RMWs are shed
     # loudly at submission (kind='rejected' / C_REJECTED — the op never
@@ -333,6 +341,9 @@ class HermesConfig:
                 "is what detects a wedged op in the first place)")
         if self.op_backoff < 1:
             raise ValueError("op_backoff must be >= 1")
+        if self.trace_sample < 0:
+            raise ValueError("trace_sample must be >= 0 (0 disables, N = "
+                             "one in N ops)")
         if not (0 <= self.min_healthy_for_writes <= self.n_replicas):
             raise ValueError(
                 "min_healthy_for_writes must be in [0, n_replicas]")

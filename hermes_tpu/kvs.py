@@ -437,6 +437,36 @@ class KVS:
         # each entry is a 1-D int32 array view whose nonzero entries are
         # live refs (see _heap_staging)
         self._staging: List[np.ndarray] = []
+        # per-op tracing (round-18, obs/tracing.py): a seeded deterministic
+        # sampler mints a trace id for ~1 in cfg.trace_sample submissions
+        # (0 = off).  The id rides the FUTURE (fut._trace + the submit /
+        # inject rounds), never the queue tuples or the device stream — the
+        # compiled round cannot see it, so the lowered program is identical
+        # at any rate.  _staged_trace carries an id minted UPSTREAM (the
+        # serving Frontend, off the wire field) into the next _enqueue.
+        if self.cfg.trace_sample:
+            from hermes_tpu.obs.tracing import TraceSampler
+
+            self._sampler: Optional[object] = TraceSampler(
+                self.cfg.trace_sample, seed=self.cfg.workload.seed)
+        else:
+            self._sampler = None
+        self._trace_seq = 0
+        self._staged_trace = 0
+        self._op_tracer_cache = None
+
+    def _op_tracer(self):
+        """Span writer bound to the runtime's CURRENT obs context (None
+        while none is attached — the unsampled/unattached fast path)."""
+        obs = self.rt.obs
+        if obs is None:
+            return None
+        c = self._op_tracer_cache
+        if c is None or c.obs is not obs:
+            from hermes_tpu.obs.tracing import OpTracer
+
+            c = self._op_tracer_cache = OpTracer(obs)
+        return c
 
     # -- client ops ----------------------------------------------------------
 
@@ -486,6 +516,17 @@ class KVS:
             # client is told NOW, not stranded
             return self._rejected_future(client_key)
         fut = Future()
+        # trace mint (round-18): adopt an id staged by the serving layer,
+        # else sample one; the submit sequence ticks for EVERY accepted
+        # submission so replays sample the same ops.  Unsampled futures
+        # never grow the attributes (getattr default keeps them free).
+        trace, self._staged_trace = self._staged_trace, 0
+        if not trace and self._sampler is not None:
+            trace = self._sampler.sample(self._trace_seq)
+        self._trace_seq += 1
+        if trace:
+            fut._trace = trace
+            fut._trace_r0 = self.rt.step_idx
         self._queues[(replica, session)].append(
             (kind, slot, client_key, value, fut, 0))
         self._queued_slots.add((replica, session))
@@ -785,6 +826,16 @@ class KVS:
             self._inflight[rs_key] = (kind, fut, client_key, value, nretry)
             self._kindarr[r, s] = self._OPC[kind]
             self._slot_inject[r, s] = self.rt.step_idx
+            trace = getattr(fut, "_trace", 0)
+            if trace:
+                # close the client-queue-wait span (submit -> injection)
+                # and pin the inject round for the op_rounds span
+                fut._trace_inject = self.rt.step_idx
+                tr = self._op_tracer()
+                if tr is not None:
+                    tr.span("op_queue", trace, r0=fut._trace_r0,
+                            r1=self.rt.step_idx, replica=r, session=s,
+                            op=kind, key=client_key)
             self._dirty = True
         self._ready.clear()
         self._ready |= waiting
@@ -900,6 +951,15 @@ class KVS:
                     slot = (client_key if self.index is None
                             else self.index.slot(client_key, insert=False))
                     self._ryw.setdefault((r, s), {})[int(slot)] = done.ts
+            trace = getattr(fut, "_trace", 0)
+            if trace:
+                # device-rounds span: injection round -> resolution round
+                tr = self._op_tracer()
+                if tr is not None:
+                    tr.span("op_rounds", trace,
+                            r0=getattr(fut, "_trace_inject", round_idx),
+                            r1=round_idx, replica=r, session=s,
+                            op=done.kind, key=client_key)
             fut._result = done
             if self._queues.get((r, s)):
                 self._ready.add((r, s))
@@ -986,6 +1046,12 @@ class KVS:
                 new_diags.append(diag)
                 self.stuck_ops.append(diag)
                 self.rt._trace("stuck_op", **diag)
+        if new_diags and self.rt.obs is not None:
+            # flight recorder (round-18): a wedged op is exactly the
+            # moment the black box exists for — dump BEFORE any strict
+            # raise so the archive holds the diagnostics (no-op unless a
+            # dump dir is configured; see obs/flightrec.py)
+            self.rt.obs.flight_dump("stuck_op", extra=dict(diags=new_diags))
         if self.cfg.op_retry_limit:
             self._escalate_stuck(stuck)
         if self.strict_timeouts and new_diags:

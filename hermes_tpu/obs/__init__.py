@@ -35,6 +35,7 @@ from __future__ import annotations
 
 from typing import IO, Optional
 
+from hermes_tpu.obs.flightrec import FlightRecorder
 from hermes_tpu.obs.metrics import (
     BufferExporter,
     Counter,
@@ -45,12 +46,20 @@ from hermes_tpu.obs.metrics import (
     percentile_from_counts,
     prometheus_text,
 )
+from hermes_tpu.obs.series import Series
 from hermes_tpu.obs.trace import Tracer
+from hermes_tpu.obs.tracing import (
+    OP_SPANS,
+    OpTracer,
+    TraceSampler,
+    canonical_span_bytes,
+)
 
 __all__ = [
-    "BufferExporter", "Counter", "Gauge", "Histogram", "JsonlExporter",
-    "MetricsRegistry", "Observability", "Tracer", "percentile_from_counts",
-    "prometheus_text",
+    "BufferExporter", "Counter", "FlightRecorder", "Gauge", "Histogram",
+    "JsonlExporter", "MetricsRegistry", "OP_SPANS", "Observability",
+    "OpTracer", "Series", "TraceSampler", "Tracer", "canonical_span_bytes",
+    "percentile_from_counts", "prometheus_text",
 ]
 
 
@@ -63,11 +72,21 @@ class Observability:
     ``trace_steps`` additionally emits per-step dispatch/readback spans —
     off by default (two records per protocol step is run-log noise at
     bench scale; faults, intervals, drains and rebases are always traced).
+
+    Round-18: every context also carries an always-on ``FlightRecorder``
+    — the exporter tees each stamped record into the recorder's bounded
+    ring, so any run with obs attached has a post-mortem black box at
+    the cost of one deque append per record.  Dumps are opt-in (a
+    ``flight_dir`` here, or HERMES_FLIGHT_DIR in the environment — see
+    obs/flightrec.py); ``flight_dump`` is the trigger entry point the
+    runtime checker, KVS watchdog, and soak drivers call.
     """
 
     def __init__(self, path: Optional[str] = None, fp: Optional[IO[str]] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 trace_steps: bool = False):
+                 trace_steps: bool = False,
+                 flight: Optional[FlightRecorder] = None,
+                 flight_dir: Optional[str] = None):
         self.registry = registry or MetricsRegistry()
         self._own_fp = None
         if fp is None and path is not None:
@@ -75,6 +94,21 @@ class Observability:
         self.exporter = JsonlExporter(fp) if fp is not None else BufferExporter()
         self.tracer = Tracer(self.exporter)
         self.trace_steps = trace_steps
+        self.flight = flight or FlightRecorder(dump_dir=flight_dir)
+        if flight is not None and flight_dir is not None:
+            self.flight.dump_dir = flight_dir
+        # tee: the recorder's ring sees the same stamped records the sink
+        # does, without disturbing the exporter's type (tests isinstance
+        # on BufferExporter) or its byte output
+        inner_write = self.exporter.write
+
+        def _tee_write(record: dict, kind: str = "metrics",
+                       _inner=inner_write) -> None:
+            self.flight.record({"t": round(self.exporter.now(), 6),
+                                "kind": kind, **record})
+            _inner(record, kind=kind)
+
+        self.exporter.write = _tee_write
 
     @property
     def records(self):
@@ -96,6 +130,19 @@ class Observability:
     def registry_snapshot(self) -> None:
         """Flush the host registry's current values as one record."""
         self.exporter.write(self.registry.snapshot(), kind="registry")
+
+    def series_snapshot(self) -> None:
+        """Flush every time series as one ``kind="series"`` record
+        (name -> parallel x/v arrays) — no-op when no series exist."""
+        snap = self.registry.series_snapshot()
+        if snap:
+            self.exporter.write(snap, kind="series")
+
+    def flight_dump(self, reason: str, extra: Optional[dict] = None):
+        """Trigger the flight recorder: dump one checksummed archive into
+        the configured dump dir (ctor ``flight_dir`` or HERMES_FLIGHT_DIR)
+        and return its path, or None when no dir is configured."""
+        return self.flight.auto_dump(reason, extra)
 
     def close(self) -> None:
         if isinstance(self.exporter, JsonlExporter):

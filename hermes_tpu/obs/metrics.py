@@ -27,6 +27,8 @@ from typing import IO, Dict, List, Optional, Union
 
 import numpy as np
 
+from hermes_tpu.obs.series import Series
+
 
 class Counter:
     """Monotone counter.  ``inc`` for host events; ``set_total`` for
@@ -102,7 +104,8 @@ class MetricsRegistry:
     same name with a different type is a bug and raises."""
 
     def __init__(self):
-        self._metrics: Dict[str, Union[Counter, Gauge, Histogram]] = {}
+        self._metrics: Dict[str, Union[Counter, Gauge, Histogram,
+                                       Series]] = {}
 
     def _get(self, name: str, cls, **kw):
         m = self._metrics.get(name)
@@ -122,6 +125,13 @@ class MetricsRegistry:
     def histogram(self, name: str, bins: int = 64, help: str = "") -> Histogram:
         return self._get(name, Histogram, bins=bins, help=help)
 
+    def series(self, name: str, capacity: int = 1024,
+               help: str = "") -> Series:
+        """Bounded windowed time series (obs/series.py) under the same
+        one-name-one-metric discipline.  ``capacity`` only applies at
+        creation; later calls return the existing ring unchanged."""
+        return self._get(name, Series, capacity=capacity, help=help)
+
     def __contains__(self, name: str) -> bool:
         return name in self._metrics
 
@@ -130,6 +140,8 @@ class MetricsRegistry:
         derived p50/p99 (None-omitted, matching stats.summarize)."""
         out: dict = {}
         for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Series):
+                continue  # full history exports via series_snapshot()
             if isinstance(m, Histogram):
                 out[name] = m.counts.tolist()
                 for q, tag in ((0.5, "p50"), (0.99, "p99")):
@@ -140,12 +152,21 @@ class MetricsRegistry:
                 out[name] = m.value
         return out
 
+    def series_snapshot(self) -> dict:
+        """JSON-ready view of every time series: name -> parallel x/v
+        arrays (the ``kind="series"`` record Observability exports)."""
+        return {name: m.snapshot()
+                for name, m in sorted(self._metrics.items())
+                if isinstance(m, Series)}
+
 
 def prometheus_text(reg: MetricsRegistry) -> str:
     """Prometheus text-exposition snapshot (counters/gauges as samples,
     histograms as cumulative ``_bucket`` series + ``_count``)."""
     lines: List[str] = []
     for name, m in sorted(reg._metrics.items()):
+        if isinstance(m, Series):
+            continue  # rings have no Prometheus shape; JSONL-only
         if m.help:
             lines.append(f"# HELP {name} {m.help}")
         if isinstance(m, Counter):

@@ -3,13 +3,17 @@
 Consumes the JSONL records an Observability run emits (interval metrics,
 trace events, span begin/end — one shared monotonic clock, see trace.py) and
 renders one causally ordered story: interval throughput next to the fault
-events that explain its dips, plus the device phase histograms from the
-final summary.  ``scripts/obs_report.py`` is the CLI wrapper.
+events that explain its dips, the per-op critical-path breakdown from the
+round-18 trace spans, plus the device phase histograms from the final
+summary.  Run as ``python -m hermes_tpu.obs.report run.jsonl``
+(``scripts/obs_report.py`` is a thin shim over the same ``main``).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 from typing import Iterable, List, Optional
 
 FAULT_EVENTS = ("freeze", "thaw", "remove", "join", "suspect",
@@ -103,6 +107,50 @@ def fleet_totals(records: List[dict]) -> Optional[dict]:
             if k != "events":
                 agg[k] = agg.get(k, 0) + v
     return dict(groups=groups, fleet=agg)
+
+
+def critical_path(records: List[dict]) -> Optional[dict]:
+    """Per-op latency attribution from the round-18 trace spans
+    (obs/tracing.py): group the op spans by trace id and break the
+    sampled population's p50/p99 down by phase, in PROTOCOL ROUNDS
+    (r1 - r0 — the deterministic unit) plus wall p99 where the span
+    measured one.  Returns None when the run traced nothing.
+
+    The headline line this feeds: "p99 ops spend X rounds in the queue
+    and Y rounds in device rounds"."""
+    from hermes_tpu.obs.tracing import OP_SPANS
+    from hermes_tpu.stats import percentile_nearest_rank
+
+    per: dict = {}  # trace id -> {span name: record}
+    for r in records:
+        if r.get("kind") != "span_end" or r.get("name") not in OP_SPANS:
+            continue
+        tr = r.get("trace")
+        if tr:
+            per.setdefault(tr, {})[r["name"]] = r
+    if not per:
+        return None
+    phases: dict = {}
+    for name in OP_SPANS:
+        spans = [s[name] for s in per.values() if name in s]
+        rounds = sorted(s["r1"] - s["r0"] for s in spans)
+        durs = sorted(s["dur_s"] for s in spans
+                      if s.get("dur_s") is not None)
+        if rounds:
+            row = dict(
+                n=len(rounds),
+                p50_rounds=percentile_nearest_rank(rounds, 0.5),
+                p99_rounds=percentile_nearest_rank(rounds, 0.99))
+            if durs:
+                row["p99_dur_s"] = percentile_nearest_rank(durs, 0.99)
+            phases[name] = row
+    return dict(traces=len(per), phases=phases)
+
+
+_PHASE_LABELS = {"fe_queue": "intake queue (admit -> issue)",
+                 "op_queue": "client queue (submit -> inject)",
+                 "op_rounds": "device rounds (inject -> resolve)",
+                 "fe_resolve": "end to end (admit -> resolve)"}
 
 
 def _fmt_fields(r: dict, skip=("t", "kind", "name", "_src")) -> str:
@@ -203,6 +251,20 @@ def render_report(records: List[dict], max_timeline: Optional[int] = None
             + (f" ring depth={last_reg['pipeline_depth']}"
                if "pipeline_depth" in last_reg else ""))
 
+    # round-18 per-op critical path: sampled traces broken down by phase
+    cp = critical_path(records)
+    if cp is not None:
+        lines.append("")
+        lines.append(f"-- per-op critical path ({cp['traces']} sampled "
+                     f"trace(s)) --")
+        for name, row in cp["phases"].items():
+            extra = (f" p99_wall={row['p99_dur_s']}s"
+                     if "p99_dur_s" in row else "")
+            lines.append(
+                f"  {name:<10} {_PHASE_LABELS.get(name, ''):<34} "
+                f"n={row['n']} p50={row['p50_rounds']} "
+                f"p99={row['p99_rounds']} rounds{extra}")
+
     # round-13 fleet aggregation: when records carry group labels, render
     # the per-group counter table and the fleet-wide sums
     ft = fleet_totals(records)
@@ -237,3 +299,31 @@ def render_report(records: List[dict], max_timeline: Optional[int] = None
                 lines.append(f"  {title}:")
                 lines.extend("  " + ln for ln in _render_hist(h))
     return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m hermes_tpu.obs.report`` — the profile.py pattern: the
+    renderer is importable library code and its CLI lives beside it;
+    ``scripts/obs_report.py`` stays as a thin shim."""
+    ap = argparse.ArgumentParser(
+        description="Render obs run logs (--metrics-out JSONL) as one "
+                    "causally ordered timeline report.")
+    ap.add_argument("paths", nargs="+", help="obs JSONL run logs to merge")
+    ap.add_argument("--max-timeline", type=int, default=None,
+                    help="show only the last N timeline records")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged record list as JSON instead of "
+                    "the human report")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.paths)
+    if args.json:
+        json.dump(records, sys.stdout)
+        sys.stdout.write("\n")
+        return 0
+    sys.stdout.write(render_report(records, max_timeline=args.max_timeline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

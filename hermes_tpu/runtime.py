@@ -352,6 +352,11 @@ class Runtime(_ObsHooks, _ElasticResize):
             ops = lin.sample_keys(ops, max_keys=max_keys)
         v = lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)
         self._trace("checker_verdict", ok=v.ok, keys_checked=v.keys_checked)
+        if not v.ok and self.obs is not None:
+            # checker red: the linearizability witness failed — dump the
+            # black box while the run's last records are still in the ring
+            self.obs.flight_dump("checker_red",
+                                 extra=dict(keys_checked=v.keys_checked))
         return v
 
 
@@ -736,6 +741,11 @@ class FastRuntime(_ObsHooks, _ElasticResize):
             reg.counter("host_work_s").inc(
                 time.perf_counter() - t0 - self._devwait_s)
             reg.gauge("pipeline_depth").set(len(self._ring))
+            # windowed history (round-18, obs/series.py): ring occupancy
+            # per round, keyed by the deterministic round index — the
+            # occupancy-over-time view a controller steers on
+            reg.series("pipeline_depth_series").append(
+                self.step_idx, len(self._ring))
         return out
 
     def run(self, n_steps: int) -> None:
@@ -851,6 +861,20 @@ class FastRuntime(_ObsHooks, _ElasticResize):
         max_ver = self._check_version_headroom(m)
         out = _sum_meta_counters(m)
         out["max_ver"] = max_ver
+        if self.obs is not None:
+            # round-18 observed-state feeds, keyed by the poll's round
+            # index: version watermark (headroom trend — the hottest key's
+            # churn) and cumulative commit count (windowed rate = per-round
+            # commit throughput); plus one Meta summary into the flight
+            # recorder's last-N ring (device-truth context for a dump)
+            reg = self.obs.registry
+            reg.series("max_ver_series").append(self.step_idx, max_ver)
+            reg.series("commits_series").append(
+                self.step_idx, int(out["n_write"]) + int(out["n_rmw"]))
+            self.obs.flight.note_meta(dict(
+                step=self.step_idx,
+                **{k: (v.tolist() if isinstance(v, np.ndarray) else int(v))
+                   for k, v in out.items()}))
         return out
 
     def _check_version_headroom(self, m) -> int:
@@ -929,4 +953,9 @@ class FastRuntime(_ObsHooks, _ElasticResize):
                 ops = lin.sample_keys(ops, max_keys=max_keys)
             v = lin.check_history(ops, aborted_uids=self.recorder.aborted_uids)
         self._trace("checker_verdict", ok=v.ok, keys_checked=v.keys_checked)
+        if not v.ok and self.obs is not None:
+            # checker red: the linearizability witness failed — dump the
+            # black box while the run's last records are still in the ring
+            self.obs.flight_dump("checker_red",
+                                 extra=dict(keys_checked=v.keys_checked))
         return v

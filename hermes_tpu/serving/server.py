@@ -66,10 +66,18 @@ class ServingConfig:
     # retention ring: exact for the finite soak/bench drivers (which size
     # well under it), bounded for a long-lived TCP server — the always-on
     # exact accounting is AdmissionControl's counters, not this ring
+    trace_sample: int = 0  # per-op tracing (round-18, obs/tracing.py):
+    # 0 = off, N = mint a trace id for ~1 in N submitted ops (seeded,
+    # deterministic — same ops trace on every replay).  A request already
+    # carrying a nonzero wire trace id is ALWAYS traced (the client
+    # sampled it); the id rides the formerly-pad u16 of wire._REQ.
+    trace_seed: int = 0
 
     def __post_init__(self) -> None:
         if self.tenant_quota < 1 or self.queue_cap < 1:
             raise ValueError("tenant_quota and queue_cap must be >= 1")
+        if self.trace_sample < 0:
+            raise ValueError("trace_sample must be >= 0 (0 disables)")
         if not (0.0 < self.shed_write_frac <= self.shed_read_frac <= 1.0):
             raise ValueError(
                 "want 0 < shed_write_frac <= shed_read_frac <= 1 (writes "
@@ -156,6 +164,18 @@ class Frontend:
                            if self.scfg.store_inflight_cap is not None
                            else cap)
         self._store_inflight = 0
+        # per-op tracing (round-18): front-door sampler + span writer.
+        # Single-op requests only — the batched-read header has no free
+        # u16 (count occupies it), so K_MGET/K_SCAN stay untraced.
+        if self.scfg.trace_sample:
+            from hermes_tpu.obs.tracing import TraceSampler
+
+            self._sampler = TraceSampler(self.scfg.trace_sample,
+                                         seed=self.scfg.trace_seed)
+        else:
+            self._sampler = None
+        self._op_tracer_cache = None
+        self._round_key_ops: dict = {}  # key -> admitted ops this round
 
     # -- plumbing ------------------------------------------------------------
 
@@ -172,6 +192,39 @@ class Frontend:
         rt = self._rt()
         if rt.obs is not None:
             rt.obs.registry.counter(f"serving_{name}").inc(n)
+
+    def _op_tracer(self):
+        """Span writer bound to the store runtime's current obs context
+        (None while none is attached)."""
+        rt = self._rt()
+        if rt.obs is None:
+            return None
+        c = self._op_tracer_cache
+        if c is None or c.obs is not rt.obs:
+            from hermes_tpu.obs.tracing import OpTracer
+
+            c = self._op_tracer_cache = OpTracer(rt.obs)
+        return c
+
+    def _trace_resolve(self, entry: dict, status: int, now: float) -> None:
+        """Close a sampled op's end-to-end span at RPC resolution:
+        admission round -> resolution round, with the terminal status
+        (the critical-path denominator obs/report.py breaks down)."""
+        trace = entry.get("trace", 0)
+        if not trace:
+            return
+        tr = self._op_tracer()
+        if tr is None:
+            return
+        req = entry["req"]
+        tags = dict(tenant=req.tenant, op=req.kind, key=req.key,
+                    status=int(status))
+        lane = entry.get("lane")
+        if lane is not None and lane[0] is not None:
+            tags["group"] = lane[0]
+        tr.span("fe_resolve", trace, r0=entry["r_admit"],
+                r1=self._rt().step_idx,
+                dur_s=now - entry["t_admit"], **tags)
 
     def _degraded_for_key(self, key: int) -> bool:
         if self.is_fleet:
@@ -269,9 +322,21 @@ class Frontend:
                 retry_after_us=int(math.ceil(wait * 1e6))), req.tenant,
                 queue=False)
         self.adm.note_admitted(req.tenant)
+        # key-heat tally (round-18, obs/series.py): admitted ops per key
+        # this serving round, harvested into the heat series at pump time
+        self._round_key_ops[req.key] = \
+            self._round_key_ops.get(req.key, 0) + 1
+        # trace mint (round-18): adopt a client-sampled wire id, else
+        # sample on the monotone request sequence; the id follows the
+        # entry through issue and resolution (and is staged into the
+        # store so the KVS-level spans share it)
+        trace = int(getattr(req, "trace", 0) or 0)
+        if not trace and self._sampler is not None:
+            trace = self._sampler.sample(self.requests - 1)
         dl_us = req.deadline_us or self.scfg.default_deadline_us
         self._intake.append(dict(
-            req=req, t_admit=now,
+            req=req, t_admit=now, trace=trace,
+            r_admit=self._rt().step_idx,
             deadline=(now + dl_us * 1e-6) if dl_us else None))
         return None
 
@@ -362,6 +427,7 @@ class Frontend:
             # heap mode stores the request's byte payload verbatim (the
             # KVS appends the extent and rounds only the packed ref)
             value = bytes(req.data) if self.vbytes else req.value
+        trace = entry.get("trace", 0)
         if self.is_fleet:
             session = req.tenant * 7919 + seq
             fut, lane = self.store.route_op(req.kind, session, req.key,
@@ -370,11 +436,28 @@ class Frontend:
         else:
             r, s = self._lanes[(req.tenant * 7919 + seq) % len(self._lanes)]
             entry["lane"] = (None, r, s)
+            if trace:
+                # hand the minted id to the KVS so its op_queue/op_rounds
+                # spans carry the SAME trace (consumed by the next
+                # _enqueue; the fleet path keeps frontend spans only —
+                # route_op picks the group internally)
+                self.store._staged_trace = trace
             fut = getattr(self.store, req.kind)(r, s, req.key, *(
                 (value,) if value is not None else ()))
         entry["fut"] = fut
         self._pending[req.req_id] = entry
         self._store_inflight += 1
+        if trace:
+            tr = self._op_tracer()
+            if tr is not None:
+                # intake-queue wait: admission round -> store-issue round
+                tags = dict(tenant=req.tenant, op=req.kind, key=req.key)
+                lane = entry.get("lane")
+                if lane is not None and lane[0] is not None:
+                    tags["group"] = lane[0]
+                tr.span("fe_queue", trace, r0=entry["r_admit"],
+                        r1=self._rt().step_idx,
+                        dur_s=self.clock() - entry["t_admit"], **tags)
 
     _STATUS = {"get": wire.S_OK, "put": wire.S_OK, "rmw": wire.S_OK,
                "rmw_abort": wire.S_RMW_ABORT, "lost": wire.S_LOST,
@@ -444,6 +527,7 @@ class Frontend:
                     self._count("deadline")
                     self._respond(self._deadline_rsp(req), req.tenant,
                                   now - entry["t_admit"])
+                    self._trace_resolve(entry, wire.S_DEADLINE, now)
                 else:
                     keep.append(entry)
             self._intake = keep
@@ -466,6 +550,7 @@ class Frontend:
                 self.adm.note_resolved(entry["req"].tenant, rsp.status)
                 self._respond(rsp, entry["req"].tenant,
                               now - entry["t_admit"])
+                self._trace_resolve(entry, rsp.status, now)
                 self._store_inflight -= 1
                 done_ids.append(rid)
             elif late:
@@ -475,6 +560,7 @@ class Frontend:
                 self._count("deadline")
                 self._respond(self._deadline_rsp(entry["req"]),
                               entry["req"].tenant, now - entry["t_admit"])
+                self._trace_resolve(entry, wire.S_DEADLINE, now)
                 self._abandoned.append(entry)
                 done_ids.append(rid)
         for rid in done_ids:
@@ -487,6 +573,24 @@ class Frontend:
                 still.append(entry)
         self._abandoned = still
         self._update_level()
+        rt = self._rt()
+        if rt.obs is not None:
+            # ladder history (round-18, obs/series.py): intake depth and
+            # shed rung per serving round, keyed by the store's round
+            # index — the backpressure trend a controller steers on
+            reg = rt.obs.registry
+            reg.series("intake_depth_series").append(
+                rt.step_idx, len(self._intake))
+            reg.series("shed_level_series").append(
+                rt.step_idx, self.shed_level)
+            # per-range key heat (ROADMAP item 6's controller input):
+            # the round's hottest single key's op count and its distinct
+            # key spread — the skew trend shed rung 2 would steer on
+            reg.series("key_heat_max_series").append(
+                rt.step_idx, max(self._round_key_ops.values(), default=0))
+            reg.series("key_distinct_series").append(
+                rt.step_idx, len(self._round_key_ops))
+        self._round_key_ops.clear()
         return self.pop_responses()
 
     def flush(self) -> List[wire.Response]:

@@ -120,36 +120,58 @@ def run_open_loop(store, scfg: ServingConfig, spec: MixSpec,
     round_s = scfg.round_us * 1e-6
     sent = 0
     rounds = 0
-    while rounds < max_rounds:
-        if chaos_runner is not None:
-            chaos_runner.tick(rounds)
-        k = arrivals.due(clock.t)
-        for _ in range(k):
-            if sent >= n:
+    # flight recorder (round-18): an obs-attached soak dumps its black
+    # box on an operator SIGTERM and on envelope-invariant failure — a
+    # long soak that dies must leave a post-mortem (no-op unless a dump
+    # dir is configured; obs/flightrec.py)
+    obs = fe._rt().obs
+    restore_sigterm = None
+    if obs is not None:
+        from hermes_tpu.obs.flightrec import install_sigterm
+
+        restore_sigterm = install_sigterm(
+            obs.flight, extra=dict(where="serving_soak", seed=seed))
+    try:
+        while rounds < max_rounds:
+            if chaos_runner is not None:
+                chaos_runner.tick(rounds)
+            k = arrivals.due(clock.t)
+            for _ in range(k):
+                if sent >= n:
+                    break
+                i = sent
+                req = wire.Request(
+                    kind=("get", "put", "rmw")[int(mix["kind"][i])],
+                    req_id=i + 1, tenant=int(mix["tenant"][i]),
+                    key=int(mix["key"][i]), deadline_us=deadline_us,
+                    value=mix["value"][i].tolist())
+                sent += 1
+                lb.submit(req)
+            lb.pump()
+            clock.advance(round_s)
+            rounds += 1
+            if sent >= n and not (fe._intake or fe._pending
+                                  or fe._abandoned):
                 break
-            i = sent
-            req = wire.Request(
-                kind=("get", "put", "rmw")[int(mix["kind"][i])],
-                req_id=i + 1, tenant=int(mix["tenant"][i]),
-                key=int(mix["key"][i]), deadline_us=deadline_us,
-                value=mix["value"][i].tolist())
-            sent += 1
-            lb.submit(req)
-        lb.pump()
-        clock.advance(round_s)
-        rounds += 1
-        if sent >= n and not (fe._intake or fe._pending or fe._abandoned):
-            break
-    lb.drain()
-    # one authoritative status census off the response meta (covers both
-    # submit()-time refusals and pump()-time resolutions)
-    statuses: dict = {}
-    for _t, st, _lat in fe._resp_meta:
-        name = wire.STATUS_NAMES[st]
-        statuses[name] = statuses.get(name, 0) + 1
-    lat = sorted(fe.latencies())
-    pctl = lambda q: percentile_nearest_rank(lat, q)
-    ev = verify_serving(fe)
+        lb.drain()
+        # one authoritative status census off the response meta (covers
+        # both submit()-time refusals and pump()-time resolutions)
+        statuses: dict = {}
+        for _t, st, _lat in fe._resp_meta:
+            name = wire.STATUS_NAMES[st]
+            statuses[name] = statuses.get(name, 0) + 1
+        lat = sorted(fe.latencies())
+        pctl = lambda q: percentile_nearest_rank(lat, q)
+        try:
+            ev = verify_serving(fe)
+        except AssertionError:
+            if obs is not None:
+                obs.flight_dump("verify_serving_failed",
+                                extra=dict(seed=seed, rounds=rounds))
+            raise
+    finally:
+        if restore_sigterm is not None:
+            restore_sigterm()
     totals = fe.counters()["totals"]
     return dict(
         ops_offered=n, sent=sent, rounds=rounds,

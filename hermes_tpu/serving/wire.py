@@ -74,8 +74,10 @@ REASON_NAMES = {R_NONE: "", R_QUEUE_FULL: "queue_full", R_QUOTA: "quota",
 
 REQ_MAGIC = 0x5251   # 'QR'
 RSP_MAGIC = 0x5253   # 'SR'
-# magic u16 | kind u8 | pad u8 | req_id u32 | tenant u16 | pad u16 |
+# magic u16 | kind u8 | pad u8 | req_id u32 | tenant u16 | trace u16 |
 # deadline_us u32 | key i64
+# (trace was pad until round-18: nonzero = the op is sampled for per-op
+# tracing, obs/tracing.py — same size, 0-compatible with old frames)
 _REQ = struct.Struct("<HBBIHHIq")
 # magic u16 | status u8 | reason u8 | req_id u32 | found u8 | has_uid u8 |
 # pad u16 | step i32 | retry_after_us u32 | uid_hi i32 | uid_lo i32
@@ -147,6 +149,11 @@ class Request:
     deadline_us: int = 0      # RELATIVE to server intake; 0 = none
     value: Optional[List[int]] = None  # payload words (updates)
     data: Optional[bytes] = None       # heap mode: variable byte payload
+    # trace id (round-18, obs/tracing.py): nonzero u16 = this op is
+    # sampled for per-op tracing; rides the formerly-pad u16 of the fixed
+    # header, so the wire size is unchanged and 0 (the old pad value)
+    # means "not sampled" — old peers interoperate bit-for-bit
+    trace: int = 0
 
 
 @dataclasses.dataclass
@@ -175,8 +182,10 @@ def encode_request(req: Request, u: int, vbytes: int = 0) -> bytes:
         raise ValueError(f"unknown op kind {req.kind!r}")
     if not (0 <= req.deadline_us < 1 << 32):
         raise ValueError("deadline_us must fit u32 (relative microseconds)")
+    if not (0 <= req.trace <= 0xFFFF):
+        raise ValueError("trace id must fit u16 (0 = not sampled)")
     head = _REQ.pack(REQ_MAGIC, _KIND_CODES[req.kind], 0, req.req_id,
-                     req.tenant, 0, req.deadline_us, req.key)
+                     req.tenant, req.trace, req.deadline_us, req.key)
     if vbytes:
         # heap mode: the length-prefixed byte tail replaces the fixed
         # word payload (an update's bytes; None for gets)
@@ -212,7 +221,7 @@ def decode_request(buf: bytes, u: int, vbytes: int = 0) -> Request:
     if not vbytes and len(buf) != req_nbytes(u):
         raise ValueError(f"request size {len(buf)} != {req_nbytes(u)} "
                          f"(payload width {u})")
-    magic, kind, _p, req_id, tenant, _p2, dl, key = _REQ.unpack(
+    magic, kind, _p, req_id, tenant, trace, dl, key = _REQ.unpack(
         buf[: _REQ.size])
     if magic != REQ_MAGIC:
         raise ValueError(f"bad request magic 0x{magic:04x}")
@@ -224,11 +233,11 @@ def decode_request(buf: bytes, u: int, vbytes: int = 0) -> Request:
             raise ValueError(f"request size {len(buf)} != {end} "
                              "(trailing bytes after the payload tail)")
         return Request(kind=_KIND_NAMES[kind], req_id=req_id, tenant=tenant,
-                       key=key, deadline_us=dl,
+                       key=key, deadline_us=dl, trace=trace,
                        data=data if _KIND_NAMES[kind] != "get" else None)
     value = np.frombuffer(buf[_REQ.size:], np.int32).tolist()
     return Request(kind=_KIND_NAMES[kind], req_id=req_id, tenant=tenant,
-                   key=key, deadline_us=dl,
+                   key=key, deadline_us=dl, trace=trace,
                    value=value if _KIND_NAMES[kind] != "get" else None)
 
 

@@ -98,6 +98,28 @@ def main() -> int:
                   if not k.startswith("_")}
     failures = prof.check_budget(measured, budget)
 
+    # round-18: per-op tracing is host-side only — trace ids ride the
+    # Future, never the queue tuples or the device stream — so the lowered
+    # round program must be op-for-op identical with the sampler armed.
+    # Census equality at trace_sample=64 is that proof.
+    traced_cfg = dataclasses.replace(cfg, trace_sample=64)
+    traced_mega = dataclasses.replace(mega, trace_sample=64)
+    traced_census_identical = True
+    for engine, tcfg, backend, m in (
+            ("batched", traced_cfg, "batched", None),
+            ("sharded", traced_cfg, "sharded", mesh),
+            ("batched_mega", traced_mega, "batched", None),
+            ("sharded_mega", traced_mega, "sharded", mesh)):
+        tc = prof.op_census(tcfg, backend, m) if m is not None else \
+            prof.op_census(tcfg, backend)
+        if tc != measured[engine]:
+            traced_census_identical = False
+            diff = {k: (tc.get(k), measured[engine].get(k))
+                    for k in set(tc) | set(measured[engine])
+                    if tc.get(k) != measured[engine].get(k)}
+            failures.append(f"trace_sample=64 changed the {engine} round "
+                            f"census: {diff} (traced vs untraced)")
+
     # drift check: the committed artifact's census must equal the lowered
     # program's (count keys only; the artifact may carry more context)
     drift = []
@@ -151,6 +173,7 @@ def main() -> int:
                               "sparse_total"],
                           sparse_heap_append=measured["heap_append"][
                               "sparse_total"],
+                          traced_census_identical=traced_census_identical,
                           budget_failures=failures, census_drift=drift)))
     return 0 if out["ok"] else 1
 

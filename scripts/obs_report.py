@@ -1,44 +1,21 @@
 """Render an obs run log (``--metrics-out run.jsonl``) as one timeline.
 
-Merges one or more obs JSONL files (interval metrics, trace events, span
-begin/end — one shared monotonic clock per file, hermes_tpu/obs) and renders
-the causally ordered run story: membership / fault events next to the
-interval throughput they explain, plus the device phase histograms from the
-final summary.  Usage:
+Thin shim (round-18 satellite): the CLI moved to
+``python -m hermes_tpu.obs.report`` — the profile.py pattern, where the
+renderer is importable library code and its entry point lives beside it.
+This script stays for muscle memory and old docs:
 
     python -m hermes_tpu --steps 400 --report-every 50 \
         --freeze 2:100:200 --metrics-out run.jsonl
-    python scripts/obs_report.py run.jsonl
-    python scripts/obs_report.py run.jsonl --json   # merged records, stdout
+    python -m hermes_tpu.obs.report run.jsonl
+    python scripts/obs_report.py run.jsonl --json   # same thing
 """
 
-import argparse
-import json
 import sys
 
 sys.path.insert(0, ".")
 
-from hermes_tpu.obs import report  # noqa: E402
-
-
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("paths", nargs="+", help="obs JSONL run logs to merge")
-    ap.add_argument("--max-timeline", type=int, default=None,
-                    help="show only the last N timeline records")
-    ap.add_argument("--json", action="store_true",
-                    help="emit the merged record list as JSON instead of "
-                    "the human report")
-    args = ap.parse_args()
-
-    records = report.load_records(args.paths)
-    if args.json:
-        json.dump(records, sys.stdout)
-        sys.stdout.write("\n")
-        return
-    sys.stdout.write(report.render_report(records,
-                                          max_timeline=args.max_timeline))
-
+from hermes_tpu.obs.report import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

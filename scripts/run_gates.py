@@ -16,7 +16,12 @@ used to live in docs; this runner enforces it in tooling:
     grandchildren) and recorded as ``timed_out`` in the summary;
   * per-gate wall time and the gate's own JSON report land in ONE summary
     (GATES_SUMMARY.json + one printed JSON line), exit non-zero if any
-    gate failed.
+    gate failed;
+  * every gate runs with the crash flight recorder armed
+    (``HERMES_FLIGHT_DIR`` -> flight_dumps/): checksummed archives dumped
+    during a gate (checker red, stuck op, SIGTERM) are attached to its
+    result, and failed gates carry them in the summary's ``gates`` block
+    next to the failure they explain.
 
     python scripts/run_gates.py [--only chaos,netchaos] [--force]
 """
@@ -63,22 +68,32 @@ def pytest_running() -> list:
     return hits
 
 
-def gate_env() -> dict:
+def gate_env(flight_dir: str) -> dict:
     env = dict(os.environ)
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # round-18: arm the crash flight recorder in every gate process — on a
+    # checker red, a stuck op, or a SIGTERM the obs layer auto-dumps a
+    # checksummed archive here (hermes_tpu/obs/flightrec.py), and the
+    # summary links the dump next to the failure it explains
+    env["HERMES_FLIGHT_DIR"] = flight_dir
     return env
 
 
-def run_gate(name: str, script: str, timeout: int) -> dict:
+def flight_dumps_in(flight_dir: str) -> set:
+    return set(glob.glob(os.path.join(flight_dir, "flight_*.json")))
+
+
+def run_gate(name: str, script: str, timeout: int, flight_dir: str) -> dict:
     t0 = time.perf_counter()
+    dumps_before = flight_dumps_in(flight_dir)
     # own process group: on timeout the WHOLE group is killed, so a gate
     # that wedged inside a grandchild (a spawned replica process, a stuck
     # device claim) cannot stall the serial run or leak orphans
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "scripts", script)],
-        cwd=REPO, env=gate_env(), start_new_session=True,
+        cwd=REPO, env=gate_env(flight_dir), start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     try:
         out_b, err_b = proc.communicate(timeout=timeout)
@@ -89,11 +104,13 @@ def run_gate(name: str, script: str, timeout: int) -> dict:
         except (ProcessLookupError, PermissionError):
             proc.kill()
         out_b, err_b = proc.communicate()
+        dumps = sorted(flight_dumps_in(flight_dir) - dumps_before)
         return dict(gate=name, ok=False, rc=-9, timed_out=True,
                     seconds=round(time.perf_counter() - t0, 2),
                     error=f"timed out after {timeout}s (process group "
                           "killed)",
-                    stderr_tail=err_b.decode(errors="replace")[-1500:])
+                    stderr_tail=err_b.decode(errors="replace")[-1500:],
+                    **({"flight_dumps": dumps} if dumps else {}))
     out = out_b.decode(errors="replace")
     err = err_b.decode(errors="replace")
     secs = round(time.perf_counter() - t0, 2)
@@ -104,8 +121,10 @@ def run_gate(name: str, script: str, timeout: int) -> dict:
             break
         except json.JSONDecodeError:
             continue
+    dumps = sorted(flight_dumps_in(flight_dir) - dumps_before)
     return dict(gate=name, ok=(rc == 0), rc=rc, seconds=secs,
                 report=report,
+                **({"flight_dumps": dumps} if dumps else {}),
                 **({} if rc == 0 else {"stderr_tail": err[-1500:]}))
 
 
@@ -138,12 +157,15 @@ def main() -> int:
                   "it or pass --force")))
         return 2
 
+    flight_dir = os.path.join(REPO, "flight_dumps")
+    os.makedirs(flight_dir, exist_ok=True)
+
     results = []
     for name, script in GATES:
         if only is not None and name not in only:
             continue
         print(f"[run_gates] {name} ...", file=sys.stderr, flush=True)
-        r = run_gate(name, script, args.timeout)
+        r = run_gate(name, script, args.timeout, flight_dir)
         print(f"[run_gates] {name}: "
               f"{'ok' if r['ok'] else 'FAIL'} in {r['seconds']}s",
               file=sys.stderr, flush=True)
@@ -153,6 +175,9 @@ def main() -> int:
         ok=all(r["ok"] for r in results),
         gates={r["gate"]: dict(ok=r["ok"], seconds=r["seconds"],
                                **({"timed_out": True} if r.get("timed_out")
+                                  else {}),
+                               **({"flight_dumps": r["flight_dumps"]}
+                                  if not r["ok"] and r.get("flight_dumps")
                                   else {}))
                for r in results},
         total_seconds=round(sum(r["seconds"] for r in results), 2),

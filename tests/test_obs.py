@@ -183,6 +183,50 @@ def test_obs_jsonl_schema_t_and_kind_monotonic(tmp_path):
     assert "drain" in spans and "step_dispatch" in spans
 
 
+def test_tracer_timeline_contract_schema_pairing_and_labels():
+    """The obs/trace.py record contract: every record carries t/kind/name
+    with non-decreasing t, spans close as begin/end PAIRS (two records,
+    not one stamped at begin time), dur_s rides only the end, and caller
+    labels (step, fleet group, replica) pass through both halves
+    verbatim — the invariants naive line-order timeline merging rests
+    on."""
+    from hermes_tpu.obs.trace import Tracer
+
+    exp = BufferExporter()
+    tr = Tracer(exp)
+    tr.event("freeze", replica=2, group=1)
+    with tr.span("step_dispatch", step=7, group=1):
+        tr.event("suspect", replica=0)
+    t0 = tr.span_begin("readback", step=8)
+    tr.span_end("readback", t0, step=8)
+
+    recs = exp.records
+    last = 0.0
+    for r in recs:
+        assert {"t", "kind", "name"} <= set(r)
+        assert r["t"] >= last, "t must be non-decreasing across ALL kinds"
+        last = r["t"]
+    assert [(r["kind"], r["name"]) for r in recs] == [
+        ("event", "freeze"),
+        ("span_begin", "step_dispatch"),
+        ("event", "suspect"),          # nested event inside the open span
+        ("span_end", "step_dispatch"),
+        ("span_begin", "readback"),
+        ("span_end", "readback"),
+    ]
+    begins = [r for r in recs if r["kind"] == "span_begin"]
+    ends = [r for r in recs if r["kind"] == "span_end"]
+    assert [b["name"] for b in begins] == [e["name"] for e in ends]
+    for b, e in zip(begins, ends):
+        assert "dur_s" not in b and e["dur_s"] >= 0
+    # labels ride the begin record (the span() context manager stamps
+    # fields at open; the end half carries the measured dur_s)
+    b_sd = [b for b in begins if b["name"] == "step_dispatch"][0]
+    assert b_sd["group"] == 1 and b_sd["step"] == 7
+    events = [r for r in recs if r["kind"] == "event"]
+    assert events[0]["replica"] == 2 and events[0]["group"] == 1
+
+
 def test_fault_timeline_orders_freeze_thaw_around_dip():
     """A frozen replica blocks the ack quorum: commits stall between the
     freeze and thaw events, and recover after — in ONE ordered record

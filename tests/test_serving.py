@@ -560,7 +560,8 @@ def test_run_gates_records_timed_out(tmp_path):
     old_repo = run_gates.REPO
     run_gates.REPO = str(tmp_path)
     try:
-        r = run_gates.run_gate("wedge", "wedge.py", timeout=2)
+        r = run_gates.run_gate("wedge", "wedge.py", timeout=2,
+                               flight_dir=str(tmp_path / "flight"))
     finally:
         run_gates.REPO = old_repo
     assert r["timed_out"] is True and r["ok"] is False

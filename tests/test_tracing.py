@@ -1,0 +1,380 @@
+"""Round-18 observability: per-op sampled tracing (obs/tracing.py), the
+windowed time-series store (obs/series.py), and the crash flight recorder
+(obs/flightrec.py).
+
+The three contracts gated here:
+
+  * determinism — a seeded traced run samples the SAME ops with the SAME
+    ids on every replay and on every engine, so ``canonical_span_bytes``
+    (the span stream minus wall-clock fields) is byte-identical across
+    replays and across batched/sharded;
+  * behavior identity — tracing off means no sampler, no spans, and the
+    wire carries 0 in the (formerly pad) trace slot, so old peers
+    interoperate bit-for-bit (the round census not moving is
+    scripts/check_op_census.py's job);
+  * trustworthy post-mortems — a flight archive round-trips its
+    checksum, a tampered one is refused loudly, and a deliberately
+    wedged op dumps BEFORE StuckOpError propagates.
+"""
+
+import dataclasses
+import json
+import signal
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.kvs import KVS, StuckOpError
+from hermes_tpu.obs import (
+    OP_SPANS,
+    FlightRecorder,
+    MetricsRegistry,
+    Observability,
+    Series,
+    TraceSampler,
+    canonical_span_bytes,
+)
+from hermes_tpu.obs import flightrec
+from hermes_tpu.obs import report as report_lib
+from hermes_tpu.runtime import FastRuntime
+from hermes_tpu.serving import wire
+from hermes_tpu.serving.server import ServingConfig
+from hermes_tpu.serving.soak import run_open_loop
+from hermes_tpu.workload.openloop import MixSpec
+
+
+def _cfg(**over):
+    kw = dict(n_replicas=3, n_keys=64, n_sessions=8, replay_slots=8,
+              ops_per_session=4, value_words=4, trace_sample=4,
+              workload=WorkloadConfig(seed=7))
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+# -- sampler -----------------------------------------------------------------
+
+
+def test_sampler_is_seeded_deterministic_and_in_range():
+    a = [TraceSampler(4, seed=7).sample(i) for i in range(256)]
+    assert a == [TraceSampler(4, seed=7).sample(i) for i in range(256)]
+    hits = [t for t in a if t]
+    assert hits and len(hits) < len(a)  # ~1 in 4, never all or none
+    assert all(1 <= t <= 0xFFFF for t in hits)
+    assert [TraceSampler(4, seed=8).sample(i) for i in range(256)] != a
+    # rate=1 traces every op; rate<=0 belongs to config, not a sampler
+    assert all(TraceSampler(1).sample(i) for i in range(32))
+    with pytest.raises(ValueError, match="sample rate"):
+        TraceSampler(0)
+
+
+# -- wire field --------------------------------------------------------------
+
+
+def test_wire_trace_field_roundtrip_range_and_size():
+    req = wire.Request(kind="put", req_id=9, tenant=2, key=5,
+                       value=[1, 2], trace=777)
+    out = wire.decode_request(wire.encode_request(req, 2), 2)
+    assert out.trace == 777 and out.key == 5 and out.value[:2] == [1, 2]
+    # unsampled encodes as 0 — the old pad value, so the wire size and
+    # the bytes old peers see are unchanged
+    plain = wire.Request(kind="get", req_id=1, tenant=0, key=0)
+    assert wire.decode_request(wire.encode_request(plain, 2), 2).trace == 0
+    assert len(wire.encode_request(req, 2)) == \
+        len(wire.encode_request(dataclasses.replace(req, trace=0), 2))
+    with pytest.raises(ValueError, match="trace id"):
+        wire.encode_request(dataclasses.replace(req, trace=0x10000), 2)
+
+
+# -- series ------------------------------------------------------------------
+
+
+def test_series_window_rate_percentile_and_bounds():
+    s = Series("depth", capacity=4)
+    for x, v in [(0, 0), (2, 4), (4, 4), (6, 10), (8, 12)]:
+        s.append(x, v)
+    assert len(s) == 4  # capacity-bounded: (0, 0) evicted
+    assert s.window(2) == [(6, 10), (8, 12)]
+    assert s.values() == [4, 4, 10, 12]
+    assert s.rate() == (12 - 4) / (8 - 2)  # dv/dx over the retained ring
+    assert s.rate(2) == 1.0
+    assert s.percentile(0.5) in (4, 10)
+    assert s.last == (8, 12)
+    assert s.snapshot() == dict(x=[2, 4, 6, 8], v=[4, 4, 10, 12])
+    # same-x appends are fine (same round, two polls); regressions raise
+    s.append(8, 13)
+    with pytest.raises(ValueError, match="went backwards"):
+        s.append(7, 0)
+    with pytest.raises(ValueError, match="capacity"):
+        Series("tiny", capacity=1)
+    empty = Series("empty")
+    assert empty.rate() is None and empty.percentile(0.5) is None
+    assert empty.last is None
+
+
+def test_registry_series_accessor_and_snapshot_separation():
+    reg = MetricsRegistry()
+    s = reg.series("intake_depth_series", capacity=8)
+    s.append(0, 3)
+    assert reg.series("intake_depth_series") is s  # get-or-create
+    with pytest.raises(TypeError):
+        reg.counter("intake_depth_series")
+    reg.counter("commits").inc(2)
+    snap = reg.snapshot()
+    assert snap["commits"] == 2
+    assert "intake_depth_series" not in snap  # point snapshot stays scalar
+    ss = reg.series_snapshot()
+    assert ss == {"intake_depth_series": dict(x=[0], v=[3])}
+    from hermes_tpu.obs import prometheus_text
+
+    assert "intake_depth_series" not in prometheus_text(reg)
+
+
+def test_runtime_feeds_series_and_flight_meta():
+    cfg = _cfg(trace_sample=0, n_sessions=16, ops_per_session=32)
+    rt = FastRuntime(cfg)
+    obs = rt.attach_obs(Observability())
+    assert rt.drain(400)
+    rt.counters()
+    reg = obs.registry
+    assert len(reg.series("pipeline_depth_series")) > 0
+    assert len(reg.series("max_ver_series")) == 1
+    assert reg.series("commits_series").last[1] > 0
+    assert obs.flight.metas and obs.flight.metas[-1]["step"] == rt.step_idx
+    obs.series_snapshot()
+    series_recs = [r for r in obs.records if r["kind"] == "series"]
+    assert len(series_recs) == 1
+    assert set(series_recs[0]) >= {"t", "kind", "pipeline_depth_series",
+                                   "max_ver_series", "commits_series"}
+
+
+# -- KVS op tracing ----------------------------------------------------------
+
+
+def _traced_kvs_run(backend="batched", mesh=None):
+    kv = KVS(_cfg(), backend=backend, mesh=mesh)
+    obs = kv.rt.attach_obs(Observability())
+    futs = [kv.put(i % 3, i % 8, i % 64, value=[i, i + 1])
+            for i in range(32)]
+    assert kv.run_until(futs)
+    return canonical_span_bytes(obs.records), obs.records
+
+
+def test_kvs_spans_replay_byte_identical_and_off_means_off():
+    b1, recs = _traced_kvs_run()
+    b2, _ = _traced_kvs_run()
+    assert b1 and b1 == b2
+    spans = [r for r in recs if r.get("kind") == "span_end"
+             and r.get("name") in OP_SPANS]
+    assert spans
+    for s in spans:
+        assert s["name"] in ("op_queue", "op_rounds")  # KVS-level phases
+        assert 1 <= s["trace"] <= 0xFFFF
+        assert s["r1"] >= s["r0"] >= 0
+        assert {"replica", "session", "op", "key"} <= set(s)
+    # every sampled op closes both phases: submit->inject, inject->resolve
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault((s["trace"], s["key"]), set()).add(s["name"])
+    assert by_trace
+    assert all(v == {"op_queue", "op_rounds"} for v in by_trace.values())
+    # tracing off: no sampler, no op spans
+    kv0 = KVS(_cfg(trace_sample=0), backend="batched")
+    obs0 = kv0.rt.attach_obs(Observability())
+    assert kv0._sampler is None
+    assert kv0.run_until([kv0.put(0, 0, 1, value=[1, 2])])
+    assert canonical_span_bytes(obs0.records) == b""
+
+
+# -- serving path ------------------------------------------------------------
+
+
+def _traced_soak(backend="batched", mesh=None):
+    cfg = _cfg(trace_sample=8)
+    scfg = ServingConfig(trace_sample=8, trace_seed=7, round_us=1000)
+    kv = KVS(cfg, backend=backend, mesh=mesh)
+    obs = kv.rt.attach_obs(Observability())
+    res = run_open_loop(kv, scfg, MixSpec(), rate_per_s=20000, n=80,
+                        seed=3, deadline_us=200_000)
+    return canonical_span_bytes(obs.records), res, obs
+
+
+def test_traced_soak_covers_four_phases_and_replays_identically():
+    b1, res1, obs = _traced_soak()
+    b2, res2, _ = _traced_soak()
+    assert b1 and b1 == b2
+    assert res1["response_log_sha"] == res2["response_log_sha"]
+    lines = [json.loads(ln) for ln in b1.decode().strip().splitlines()]
+    names = {n: sum(1 for r in lines if r["name"] == n)
+             for n in {r["name"] for r in lines}}
+    assert set(names) == set(OP_SPANS)  # the full critical path closed
+    # one sampled request's chain walks every phase end-to-end
+    chains = {}
+    for r in lines:
+        chains.setdefault(r["trace"], set()).add(r["name"])
+    assert any(c == set(OP_SPANS) for c in chains.values())
+    # fe spans carry the admission/tenant identity, with terminal status
+    fe = [r for r in lines if r["name"] == "fe_resolve"]
+    assert fe and all({"tenant", "op", "key", "status"} <= set(r)
+                      for r in fe)
+    # the serving ladder fed its windowed series at the store's round clock
+    reg = obs.registry
+    assert len(reg.series("intake_depth_series")) > 0
+    assert len(reg.series("shed_level_series")) > 0
+    heat = reg.series("key_heat_max_series")
+    assert len(heat) > 0 and max(heat.values()) >= 1
+    assert max(reg.series("key_distinct_series").values()) >= 1
+    # and the report's critical-path section renders from these spans
+    cp = report_lib.critical_path(obs.records)
+    assert cp is not None and cp["traces"] == len(chains)
+    assert set(cp["phases"]) <= set(OP_SPANS)
+    assert "per-op critical path" in report_lib.render_report(obs.records)
+
+
+def test_traced_soak_spans_identical_across_engines(cpu_devices):
+    from jax.sharding import Mesh
+
+    b_batched, res_b, _ = _traced_soak()
+    mesh = Mesh(np.array(cpu_devices[:3]), ("replica",))
+    b_sharded, res_s, _ = _traced_soak(backend="sharded", mesh=mesh)
+    assert b_batched and b_batched == b_sharded
+    assert res_b["response_log_sha"] == res_s["response_log_sha"]
+
+
+# -- critical path (synthetic) -----------------------------------------------
+
+
+def test_critical_path_breakdown_on_synthetic_spans():
+    recs = [
+        dict(t=0.0, kind="span_end", name="op_queue", trace=5, r0=1, r1=3),
+        dict(t=0.1, kind="span_end", name="op_rounds", trace=5, r0=3, r1=9),
+        dict(t=0.2, kind="span_end", name="fe_resolve", trace=5, r0=0,
+             r1=9, dur_s=0.01),
+        dict(t=0.3, kind="span_end", name="op_queue", trace=9, r0=2, r1=2),
+        dict(t=0.4, kind="event", name="freeze", trace=0),  # not a span
+    ]
+    cp = report_lib.critical_path(recs)
+    assert cp["traces"] == 2
+    assert cp["phases"]["op_queue"]["n"] == 2
+    assert cp["phases"]["op_queue"]["p50_rounds"] == 0
+    assert cp["phases"]["op_queue"]["p99_rounds"] == 2
+    assert cp["phases"]["op_rounds"]["p50_rounds"] == 6
+    assert cp["phases"]["fe_resolve"]["p99_dur_s"] == 0.01
+    assert "fe_queue" not in cp["phases"]  # no span, no row
+    assert report_lib.critical_path([]) is None
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_archive_roundtrips_checksum_and_refuses_tamper(tmp_path):
+    fr = FlightRecorder(capacity=4, meta_keep=2)
+    for i in range(6):
+        fr.record({"t": float(i), "kind": "metrics", "i": i})
+    for i in range(3):
+        fr.note_meta({"step": i})
+    fr.set_config(_cfg())
+    path = str(tmp_path / "dump.json")
+    assert fr.dump(path, "unit", extra=dict(k="v")) == path
+    payload = flightrec.load(path)
+    assert payload["reason"] == "unit" and payload["extra"] == {"k": "v"}
+    assert payload["n_events"] == 4  # ring bounded at capacity
+    assert [e["i"] for e in payload["events"]] == [2, 3, 4, 5]
+    assert [m["step"] for m in payload["meta_summaries"]] == [1, 2]
+    assert payload["config_sha256"]
+    assert fr.dumps == [path]
+    # tampering flips the checksum — refused, never returned as data
+    archive = json.loads(open(path).read())
+    archive["payload"]["events"][0]["i"] = 99
+    with open(path, "w") as f:
+        json.dump(archive, f)
+    with pytest.raises(flightrec.FlightArchiveError, match="checksum"):
+        flightrec.load(path)
+    with open(path, "w") as f:
+        json.dump({"not": "an archive"}, f)
+    with pytest.raises(flightrec.FlightArchiveError, match="not a flight"):
+        flightrec.load(path)
+
+
+def test_flight_auto_dump_gated_on_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv(flightrec.FLIGHT_DIR_ENV, raising=False)
+    fr = FlightRecorder()
+    fr.record({"t": 0.0, "kind": "event", "name": "x"})
+    assert fr.auto_dump("nowhere") is None  # no dir, no litter
+    monkeypatch.setenv(flightrec.FLIGHT_DIR_ENV, str(tmp_path / "env"))
+    p = fr.auto_dump("enved")
+    assert p and flightrec.load(p)["reason"] == "enved"
+    # explicit ctor dir wins over the environment
+    fr2 = FlightRecorder(dump_dir=str(tmp_path / "ctor"))
+    p2 = fr2.auto_dump("ctored", extra=dict(a=1))
+    assert p2 and str(tmp_path / "ctor") in p2
+
+
+def test_observability_tees_records_into_flight_ring():
+    obs = Observability()
+    obs.tracer.event("freeze", replica=2)
+    obs.interval({"commits": 5})
+    kinds = [e["kind"] for e in obs.flight.events]
+    assert kinds == ["event", "metrics"]
+    assert obs.flight.events[0]["name"] == "freeze"
+    # the tee preserves the exporter's records too (not a redirect)
+    assert [r["kind"] for r in obs.records] == kinds
+
+
+def test_wedged_op_dumps_flight_archive_before_stuckop_raises(tmp_path):
+    cfg = _cfg(value_words=6, op_timeout_rounds=4, trace_sample=0)
+    kv = KVS(cfg, strict_timeouts=True)
+    obs = kv.rt.attach_obs(Observability(flight_dir=str(tmp_path)))
+    kv.freeze(1)
+    kv.freeze(2)  # no ack quorum: the put below can never commit
+    kv.put(0, 0, 3, [1])
+    with pytest.raises(StuckOpError, match="stuck past op_timeout_rounds"):
+        for _ in range(12):
+            kv.step()
+    assert obs.flight.dumps, "the watchdog must dump before raising"
+    payload = flightrec.load(obs.flight.dumps[-1])  # checksum round-trip
+    assert payload["reason"] == "stuck_op"
+    diags = payload["extra"]["diags"]
+    assert diags and diags[0]["key"] == 3
+    assert payload["events"], "the ring must carry the run's recent records"
+
+
+def test_checker_red_triggers_flight_dump(tmp_path, monkeypatch):
+    from hermes_tpu.checker import linearizability as lin
+
+    cfg = _cfg(trace_sample=0, n_sessions=16, ops_per_session=32)
+    rt = FastRuntime(cfg, record=True)
+    obs = rt.attach_obs(Observability(flight_dir=str(tmp_path)))
+    assert rt.drain(400)
+    assert rt.check().ok
+    assert not obs.flight.dumps  # green checks never dump
+
+    class _Red:  # stubbed red verdict: tests the trigger, not the checker
+        ok = False
+        keys_checked = 7
+
+    monkeypatch.setattr(lin, "check_history", lambda *a, **k: _Red)
+    monkeypatch.setattr("hermes_tpu.runtime.check_arrays",
+                        lambda *a, **k: _Red, raising=False)
+    assert not rt.check().ok
+    assert obs.flight.dumps
+    payload = flightrec.load(obs.flight.dumps[-1])
+    assert payload["reason"] == "checker_red"
+    assert payload["extra"]["keys_checked"] == 7
+
+
+def test_install_sigterm_dumps_then_defers(tmp_path):
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: seen.append("prev"))
+    try:
+        fr = FlightRecorder(dump_dir=str(tmp_path))
+        fr.record({"t": 0.0, "kind": "event", "name": "tick"})
+        restore = flightrec.install_sigterm(fr, extra=dict(where="test"))
+        signal.raise_signal(signal.SIGTERM)
+        assert fr.dumps and flightrec.load(fr.dumps[-1])["reason"] == \
+            "sigterm"
+        assert seen == ["prev"]  # previous disposition honored after dump
+        restore()
+        assert signal.getsignal(signal.SIGTERM) is not None
+    finally:
+        signal.signal(signal.SIGTERM, prev)
