@@ -135,6 +135,91 @@ def run_fleet(fcfg, steps: int, coordinator=None, num_hosts=1, host_id=0):
     return rts
 
 
+class ServeWorkers:
+    """Handle over a sharded-accept serving fleet (round-19): N worker
+    processes, one SO_REUSEPORT listener each on ``addr``, started by
+    ``start_serve_workers`` and joined by ``stop()``."""
+
+    def __init__(self, procs, stop_ev, addr):
+        self.procs = procs
+        self.addr = addr
+        self._stop_ev = stop_ev
+
+    def alive(self) -> int:
+        return sum(p.is_alive() for p in self.procs)
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop_ev.set()
+        for p in self.procs:
+            p.join(timeout=timeout_s)
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def start_serve_workers(n_workers: int, cfg=None, scfg=None,
+                        host: str = "127.0.0.1", port: int = 0,
+                        ready_timeout_s: float = 120.0) -> ServeWorkers:
+    """Start ``n_workers`` columnar serving worker PROCESSES sharing one
+    port via SO_REUSEPORT accept sharding (serving/rpc.py round-19):
+    each worker owns its own KVS, ColumnarFrontend, and GIL; the kernel
+    load-balances client connections across them.  Blocks until every
+    worker is accepting (or raises loudly if one dies during boot)."""
+    import multiprocessing as mp
+    import socket as _socket
+
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.serving.rpc import serve_worker_main
+    from hermes_tpu.serving.server import ServingConfig
+
+    if n_workers < 1:
+        raise ValueError("need at least one serve worker")
+    cfg = cfg or HermesConfig(n_replicas=4, n_keys=1 << 10, n_sessions=64,
+                              value_words=6)
+    scfg = scfg or ServingConfig()
+    if port == 0:
+        # claim a concrete port up front: every worker must bind the
+        # SAME number for the kernel to shard accepts across them
+        probe = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        probe.bind((host, 0))
+        port = probe.getsockname()[1]
+        probe.close()
+    ctx = mp.get_context("spawn")
+    ready_q = ctx.Queue()
+    stop_ev = ctx.Event()
+    procs = []
+    for w in range(n_workers):
+        p = ctx.Process(target=serve_worker_main,
+                        args=(w, host, port, cfg, scfg, ready_q, stop_ev),
+                        daemon=True)
+        p.start()
+        procs.append(p)
+    fleet = ServeWorkers(procs, stop_ev, (host, port))
+    ready = set()
+    import queue as _queue
+    while len(ready) < n_workers:
+        try:
+            wid, _port = ready_q.get(timeout=ready_timeout_s)
+        except _queue.Empty:
+            fleet.stop()
+            raise RuntimeError(
+                f"serve workers failed to come up: {sorted(ready)} of "
+                f"{n_workers} ready within {ready_timeout_s}s")
+        ready.add(wid)
+        if fleet.alive() < n_workers:
+            fleet.stop()
+            raise RuntimeError(
+                "a serve worker died during boot — check its stderr")
+    return fleet
+
+
 def _main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--coordinator", type=str, default=None,
@@ -151,7 +236,39 @@ def _main():
     ap.add_argument("--keys", type=int, default=1 << 16)
     ap.add_argument("--sessions", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--serve-workers", type=int, default=0,
+                    help="instead of a protocol run: start N columnar "
+                    "serving worker processes sharding accepts on one "
+                    "port (SO_REUSEPORT) and serve until interrupted")
+    ap.add_argument("--serve-port", type=int, default=0,
+                    help="shared serving port (0 = pick a free one)")
+    ap.add_argument("--serve-seconds", type=float, default=0.0,
+                    help="serve for this long then exit (0 = until ^C)")
     args = ap.parse_args()
+
+    if args.serve_workers > 0:
+        import json
+        import time as _time
+
+        from hermes_tpu.config import HermesConfig
+
+        cfg = HermesConfig(n_replicas=args.replicas or 4, n_keys=args.keys,
+                           n_sessions=args.sessions, value_words=6)
+        fleet = start_serve_workers(args.serve_workers, cfg=cfg,
+                                    port=args.serve_port)
+        print(json.dumps({"serving": list(fleet.addr),
+                          "workers": args.serve_workers}), flush=True)
+        try:
+            if args.serve_seconds > 0:
+                _time.sleep(args.serve_seconds)
+            else:
+                while fleet.alive():
+                    _time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            fleet.stop()
+        return
 
     init_distributed(args.coordinator, args.num_hosts, args.host_id)
     import jax

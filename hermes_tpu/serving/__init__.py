@@ -7,19 +7,32 @@ loopback, admission control + deadlines + backpressure + the load-shed
 ladder (serving/server.py Frontend over kvs.KVS or fleet.Fleet), and
 deterministic open-loop soaks (serving/soak.py with
 workload.openloop's seeded Poisson arrivals).
+
+Round-19 adds the COLUMNAR data plane: whole request batches decode
+into column arrays in one numpy pass (wire.ReqBatch/RspBatch), admit
+through the ladder in O(1)-per-batch vectorized judgments
+(admission.admit_batch), resolve through a preallocated completion
+ring instead of per-request futures (server.ColumnarFrontend), and
+drain as one framed encode per connection per pump
+(rpc.ColumnarLoopback / ColumnarTcpServer, with SO_REUSEPORT accept
+sharding across worker processes via launch.start_serve_workers).
 """
 
 from hermes_tpu.serving import wire
 from hermes_tpu.serving.admission import AdmissionControl, TokenBucket
-from hermes_tpu.serving.rpc import LoopbackServer, RpcClient, TcpRpcServer
-from hermes_tpu.serving.server import (Frontend, ServingConfig, VirtualClock,
-                                       verify_serving)
+from hermes_tpu.serving.rpc import (ColumnarClient, ColumnarLoopback,
+                                    ColumnarTcpServer, LoopbackServer,
+                                    RpcClient, TcpRpcServer)
+from hermes_tpu.serving.server import (ColumnarFrontend, Frontend,
+                                       ServingConfig, VirtualClock,
+                                       verify_columnar, verify_serving)
 from hermes_tpu.serving.soak import (committed_uids, measure_capacity,
                                      run_open_loop)
 
 __all__ = [
     "wire", "AdmissionControl", "TokenBucket", "LoopbackServer",
-    "RpcClient", "TcpRpcServer", "Frontend", "ServingConfig",
-    "VirtualClock", "verify_serving", "committed_uids",
+    "RpcClient", "TcpRpcServer", "ColumnarClient", "ColumnarLoopback",
+    "ColumnarTcpServer", "ColumnarFrontend", "Frontend", "ServingConfig",
+    "VirtualClock", "verify_columnar", "verify_serving", "committed_uids",
     "measure_capacity", "run_open_loop",
 ]

@@ -190,6 +190,133 @@ def run_socket_cell(cfg, scfg: ServingConfig, spec: MixSpec, n: int,
     )
 
 
+def measure_decode_rate(n: int = 4096, u: int = 6, reps: int = 20,
+                        seed: int = 14) -> dict:
+    """Columnar wire-decode bandwidth (round-19): one drained-buffer
+    request stream of ``n`` records decoded into columns per rep,
+    best-of-``reps`` wall time -> MB/s.  The number the tentpole's
+    one-numpy-pass claim is accountable to."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    b = wire.ReqBatch(
+        kind=rng.choice([wire.K_GET, wire.K_PUT, wire.K_RMW], n)
+            .astype(np.uint8),
+        req_id=np.arange(1, n + 1, dtype=np.uint32),
+        tenant=rng.integers(0, 8, n).astype(np.uint16),
+        trace=np.zeros(n, np.uint16),
+        deadline_us=np.zeros(n, np.uint32),
+        key=rng.integers(0, 1 << 10, n).astype(np.int64),
+        value=rng.integers(-99, 99, (n, u)).astype(np.int32))
+    raw = wire.encode_request_batch(b, u)
+    wire.decode_request_batch(raw, u)  # warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        wire.decode_request_batch(raw, u)
+        best = min(best, time.perf_counter() - t0)
+    return dict(records=n, bytes=len(raw),
+                decode_us=round(best * 1e6, 1),
+                mb_per_s=round(len(raw) / best / 1e6, 1),
+                records_per_s=round(n / best, 1))
+
+
+def run_columnar_worker_cell(n_workers: int, n_ops: int = 4096,
+                             batch: int = 256, seed: int = 14) -> dict:
+    """Closed-loop columnar ops/s through ``n_workers`` accept-sharded
+    worker PROCESSES (SO_REUSEPORT, launch.start_serve_workers): one
+    client thread per worker, each driving framed columnar batches over
+    its own connection.  Error-field honesty: a cell that lost workers
+    or clients mid-run says so instead of reporting a partial rate."""
+    import numpy as np
+
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+    from hermes_tpu.launch import start_serve_workers
+    from hermes_tpu.serving.rpc import ColumnarClient
+    from hermes_tpu.workload.openloop import make_mix
+
+    cfg = HermesConfig(
+        n_replicas=4, n_keys=64, n_sessions=64, value_words=8,
+        pipeline_depth=2, workload=WorkloadConfig(read_frac=0.5, seed=seed))
+    scfg = ServingConfig(tenant_rate_per_s=1e9, tenant_burst=1e9,
+                         tenant_quota=4 * batch, queue_cap=4 * batch)
+    u = cfg.value_words - 2
+    spec = MixSpec(read_frac=0.5, rmw_frac=0.1, tenants=4)
+    per_client = n_ops // n_workers
+    err: List[str] = []
+    answered = [0] * n_workers
+    try:
+        fleet = start_serve_workers(n_workers, cfg=cfg, scfg=scfg)
+    except Exception as e:  # noqa: BLE001 — no SO_REUSEPORT, boot fail
+        return dict(workers=n_workers, ops=n_ops, answered=0,
+                    ops_per_sec=None, error=f"worker boot failed: {e!r}")
+    # warmup happens OUTSIDE the timed wall: each client warms its own
+    # worker's jit cache (one batch through its own connection), then
+    # everyone meets at the barrier and the clock starts — otherwise a
+    # host cell is mostly measuring n_workers XLA compiles
+    gate = threading.Barrier(n_workers + 1, timeout=180.0)
+    try:
+        def client_loop(w: int) -> None:
+            try:
+                cl = ColumnarClient(fleet.addr, u)
+                mix = make_mix(spec, cfg.n_keys, per_client,
+                               seed + 101 * w, value_words=u)
+                kind = (np.asarray(mix["kind"], np.uint8) + 1)
+                key = np.asarray(mix["key"], np.int64)
+                ten = np.asarray(mix["tenant"], np.uint16)
+                val = np.asarray(mix["value"], np.int32
+                                 ).reshape(per_client, u)
+
+                def shoot(lo: int, hi: int) -> int:
+                    k = hi - lo
+                    b = wire.ReqBatch(
+                        kind=kind[lo:hi], req_id=cl.next_ids(k),
+                        tenant=ten[lo:hi], trace=np.zeros(k, np.uint16),
+                        deadline_us=np.zeros(k, np.uint32),
+                        key=key[lo:hi], value=val[lo:hi])
+                    return len(cl.call_batch(b))
+
+                shoot(0, min(batch, per_client))  # warm, untimed
+                gate.wait()
+                for lo in range(0, per_client, batch):
+                    answered[w] += shoot(lo, min(lo + batch, per_client))
+                cl.close()
+            except Exception as e:  # noqa: BLE001
+                err.append(f"client {w}: {e!r}")
+                try:
+                    gate.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threads = [threading.Thread(target=client_loop, args=(w,),
+                                    daemon=True) for w in range(n_workers)]
+        for t in threads:
+            t.start()
+        try:
+            gate.wait()
+        except threading.BrokenBarrierError:
+            pass  # a client died warming up; its err entry says why
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(timeout=120.0)
+        wall = time.perf_counter() - t0
+        if any(t.is_alive() for t in threads):
+            err.append("client thread(s) still running at join timeout")
+        if fleet.alive() < n_workers:
+            err.append(f"only {fleet.alive()}/{n_workers} workers alive "
+                       "at the end of the run")
+    finally:
+        fleet.stop()
+    total = sum(answered)
+    if total < n_workers * per_client:
+        err.append(f"answered {total}/{n_workers * per_client} ops")
+    return dict(
+        workers=n_workers, ops=n_workers * per_client, answered=total,
+        batch=batch, wall_s=round(wall, 4),
+        ops_per_sec=None if err else round(total / max(wall, 1e-9), 1),
+        error="; ".join(err) if err else None)
+
+
 def run_serve_bench(n: Optional[int] = None, seed: Optional[int] = None,
                     scenarios: bool = True) -> dict:
     """The BENCH_LATENCY.json payload: latency + throughput operating
@@ -218,6 +345,28 @@ def run_serve_bench(n: Optional[int] = None, seed: Optional[int] = None,
     cells["throughput"] = run_socket_cell(
         thr_cfg, scfg, MixSpec(name="uniform"), 2 * n, mode="closed",
         window=64, seed=seed)
+    # round-19 columnar cells: wire-decode bandwidth, the in-process
+    # loopback floor, and accept-sharded worker scaling at 1/2/4
+    # workers — each quoted against the scalar throughput cell above
+    scalar_ops = cells["throughput"]["ops_per_sec"]
+    cells["columnar_decode"] = measure_decode_rate(seed=seed)
+    try:
+        from hermes_tpu.serving.soak import measure_columnar_floor
+
+        fl = measure_columnar_floor(seed=seed)
+        fl["speedup_vs_scalar"] = round(
+            fl["ops_per_sec"] / max(scalar_ops, 1e-9), 1)
+        fl["scalar_ops_per_sec"] = scalar_ops
+        cells["columnar_loopback"] = fl
+    except Exception as e:  # noqa: BLE001 — honesty over silence
+        cells["columnar_loopback"] = dict(ops_per_sec=None,
+                                          error=f"floor failed: {e!r}")
+    for w in (1, 2, 4):
+        c = run_columnar_worker_cell(w, seed=seed)
+        if c["ops_per_sec"] is not None:
+            c["speedup_vs_scalar"] = round(
+                c["ops_per_sec"] / max(scalar_ops, 1e-9), 1)
+        cells[f"columnar_workers_{w}"] = c
     out = dict(
         cells=cells, capacity_probe=probe,
         dispatch_loop_p50_ms=DISPATCH_LOOP_P50_MS,

@@ -100,6 +100,12 @@ class TcpRpcServer:
         self.vbytes = frontend.vbytes
         self._FramedSocket = FramedSocket
         self._lock = threading.Lock()
+        # round-19 lock-fairness split: ``_lock`` guards the Frontend
+        # itself (submit/pump — held for a full store round at a time);
+        # ``_map_lock`` guards only the iid<->connection bookkeeping, so
+        # the pump's per-response map pops and the readers' iid minting
+        # never extend the frontend critical section
+        self._map_lock = threading.Lock()
         # client req_ids are only unique PER CONNECTION (wire.py): the
         # server re-mints each into a globally unique internal id before
         # submit, and maps it back on send — two connections using the
@@ -216,27 +222,35 @@ class TcpRpcServer:
                         except OSError:
                             fsock.close()
                             return
-            outs = []
-            with self._lock:
+            # mint iids + record the return map OUTSIDE the frontend
+            # lock (the map has its own lock): the frontend critical
+            # section is exactly the submit calls, nothing else
+            with self._map_lock:
                 for req in reqs:
                     iid, self._next_iid = self._next_iid, self._next_iid + 1
                     self._conn_of[iid] = (fsock, req.req_id)
                     req.req_id = iid
+            refusals = []
+            with self._lock:
+                for req in reqs:
                     rsp = self.fe.submit(req)
                     if rsp is not None:  # immediate refusal
-                        out = self._resolve_locked(rsp)
-                        if out is not None:
-                            outs.append(out)
+                        refusals.append(rsp)
+            outs = [out for out in map(self._resolve, refusals) if out]
             # send OUTSIDE the lock: a non-reading client stalls only
             # its own reader thread here, never the frontend
             for conn, rsp in outs:
                 self._send_out(conn, rsp)
 
-    def _resolve_locked(self, rsp: wire.Response):
+    def _resolve(self, rsp: wire.Response):
         """Swap the internal id back for the client's req_id; returns
         ``(fsock, rsp)`` ready to send, or None for an unknown (already
-        torn down) connection.  Caller holds ``self._lock``."""
-        ent = self._conn_of.pop(rsp.req_id, None)
+        torn down) connection.  Takes ``_map_lock`` itself — callers
+        must NOT hold the frontend lock (that coupling was the round-14
+        fairness bug: per-response dict work inside the pump's critical
+        section)."""
+        with self._map_lock:
+            ent = self._conn_of.pop(rsp.req_id, None)
         if ent is None:
             return None
         fsock, client_rid = ent
@@ -263,8 +277,11 @@ class TcpRpcServer:
                 continue
             try:
                 with self._lock:
-                    outs = [out for out in map(self._resolve_locked,
-                                               fe.pump()) if out]
+                    rsps = fe.pump()
+                # publish completions OUTSIDE the frontend lock (the
+                # round-19 fairness fix): the map swap is _map_lock-only,
+                # so readers can submit while this pass runs
+                outs = [out for out in map(self._resolve, rsps) if out]
             except Exception as e:  # noqa: BLE001 — store died (e.g.
                 # StuckOpError): a silently dead pump thread would leave
                 # every connected client hanging on its socket timeout.
@@ -297,6 +314,309 @@ class TcpRpcServer:
             fsock.close()
         for t in list(self._threads):
             t.join(timeout=2.0)
+
+
+# -- round-19: the columnar RPC path -----------------------------------------
+
+
+class ColumnarLoopback:
+    """Byte-honest in-process COLUMNAR server: every request batch and
+    response batch round-trips the full columnar wire codec (encode ->
+    CRC frame -> unframe -> decode) with no socket or thread, so
+    columnar soaks replay byte-identically on a ``VirtualClock`` — the
+    columnar twin of ``LoopbackServer`` and the serving gate's floor
+    path.  The response byte log is record-for-record walkable by
+    ``wire.response_extent`` (the columnar stream is byte-identical to
+    the per-struct one), so ``soak.committed_uids`` works unchanged."""
+
+    def __init__(self, frontend):
+        self.fe = frontend
+        self.u = frontend.u
+        self.vbytes = frontend.vbytes
+        self.wire_rx = 0
+        self.wire_tx = 0
+        self._out: List[bytes] = []
+
+    def submit_batch(self, batch: wire.ReqBatch,
+                     conn: int = 0) -> wire.RspBatch:
+        """One client batch through the wire + admission; returns the
+        decoded immediate-refusal batch (possibly empty)."""
+        from hermes_tpu.transport import codec
+
+        raw = wire.encode_request_batch(batch, self.u, self.vbytes)
+        raw = codec.frame_unpack(codec.frame_pack(
+            np.frombuffer(raw, np.uint8))).tobytes()
+        self.wire_rx += len(raw) + codec.FRAME_OVERHEAD
+        b = wire.decode_request_batch(raw, self.u, self.vbytes)
+        return self._encode_out(self.fe.submit_batch(b, conn=conn))
+
+    def pump(self) -> Dict[int, wire.RspBatch]:
+        return {cid: self._encode_out(rb)
+                for cid, rb in self.fe.pump().items()}
+
+    def drain(self, max_rounds: int = 10_000) -> bool:
+        """Pump until the envelope drains, keeping every response batch
+        in the byte log in emission order."""
+        drained, emitted = self.fe.drain(max_rounds)
+        for d in emitted:
+            for cid in sorted(d):
+                self._encode_out(d[cid])
+        return drained
+
+    def _encode_out(self, rb: wire.RspBatch) -> wire.RspBatch:
+        if len(rb) == 0:
+            return rb
+        raw = wire.encode_response_batch(rb, self.u, self.vbytes)
+        self.wire_tx += len(raw)
+        self._out.append(raw)
+        return wire.decode_response_batch(raw, self.u, self.vbytes)
+
+    def response_log(self) -> bytes:
+        """Concatenated response bytes in emission order — the
+        determinism witness (same seed + config => byte-identical)."""
+        return b"".join(self._out)
+
+
+class ColumnarTcpServer:
+    """Threaded localhost COLUMNAR RPC server: every inbound frame
+    carries a whole request batch, and the pump sends ONE framed
+    response batch per connection per round (the one-encode-per-
+    connection-per-pump drain the ring plane was built for).
+
+    ``reuseport=True`` binds the listener with SO_REUSEPORT so N worker
+    PROCESSES shard accepts on one port (``launch.start_serve_workers``):
+    the kernel load-balances new connections across workers, and each
+    worker owns its own store, frontend, and GIL — the GIL stops being
+    the admission ladder."""
+
+    def __init__(self, frontend, host: str = "127.0.0.1", port: int = 0,
+                 pump_sleep_s: float = 0.0002, reuseport: bool = False):
+        from hermes_tpu.transport.tcp import FramedSocket, serving_listener
+
+        self.fe = frontend
+        self.u = frontend.u
+        self.vbytes = frontend.vbytes
+        self._FramedSocket = FramedSocket
+        self._lock = threading.Lock()      # frontend critical section
+        self._map_lock = threading.Lock()  # conn-id bookkeeping only
+        self._next_cid = 1
+        self._sock_of: Dict[int, object] = {}
+        self.undecodable = 0
+        self._stop = threading.Event()
+        self.pump_error: Optional[BaseException] = None
+        self._pump_sleep = pump_sleep_s
+        self._threads: List[threading.Thread] = []
+        self._conns: List = []
+        self._listener = serving_listener(host, port, reuseport=reuseport)
+        self.addr = self._listener.getsockname()
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._pump_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        import struct as _struct
+
+        self._listener.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                sock, _peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # bound sends only — same rationale as TcpRpcServer: a
+            # non-reading client must stall only its own stream
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            _struct.pack("ll", 1, 0))
+            # columnar frames are variable-length (k * record strides),
+            # so no plausible-length set: a CRC failure skips the frame
+            fsock = self._FramedSocket(sock)
+            with self._map_lock:
+                cid, self._next_cid = self._next_cid, self._next_cid + 1
+                self._sock_of[cid] = fsock
+            self._conns.append(fsock)
+            t = threading.Thread(target=self._reader_loop,
+                                 args=(fsock, cid), daemon=True)
+            t.start()
+            self._threads = [th for th in self._threads if th.is_alive()]
+            self._threads.append(t)
+
+    def _reader_loop(self, fsock, cid: int) -> None:
+        try:
+            self._reader_body(fsock, cid)
+        finally:
+            fsock.close()
+            with self._map_lock:
+                self._sock_of.pop(cid, None)
+            try:
+                self._conns.remove(fsock)
+            except ValueError:
+                pass
+
+    def _reader_body(self, fsock, cid: int) -> None:
+        while not self._stop.is_set():
+            # batch intake, batch-of-batches drain: one blocking recv,
+            # then everything the socket already buffered, submitted
+            # under ONE frontend lock acquisition
+            try:
+                raw = fsock.recv()
+            except Exception:
+                return
+            if raw is None:
+                return
+            raws = [raw]
+            while select.select([fsock.sock], [], [], 0)[0]:
+                try:
+                    more = fsock.recv()
+                except Exception:
+                    more = None
+                if more is None:
+                    break
+                raws.append(more)
+            batches = []
+            for raw in raws:
+                try:
+                    batches.append(wire.decode_request_batch(
+                        raw, self.u, self.vbytes))
+                except ValueError:
+                    # a CRC-valid frame that doesn't parse as a batch
+                    # (torn record stream, width mismatch) means the
+                    # sender's batch framing itself is broken — there is
+                    # no per-row identity to refuse on, so tear the
+                    # stream down LOUDLY (client sees EOF now, not a
+                    # timeout later)
+                    self.undecodable += 1
+                    return
+            refusals = []
+            with self._lock:
+                for b in batches:
+                    rb = self.fe.submit_batch(b, conn=cid)
+                    if len(rb):
+                        refusals.append(rb)
+            for rb in refusals:  # send outside the lock
+                self._send_out(fsock, rb)
+
+    def _send_out(self, fsock, rb: wire.RspBatch) -> None:
+        try:
+            fsock.send(wire.encode_response_batch(rb, self.u,
+                                                  self.vbytes))
+        except OSError:
+            fsock.close()
+
+    def _pump_loop(self) -> None:
+        import time as _time
+
+        fe = self.fe
+        while not self._stop.is_set():
+            with self._lock:
+                busy = not fe.idle()
+            if not busy:
+                _time.sleep(0.001)
+                continue
+            try:
+                with self._lock:
+                    rsps = fe.pump()
+            except Exception as e:  # noqa: BLE001 — store died: fail
+                # loudly, close every stream so clients see EOF now
+                self.pump_error = e
+                self._stop.set()
+                for fsock in list(self._conns):
+                    fsock.close()
+                raise
+            # publish OUTSIDE the frontend lock: one encode + one send
+            # per connection per round
+            for cid in sorted(rsps):
+                with self._map_lock:
+                    fsock = self._sock_of.get(cid)
+                if fsock is not None:
+                    self._send_out(fsock, rsps[cid])
+            _time.sleep(self._pump_sleep)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for fsock in list(self._conns):
+            fsock.close()
+        for t in list(self._threads):
+            t.join(timeout=2.0)
+
+
+class ColumnarClient:
+    """Blocking columnar client: one framed request BATCH per send;
+    a batch's rows may resolve across several server pump rounds, so
+    ``call_batch`` collects response batches until every req_id has
+    answered."""
+
+    def __init__(self, addr, u: int, vbytes: int = 0,
+                 timeout_s: float = 30.0):
+        from hermes_tpu.transport.tcp import FramedSocket
+
+        sock = socket.create_connection(addr, timeout=timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.fsock = FramedSocket(sock)
+        self.u = u
+        self.vbytes = vbytes
+        self._next_id = 1
+
+    def next_ids(self, k: int) -> np.ndarray:
+        ids = np.arange(self._next_id, self._next_id + k, dtype=np.uint32)
+        self._next_id += k
+        return ids
+
+    def send_batch(self, batch: wire.ReqBatch) -> None:
+        self.fsock.send(wire.encode_request_batch(batch, self.u,
+                                                  self.vbytes))
+
+    def recv_batch(self) -> Optional[wire.RspBatch]:
+        raw = self.fsock.recv()
+        if raw is None:
+            return None
+        return wire.decode_response_batch(raw, self.u, self.vbytes)
+
+    def call_batch(self, batch: wire.ReqBatch) -> Dict[int, wire.Response]:
+        """Send one batch and block until every row has a response;
+        returns {req_id: Response}."""
+        want = set(int(r) for r in batch.req_id.tolist())
+        self.send_batch(batch)
+        out: Dict[int, wire.Response] = {}
+        while want:
+            rb = self.recv_batch()
+            if rb is None:
+                raise ConnectionError("server closed mid-batch")
+            for r in rb.to_responses():
+                out[r.req_id] = r
+                want.discard(r.req_id)
+        return out
+
+    def close(self) -> None:
+        self.fsock.close()
+
+
+def serve_worker_main(worker_id: int, host: str, port: int, cfg, scfg,
+                      ready_q, stop_ev) -> None:
+    """One accept-sharding worker process (module-level so the
+    ``spawn`` start method can import it): own KVS, own
+    ColumnarFrontend, own ColumnarTcpServer bound SO_REUSEPORT on the
+    shared port.  Reports ``(worker_id, port)`` on ``ready_q`` once
+    accepting, then serves until ``stop_ev`` fires."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.serving.server import ColumnarFrontend
+
+    store = KVS(cfg)
+    fe = ColumnarFrontend(store, scfg)
+    srv = ColumnarTcpServer(fe, host=host, port=port, reuseport=True)
+    ready_q.put((worker_id, srv.addr[1]))
+    stop_ev.wait()
+    srv.close()
 
 
 class RpcClient:

@@ -41,6 +41,8 @@ import math
 import time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from hermes_tpu.serving import wire
 from hermes_tpu.serving.admission import AdmissionControl
 
@@ -645,6 +647,632 @@ class Frontend:
                     shed_level=self.shed_level, queue=len(self._intake),
                     store_inflight=self._store_inflight,
                     tenants=per, fleet=self.is_fleet, totals=agg)
+
+
+# -- round-19: the columnar data plane ---------------------------------------
+#
+# ``ColumnarFrontend`` is the batch twin of ``Frontend``: whole columnar
+# request batches (wire.ReqBatch) run the admission ladder in O(1)
+# numpy passes per batch (admission.admit_batch — proven row-for-row
+# equivalent to the scalar ladder), admitted rows live in a
+# preallocated ``CompletionRing`` instead of per-request Future/dict
+# objects, the pump resolves a round's completions as COLUMN writes off
+# ``kvs.BatchFutures``, and responses drain as one ``RspBatch`` per
+# connection per pump (one encode per connection on the transport).
+# Single-op verbs only (get/put/rmw); the batched-read verbs
+# (K_MGET/K_SCAN) and fleet routing stay on the scalar Frontend — and
+# because the columnar plane serves no reads-with-fences, it does not
+# pin per-tenant read fences on commit (the scalar path's RYW
+# plumbing).  KVS-level op spans are also scalar-only (submit_batch has
+# no per-op trace staging); the columnar plane closes fe_resolve spans
+# for sampled rows so traced soaks still cover the front-end phase.
+
+_RING_OPEN = 0xFF  # status column sentinel: slot allocated, unresolved
+
+
+class CompletionRing:
+    """The preallocated completion plane: an admitted op's identity is a
+    SLOT INDEX into these columns (conn + client req_id restore the wire
+    identity at emit time), allocated from a free stack and recycled the
+    pump after the response is built.  No per-op Python objects exist
+    between admission and emit."""
+
+    def __init__(self, cap: int, u: int, vbytes: int):
+        size = 1 << max(4, int(cap - 1).bit_length())
+        self.cap = size
+        self.u = u
+        self.vbytes = vbytes
+        # free stack: pop from the end, push back on release
+        self.free = np.arange(size - 1, -1, -1, np.int32)
+        self.n_free = size
+        # request-side columns (written at admission)
+        self.conn = np.zeros(size, np.int32)
+        self.client_rid = np.zeros(size, np.uint32)
+        self.tenant = np.zeros(size, np.int32)
+        self.kind = np.zeros(size, np.uint8)      # wire K_* codes
+        self.key = np.zeros(size, np.int64)
+        self.trace = np.zeros(size, np.uint16)
+        self.deadline = np.full(size, np.inf)     # absolute; inf = none
+        self.t_admit = np.zeros(size)
+        self.r_admit = np.zeros(size, np.int32)
+        # resolution columns (written by the pump's harvest)
+        self.status = np.full(size, _RING_OPEN, np.uint8)
+        self.reason = np.zeros(size, np.uint8)
+        self.found = np.zeros(size, bool)
+        self.has_uid = np.zeros(size, bool)
+        self.step = np.full(size, -1, np.int32)
+        self.retry_us = np.zeros(size, np.uint32)
+        self.uid = np.zeros((size, 2), np.int32)
+        # payload: fixed word matrix, or (heap mode) a per-slot byte ref
+        self.value = (np.zeros((size, u), np.int32) if not vbytes else None)
+        self.data: List[Optional[bytes]] = [None] * size
+
+    def alloc(self, k: int) -> np.ndarray:
+        if k > self.n_free:
+            raise RuntimeError(
+                f"completion ring exhausted: want {k} slots, {self.n_free} "
+                f"free of {self.cap} — the ring is sized for queue_cap + "
+                "store_inflight_cap, so this is an accounting bug, not "
+                "backpressure")
+        out = self.free[self.n_free - k: self.n_free].copy()
+        self.n_free -= k
+        return out
+
+    def release(self, slots: np.ndarray) -> None:
+        k = int(slots.size)
+        if not k:
+            return
+        self.free[self.n_free: self.n_free + k] = slots
+        self.n_free += k
+        self.status[slots] = _RING_OPEN
+        if self.vbytes:
+            for s in slots.tolist():
+                self.data[s] = None
+
+    def in_use(self) -> int:
+        return self.cap - self.n_free
+
+
+class ColumnarFrontend:
+    """The columnar serving data plane over one ``kvs.KVS`` (round-19).
+
+    Same envelope semantics as ``Frontend`` — refusal reasons and
+    retry hints row-for-row identical to the scalar ladder, deadlines
+    enforced at intake and completion, loud statuses everywhere — at
+    columnar throughput: admission, issue, harvest, and emit each touch
+    a whole batch per numpy pass."""
+
+    def __init__(self, store, scfg: Optional[ServingConfig] = None,
+                 clock=None, ring_slack: int = 64):
+        if hasattr(store, "router") and hasattr(store, "groups"):
+            raise ValueError(
+                "the columnar plane serves a single KVS; fleet routing "
+                "(and the batched-read verbs) stay on the scalar Frontend")
+        from hermes_tpu.core import types as t
+        from hermes_tpu.kvs import C_LOST, C_REJECTED
+
+        # the wire op codes ARE the store op codes (K_GET==OP_READ, ...):
+        # the issue path relies on passing the kind column through verbatim
+        assert (wire.K_GET, wire.K_PUT, wire.K_RMW) == (
+            t.OP_READ, t.OP_WRITE, t.OP_RMW)
+        self._C_READ, self._C_WRITE = t.C_READ, t.C_WRITE
+        self._C_RMW, self._C_RMW_ABORT = t.C_RMW, t.C_RMW_ABORT
+        self._C_LOST, self._C_REJECTED = C_LOST, C_REJECTED
+        # completion code -> wire status, indexed by code + 3
+        lut = np.zeros(8, np.uint8)
+        lut[C_LOST + 3] = wire.S_LOST
+        lut[C_REJECTED + 3] = wire.S_REJECTED
+        lut[t.C_READ + 3] = wire.S_OK
+        lut[t.C_WRITE + 3] = wire.S_OK
+        lut[t.C_RMW + 3] = wire.S_OK
+        lut[t.C_RMW_ABORT + 3] = wire.S_RMW_ABORT
+        self._code_lut = lut
+
+        self.store = store
+        self.scfg = scfg or ServingConfig()
+        self.u = store.cfg.value_words - 2
+        self.vbytes = store.cfg.max_value_bytes
+        if self.u < 1:
+            raise ValueError("serving needs value_words >= 3 (the store "
+                             "carries write uids in words 0-1)")
+        self.n_keys = store.cfg.n_keys
+        self.clock = clock if clock is not None else time.monotonic
+        self.adm = AdmissionControl(self.scfg)
+        cap = store.cfg.n_replicas * store.cfg.n_sessions
+        self._store_cap = (self.scfg.store_inflight_cap
+                           if self.scfg.store_inflight_cap is not None
+                           else cap)
+        self.ring = CompletionRing(
+            self.scfg.queue_cap + self._store_cap + ring_slack,
+            self.u, self.vbytes)
+        self._intake: List[np.ndarray] = []   # FIFO of slot-id arrays
+        self._intake_len = 0
+        # open store batches: bf + slots + per-row resolved/harvested/
+        # released masks (a row may resolve S_DEADLINE while its store op
+        # is still open — the slot is held until the store finishes it,
+        # the batch twin of the scalar _abandoned list)
+        self._open: List[dict] = []
+        self._store_inflight = 0
+        self._resp_meta: collections.deque = collections.deque(
+            maxlen=self.scfg.resp_meta_cap)
+        self.requests = 0
+        self.responses = 0
+        self.shed_level = 0
+        if self.scfg.trace_sample:
+            from hermes_tpu.obs.tracing import TraceSampler
+
+            self._sampler = TraceSampler(self.scfg.trace_sample,
+                                         seed=self.scfg.trace_seed)
+        else:
+            self._sampler = None
+        self._op_tracer_cache = None
+        self._round_key_ops: dict = {}
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _rt(self):
+        return self.store.rt
+
+    def _trace(self, name: str, **fields) -> None:
+        rt = self._rt()
+        rt._trace(name, **fields)
+        if rt.obs is not None:
+            rt.obs.registry.counter(f"serving_{name}").inc()
+
+    def _count(self, name: str, n: int = 1) -> None:
+        rt = self._rt()
+        if rt.obs is not None and n:
+            rt.obs.registry.counter(f"serving_{name}").inc(n)
+
+    def _op_tracer(self):
+        rt = self._rt()
+        if rt.obs is None:
+            return None
+        c = self._op_tracer_cache
+        if c is None or c.obs is not rt.obs:
+            from hermes_tpu.obs.tracing import OpTracer
+
+            c = self._op_tracer_cache = OpTracer(rt.obs)
+        return c
+
+    def _update_level(self, degraded: Optional[bool] = None) -> None:
+        if degraded is None:
+            degraded = self.store.degraded()
+        level = self.adm.ladder_level(self._intake_len, degraded)
+        if level != self.shed_level:
+            if level > 0:
+                self._trace("shed", level=level, queue=self._intake_len)
+            else:
+                self._trace("shed_clear", queue=self._intake_len)
+            self.shed_level = level
+
+    # -- intake --------------------------------------------------------------
+
+    def submit_batch(self, batch: wire.ReqBatch,
+                     conn: int = 0) -> wire.RspBatch:
+        """Run a whole request batch through admission in one pass.
+        Returns the IMMEDIATE refusals (S_REJECTED validity failures and
+        loud S_RETRY_AFTER rows) as an RspBatch in batch row order —
+        possibly empty; admitted rows resolve through later ``pump``
+        calls.  ``conn`` tags admitted rows so the pump can emit one
+        response batch per connection."""
+        now = self.clock()
+        k = len(batch)
+        self.requests += k
+        if k == 0:
+            return _empty_rsp_batch(self.u, self.vbytes)
+        status = np.full(k, _RING_OPEN, np.uint8)
+        reason = np.zeros(k, np.uint8)
+        retry_us = np.zeros(k, np.uint32)
+        kind = np.asarray(batch.kind, np.uint8)
+        key = np.asarray(batch.key, np.int64)
+        # validity (the scalar path's pre-admission S_REJECTED checks):
+        # unknown kind, key out of range, heap update without a payload
+        valid = (np.isin(kind, (wire.K_GET, wire.K_PUT, wire.K_RMW))
+                 & (key >= 0) & (key < self.n_keys))
+        writes = kind != wire.K_GET
+        if self.vbytes:
+            vlen = (np.asarray(batch.vlen, np.int64)
+                    if batch.vlen is not None else np.full(k, -1, np.int64))
+            valid &= ~writes | ((vlen >= 0) & (vlen <= self.vbytes))
+        status[~valid] = wire.S_REJECTED
+        vi = np.nonzero(valid)[0]
+        degraded = self.store.degraded()
+        self._update_level(degraded)
+        reasons, waits = self.adm.admit_batch(
+            writes[vi], key[vi], batch.tenant[vi], now,
+            self._intake_len, degraded)
+        refused = reasons != wire.R_NONE
+        ri = vi[refused]
+        status[ri] = wire.S_RETRY_AFTER
+        reason[ri] = reasons[refused]
+        retry_us[ri] = np.ceil(waits[refused] * 1e6).astype(np.uint32)
+        self._count("retry_after", int(ri.size))
+        ai = vi[~refused]
+        if ai.size:
+            # trace mint: adopt client-sampled wire ids, else sample on
+            # the monotone request index (same indices the scalar loop
+            # would use for these rows)
+            trace = np.asarray(batch.trace[ai], np.uint16).copy()
+            if self._sampler is not None:
+                base = self.requests - k
+                for j in np.nonzero(trace == 0)[0].tolist():
+                    trace[j] = self._sampler.sample(base + int(ai[j]))
+            rg = self.ring
+            slots = rg.alloc(int(ai.size))
+            rg.conn[slots] = conn
+            rg.client_rid[slots] = batch.req_id[ai]
+            rg.tenant[slots] = batch.tenant[ai]
+            rg.kind[slots] = kind[ai]
+            rg.key[slots] = key[ai]
+            rg.trace[slots] = trace
+            dl = batch.deadline_us[ai].astype(np.int64)
+            if self.scfg.default_deadline_us:
+                dl = np.where(dl == 0, self.scfg.default_deadline_us, dl)
+            rg.deadline[slots] = np.where(dl > 0, now + dl * 1e-6, np.inf)
+            rg.t_admit[slots] = now
+            rg.r_admit[slots] = self._rt().step_idx
+            rg.status[slots] = _RING_OPEN
+            if self.vbytes:
+                for j, s in zip(ai.tolist(), slots.tolist()):
+                    rg.data[s] = batch.row_data(j)
+            else:
+                rg.value[slots] = (batch.value[ai]
+                                   if batch.value is not None
+                                   else 0)
+            self._intake.append(slots)
+            self._intake_len += int(slots.size)
+            ku, kc = np.unique(key[ai], return_counts=True)
+            for kk, cc in zip(ku.tolist(), kc.tolist()):
+                self._round_key_ops[kk] = \
+                    self._round_key_ops.get(kk, 0) + cc
+        # immediate refusals (in batch row order)
+        done = status != _RING_OPEN
+        di = np.nonzero(done)[0]
+        nd = int(di.size)
+        self.responses += nd
+        for tt, st in zip(batch.tenant[di].tolist(),
+                          status[di].tolist()):
+            self._resp_meta.append((int(tt), int(st), None))
+        rb = wire.RspBatch(
+            status=status[di], reason=reason[di],
+            req_id=np.asarray(batch.req_id)[di].astype(np.uint32),
+            found=np.ones(nd, bool),  # refusal Responses default found=True
+            has_uid=np.zeros(nd, bool), step=np.full(nd, -1, np.int32),
+            retry_after_us=retry_us[di],
+            uid=np.zeros((nd, 2), np.int32))
+        if self.vbytes:
+            rb.vlen = np.full(nd, -1, np.int64)
+        else:
+            rb.value = np.zeros((nd, self.u), np.int32)
+        return rb
+
+    # -- resolution helpers --------------------------------------------------
+
+    def _mark_deadline(self, slots: np.ndarray) -> None:
+        """Write the S_DEADLINE resolution columns (found=False, no
+        result payload — the scalar ``_deadline_rsp`` shape)."""
+        rg = self.ring
+        rg.status[slots] = wire.S_DEADLINE
+        rg.reason[slots] = wire.R_NONE
+        rg.found[slots] = False
+        rg.has_uid[slots] = False
+        rg.step[slots] = -1
+        rg.retry_us[slots] = 0
+        rg.uid[slots] = 0
+        if rg.value is not None:
+            rg.value[slots] = 0
+        else:
+            for s in slots.tolist():
+                rg.data[s] = None
+
+    def _finish(self, slots: np.ndarray, now: float,
+                emit: List[np.ndarray]) -> None:
+        """Account + meta + spans for freshly-resolved slots, and queue
+        them for this pump's per-connection emit."""
+        rg = self.ring
+        sts = rg.status[slots]
+        self.adm.note_resolved_batch(rg.tenant[slots], sts)
+        self._count("deadline", int((sts == wire.S_DEADLINE).sum()))
+        lats = now - rg.t_admit[slots]
+        for tt, st, lat in zip(rg.tenant[slots].tolist(), sts.tolist(),
+                               lats.tolist()):
+            self._resp_meta.append((tt, st, lat))
+        self.responses += int(slots.size)
+        traced = np.nonzero(rg.trace[slots] != 0)[0]
+        if traced.size:
+            tr = self._op_tracer()
+            if tr is not None:
+                r1 = self._rt().step_idx
+                for j in traced.tolist():
+                    s = int(slots[j])
+                    tr.span(
+                        "fe_resolve", int(rg.trace[s]),
+                        r0=int(rg.r_admit[s]), r1=r1,
+                        dur_s=now - float(rg.t_admit[s]),
+                        tenant=int(rg.tenant[s]),
+                        op=wire._KIND_NAMES[int(rg.kind[s])],
+                        key=int(rg.key[s]), status=int(rg.status[s]))
+        emit.append(slots)
+
+    def _rsp_batch(self, slots: np.ndarray) -> wire.RspBatch:
+        rg = self.ring
+        rb = wire.RspBatch(
+            status=rg.status[slots], reason=rg.reason[slots],
+            req_id=rg.client_rid[slots], found=rg.found[slots],
+            has_uid=rg.has_uid[slots], step=rg.step[slots],
+            retry_after_us=rg.retry_us[slots], uid=rg.uid[slots])
+        if self.vbytes:
+            vlen = np.full(slots.size, -1, np.int64)
+            voff = np.zeros(slots.size, np.int64)
+            parts = []
+            off = 0
+            for j, s in enumerate(slots.tolist()):
+                d = rg.data[s]
+                if d is not None and rg.status[s] == wire.S_OK:
+                    vlen[j] = len(d)
+                    voff[j] = off
+                    parts.append(d)
+                    off += len(d)
+            rb.vlen, rb.voff, rb.blob = vlen, voff, b"".join(parts)
+        else:
+            rb.value = rg.value[slots]
+        return rb
+
+    # -- the pump ------------------------------------------------------------
+
+    def pump(self) -> Dict[int, wire.RspBatch]:
+        """One serving round, all columns: intake expiry -> issue (ONE
+        store.submit_batch) -> store.step() -> harvest (column writes
+        off BatchFutures) + completion-side deadlines -> one RspBatch
+        per connection.  Returns {conn: RspBatch} for this round's
+        resolutions."""
+        now = self.clock()
+        rg = self.ring
+        emit: List[np.ndarray] = []
+        expired_free: List[np.ndarray] = []
+        # 1. intake expiry FIRST, over the whole queue (scalar rule: an
+        # op stuck behind a full store still resolves S_DEADLINE on time)
+        if self._intake_len:
+            kept: List[np.ndarray] = []
+            n_left = 0
+            for slots in self._intake:
+                late = now > rg.deadline[slots]
+                if late.any():
+                    ds = slots[late]
+                    self._mark_deadline(ds)
+                    self._finish(ds, now, emit)
+                    expired_free.append(ds)
+                    slots = slots[~late]
+                if slots.size:
+                    kept.append(slots)
+                    n_left += int(slots.size)
+            self._intake = kept
+            self._intake_len = n_left
+        # 2. issue: fill the store's free depth with the intake prefix,
+        # one submit_batch for the whole round
+        room = self._store_cap - self._store_inflight
+        if room > 0 and self._intake_len:
+            take: List[np.ndarray] = []
+            while self._intake and room > 0:
+                s = self._intake[0]
+                if s.size <= room:
+                    take.append(s)
+                    self._intake.pop(0)
+                    room -= int(s.size)
+                else:
+                    take.append(s[:room])
+                    self._intake[0] = s[room:]
+                    room = 0
+            slots = (np.concatenate(take) if len(take) > 1 else take[0])
+            self._intake_len -= int(slots.size)
+            if self.vbytes:
+                vals = [rg.data[s] for s in slots.tolist()]
+            else:
+                vals = rg.value[slots]
+            bf = self.store.submit_batch(
+                rg.kind[slots].astype(np.int32), rg.key[slots], vals)
+            n = int(slots.size)
+            self._open.append(dict(
+                bf=bf, slots=slots,
+                resolved=np.zeros(n, bool),
+                harvested=np.zeros(n, bool),
+                released=np.zeros(n, bool)))
+            self._store_inflight += n
+            traced = np.nonzero(rg.trace[slots] != 0)[0]
+            if traced.size:
+                tr = self._op_tracer()
+                if tr is not None:
+                    r1 = self._rt().step_idx
+                    for j in traced.tolist():
+                        s = int(slots[j])
+                        tr.span(
+                            "fe_queue", int(rg.trace[s]),
+                            r0=int(rg.r_admit[s]), r1=r1,
+                            dur_s=now - float(rg.t_admit[s]),
+                            tenant=int(rg.tenant[s]),
+                            op=wire._KIND_NAMES[int(rg.kind[s])],
+                            key=int(rg.key[s]))
+        # 3. one store round
+        self.store.step()
+        now = self.clock()
+        # 4. harvest completions + completion-side deadline enforcement,
+        # in issue order (deterministic)
+        for ob in self._open:
+            bf, slots = ob["bf"], ob["slots"]
+            code = np.asarray(bf.code)
+            done = code != 0
+            newly_done = done & ~ob["harvested"]
+            if newly_done.any():
+                self._store_inflight -= int(newly_done.sum())
+                ob["harvested"] |= newly_done
+            res = done & ~ob["resolved"]
+            if res.any():
+                ds = slots[res]
+                c = code[res]
+                late = now > rg.deadline[ds]
+                st = self._code_lut[c + 3]
+                rg.status[ds] = np.where(late, wire.S_DEADLINE, st)
+                maybe = (c == self._C_LOST) | (c == self._C_REJECTED)
+                fnd = np.asarray(bf.found)[res] & ~maybe
+                rg.found[ds] = np.where(late, False, fnd)
+                rg.reason[ds] = wire.R_NONE
+                rg.retry_us[ds] = 0
+                rg.step[ds] = np.where(late, -1, np.asarray(bf.step)[res])
+                hu = (((c == self._C_WRITE) | (c == self._C_RMW))
+                      & ~late)
+                rg.has_uid[ds] = hu
+                rg.uid[ds] = np.where(hu[:, None],
+                                      np.asarray(bf.uid)[res], 0)
+                readable = (((c == self._C_READ) | (c == self._C_RMW))
+                            & fnd & ~late)
+                if rg.value is not None:
+                    rg.value[ds] = np.where(readable[:, None],
+                                            np.asarray(bf.value)[res], 0)
+                else:
+                    ridx = np.nonzero(res)[0]
+                    for j, s, keep in zip(ridx.tolist(), ds.tolist(),
+                                          readable.tolist()):
+                        rg.data[s] = bf.data[j] if keep else None
+                ob["resolved"] |= res
+                self._finish(ds, now, emit)
+            # completion-side deadline on rows the store still holds:
+            # the RPC resolves NOW, the slot stays until the store
+            # finishes the op (the scalar _abandoned semantics)
+            pend = ~done & ~ob["resolved"]
+            if pend.any():
+                ds_all = slots[pend]
+                late = now > rg.deadline[ds_all]
+                if late.any():
+                    ds = ds_all[late]
+                    self._mark_deadline(ds)
+                    idx = np.nonzero(pend)[0][late]
+                    ob["resolved"][idx] = True
+                    self._finish(ds, now, emit)
+        self._update_level()
+        rt = self._rt()
+        if rt.obs is not None:
+            reg = rt.obs.registry
+            reg.series("intake_depth_series").append(
+                rt.step_idx, self._intake_len)
+            reg.series("shed_level_series").append(
+                rt.step_idx, self.shed_level)
+            reg.series("key_heat_max_series").append(
+                rt.step_idx, max(self._round_key_ops.values(), default=0))
+            reg.series("key_distinct_series").append(
+                rt.step_idx, len(self._round_key_ops))
+        self._round_key_ops.clear()
+        # 5. emit: one response batch per connection, then recycle slots
+        out: Dict[int, wire.RspBatch] = {}
+        if emit:
+            all_slots = np.concatenate(emit)
+            conns = rg.conn[all_slots]
+            for cid in np.unique(conns).tolist():
+                out[cid] = self._rsp_batch(all_slots[conns == cid])
+        for ds in expired_free:
+            rg.release(ds)
+        still: List[dict] = []
+        for ob in self._open:
+            freeable = ob["resolved"] & ob["harvested"] & ~ob["released"]
+            if freeable.any():
+                rg.release(ob["slots"][freeable])
+                ob["released"] |= freeable
+            if not ob["released"].all():
+                still.append(ob)
+        self._open = still
+        return out
+
+    def idle(self) -> bool:
+        return not self._intake and not self._open
+
+    def flush(self) -> Dict[int, wire.RspBatch]:
+        """Force the store's deferred (pipelined) completions out and
+        harvest them."""
+        self.store.flush()
+        self.store.rt.flush_pipeline()
+        return self.pump()
+
+    def drain(self, max_rounds: int = 10_000
+              ) -> Tuple[bool, List[Dict[int, wire.RspBatch]]]:
+        """Pump until every admitted op resolves; returns (drained,
+        per-pump emit dicts) — drained responses stay observable."""
+        emitted: List[Dict[int, wire.RspBatch]] = []
+        for _ in range(max_rounds):
+            if self.idle():
+                self._update_level()
+                return True, emitted
+            emitted.append(self.pump())
+        emitted.append(self.flush())
+        return self.idle(), emitted
+
+    # -- accounting ----------------------------------------------------------
+
+    def latencies(self, statuses=(wire.S_OK, wire.S_RMW_ABORT,
+                                  wire.S_DEADLINE, wire.S_REJECTED,
+                                  wire.S_LOST)) -> List[float]:
+        return [lat for _t, st, lat in self._resp_meta
+                if st in statuses and lat is not None]
+
+    def counters(self) -> dict:
+        per = self.adm.counters()
+        agg: Dict[str, int] = {}
+        for row in per.values():
+            for k, v in row.items():
+                agg[k] = agg.get(k, 0) + v
+        return dict(requests=self.requests, responses=self.responses,
+                    shed_level=self.shed_level, queue=self._intake_len,
+                    store_inflight=self._store_inflight,
+                    ring_in_use=self.ring.in_use(),
+                    tenants=per, fleet=False, totals=agg)
+
+
+def _empty_rsp_batch(u: int, vbytes: int) -> wire.RspBatch:
+    rb = wire.RspBatch(
+        status=np.zeros(0, np.uint8), reason=np.zeros(0, np.uint8),
+        req_id=np.zeros(0, np.uint32), found=np.zeros(0, bool),
+        has_uid=np.zeros(0, bool), step=np.zeros(0, np.int32),
+        retry_after_us=np.zeros(0, np.uint32),
+        uid=np.zeros((0, 2), np.int32))
+    if vbytes:
+        rb.vlen = np.zeros(0, np.int64)
+    else:
+        rb.value = np.zeros((0, u), np.int32)
+    return rb
+
+
+def verify_columnar(fe: ColumnarFrontend) -> dict:
+    """The serving envelope invariants, ring edition:
+
+      1. response conservation — every batched request produced exactly
+         one response row;
+      2. admission accounting exactness per tenant, in-flight zero;
+      3. the envelope is empty — intake, open store batches, and the
+         completion ring all drained (every slot back on the free
+         stack).
+    """
+    assert fe.requests == fe.responses, (
+        f"response conservation broken: {fe.requests} requests but "
+        f"{fe.responses} responses")
+    for t, row in fe.adm.counters().items():
+        assert row["inflight"] == 0, (
+            f"tenant {t} still shows {row['inflight']} in flight")
+        resolved = (row["completed"] + row["deadline"] + row["rejected"]
+                    + row["lost"])
+        assert row["admitted"] == resolved, (
+            f"tenant {t} admission accounting broken: "
+            f"admitted={row['admitted']} != resolved={resolved} ({row})")
+    assert not fe._intake and not fe._open, (
+        "columnar envelope not empty after drain")
+    assert fe.ring.in_use() == 0, (
+        f"completion ring leaked {fe.ring.in_use()} slots")
+    agg = fe.counters()["totals"]
+    return dict(requests=fe.requests, responses=fe.responses,
+                admitted=agg.get("admitted", 0),
+                completed=agg.get("completed", 0),
+                deadline=agg.get("deadline", 0),
+                retry_after=agg.get("retry_after", 0),
+                shed=agg.get("shed", 0),
+                rejected=agg.get("rejected", 0), lost=agg.get("lost", 0))
 
 
 def verify_serving(fe: Frontend) -> dict:

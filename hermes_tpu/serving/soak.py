@@ -191,6 +191,188 @@ def run_open_loop(store, scfg: ServingConfig, spec: MixSpec,
     )
 
 
+def run_columnar_soak(store, scfg: ServingConfig, spec: MixSpec,
+                      rate_per_s: float, n: int, seed: int,
+                      deadline_us: int,
+                      arrivals: Optional[ShapedArrivals] = None,
+                      max_rounds: int = 200_000) -> dict:
+    """The open-loop soak on the COLUMNAR data plane (round-19): the
+    same seeded Poisson schedule and op mix as ``run_open_loop``, but
+    each round's due arrivals go through the wire as ONE columnar batch
+    and responses drain as one encode per pump — still a pure function
+    of (store config, serving config, mix spec, rate, seed), so the
+    response byte log replays identically."""
+    from hermes_tpu.serving.rpc import ColumnarLoopback
+    from hermes_tpu.serving.server import ColumnarFrontend, verify_columnar
+
+    clock = VirtualClock()
+    fe = ColumnarFrontend(store, scfg, clock=clock)
+    lb = ColumnarLoopback(fe)
+    if fe.vbytes:
+        raise ValueError(
+            "the columnar soak drives fixed-width stores (the open-loop "
+            "mix generator mints word values); heap-mode coverage lives "
+            "in the codec property tests and the frontend unit tests")
+    if arrivals is None:
+        arrivals = ShapedArrivals(rate_per_s, n, seed)
+    mix = make_mix(spec, fe.n_keys, n, seed, value_words=fe.u)
+    # columnize the whole mix ONCE; each round slices a view
+    kind_col = (np.asarray(mix["kind"], np.uint8) + 1)  # 0/1/2 -> K_*
+    rid_col = np.arange(1, n + 1, dtype=np.uint32)
+    tenant_col = np.asarray(mix["tenant"], np.uint16)
+    trace_col = np.zeros(n, np.uint16)  # server-minted sampling
+    dl_col = np.full(n, deadline_us, np.uint32)
+    key_col = np.asarray(mix["key"], np.int64)
+    val_col = np.asarray(mix["value"], np.int32).reshape(n, fe.u)
+    round_s = scfg.round_us * 1e-6
+    sent = 0
+    rounds = 0
+    obs = fe._rt().obs
+    restore_sigterm = None
+    if obs is not None:
+        from hermes_tpu.obs.flightrec import install_sigterm
+
+        restore_sigterm = install_sigterm(
+            obs.flight, extra=dict(where="columnar_soak", seed=seed))
+    try:
+        while rounds < max_rounds:
+            k = min(arrivals.due(clock.t), n - sent)
+            if k:
+                b = wire.ReqBatch(
+                    kind=kind_col[sent:sent + k],
+                    req_id=rid_col[sent:sent + k],
+                    tenant=tenant_col[sent:sent + k],
+                    trace=trace_col[sent:sent + k],
+                    deadline_us=dl_col[sent:sent + k],
+                    key=key_col[sent:sent + k],
+                    value=val_col[sent:sent + k])
+                lb.submit_batch(b, conn=0)
+                sent += k
+            lb.pump()
+            clock.advance(round_s)
+            rounds += 1
+            if sent >= n and fe.idle():
+                break
+        lb.drain()
+        statuses: dict = {}
+        for _t, st, _lat in fe._resp_meta:
+            name = wire.STATUS_NAMES[st]
+            statuses[name] = statuses.get(name, 0) + 1
+        lat = sorted(fe.latencies())
+        pctl = lambda q: percentile_nearest_rank(lat, q)
+        try:
+            ev = verify_columnar(fe)
+        except AssertionError:
+            if obs is not None:
+                obs.flight_dump("verify_columnar_failed",
+                                extra=dict(seed=seed, rounds=rounds))
+            raise
+    finally:
+        if restore_sigterm is not None:
+            restore_sigterm()
+    return dict(
+        ops_offered=n, sent=sent, rounds=rounds,
+        statuses=statuses, admitted=ev["admitted"],
+        retry_after=ev["retry_after"], shed=ev["shed"],
+        deadline=ev["deadline"], lost=ev["lost"],
+        completed=ev["completed"], rejected=ev["rejected"],
+        p50_latency_us=(None if pctl(0.5) is None
+                        else round(pctl(0.5) * 1e6, 1)),
+        p99_latency_us=(None if pctl(0.99) is None
+                        else round(pctl(0.99) * 1e6, 1)),
+        deadline_us=deadline_us,
+        virtual_seconds=round(clock.t, 6),
+        response_log_sha=_sha(lb.response_log()),
+        tenants=fe.counters()["tenants"],
+        _frontend=fe, _server=lb,
+    )
+
+
+def measure_columnar_floor(n_ops: int = 8192, batch: int = 1024,
+                           seed: int = 14, store=None,
+                           scfg: Optional[ServingConfig] = None) -> dict:
+    """WALL-CLOCK closed-loop throughput of the columnar loopback path
+    — the serving-throughput floor leg (scripts/check_serving.py): the
+    full byte-honest pipeline (columnar encode -> CRC frame -> decode ->
+    batch admission -> ring -> store -> columnar response encode) on the
+    real clock.  Every op resolves; refusals would be S_RETRY_AFTER rows
+    and are RETRIED (closed-loop clients wait) — with the generous
+    default envelope none occur, and the count is reported loudly."""
+    import time as _time
+
+    from hermes_tpu.serving.rpc import ColumnarLoopback
+    from hermes_tpu.serving.server import ColumnarFrontend, verify_columnar
+
+    if store is None:
+        from hermes_tpu.config import HermesConfig, WorkloadConfig
+        from hermes_tpu.kvs import KVS
+
+        store = KVS(HermesConfig(
+            n_replicas=4, n_keys=64, n_sessions=128, value_words=8,
+            pipeline_depth=2,
+            workload=WorkloadConfig(read_frac=0.5, seed=seed)))
+    scfg = scfg or ServingConfig(
+        tenant_rate_per_s=1e9, tenant_burst=1e9,
+        tenant_quota=4 * batch, queue_cap=4 * batch)
+    fe = ColumnarFrontend(store, scfg)  # real clock: wall-honest floor
+    lb = ColumnarLoopback(fe)
+    spec = MixSpec(read_frac=0.5, rmw_frac=0.1, tenants=4)
+    mix = make_mix(spec, fe.n_keys, n_ops, seed, value_words=fe.u)
+    kind_col = (np.asarray(mix["kind"], np.uint8) + 1)
+    rid_col = np.arange(1, n_ops + 1, dtype=np.uint32)
+    tenant_col = np.asarray(mix["tenant"], np.uint16)
+    zeros16 = np.zeros(n_ops, np.uint16)
+    zeros32 = np.zeros(n_ops, np.uint32)
+    key_col = np.asarray(mix["key"], np.int64)
+    val_col = np.asarray(mix["value"], np.int32).reshape(n_ops, fe.u)
+
+    def _slice(lo, hi):
+        return wire.ReqBatch(
+            kind=kind_col[lo:hi], req_id=rid_col[lo:hi],
+            tenant=tenant_col[lo:hi], trace=zeros16[lo:hi],
+            deadline_us=zeros32[lo:hi], key=key_col[lo:hi],
+            value=val_col[lo:hi])
+
+    # warm the store's jit cache on a throwaway prefix so the floor
+    # measures the data plane, not XLA compile time
+    warm = min(batch, n_ops)
+    lb.submit_batch(_slice(0, warm), conn=0)
+    while not fe.idle():
+        lb.pump()
+    retried = 0
+    sent = warm
+    retry_q: List[wire.ReqBatch] = []
+
+    def _offer(b):
+        nonlocal retried
+        rb = lb.submit_batch(b, conn=0)
+        if len(rb):  # closed-loop: refused rows go around again
+            idx = np.nonzero(rb.status == wire.S_RETRY_AFTER)[0]
+            if idx.size:
+                retried += int(idx.size)
+                retry_q.append(b.select(idx))
+
+    t0 = _time.perf_counter()
+    while sent < n_ops or retry_q or not fe.idle():
+        inflight = fe.requests - fe.responses
+        if retry_q:
+            _offer(retry_q.pop(0))
+        elif sent < n_ops and inflight < batch:
+            k = min(batch, n_ops - sent)
+            _offer(_slice(sent, sent + k))
+            sent += k
+        lb.pump()
+    seconds = _time.perf_counter() - t0
+    verify_columnar(fe)
+    measured = n_ops - warm
+    return dict(ops=measured, seconds=round(seconds, 6),
+                ops_per_sec=round(measured / seconds, 1),
+                batch=batch, retried=retried,
+                wire_rx_bytes=lb.wire_rx, wire_tx_bytes=lb.wire_tx,
+                n_replicas=store.cfg.n_replicas,
+                n_sessions=store.cfg.n_sessions)
+
+
 def _sha(b: bytes) -> str:
     import hashlib
 

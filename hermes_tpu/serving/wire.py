@@ -541,6 +541,489 @@ def response_extent(raw: bytes, off: int, u: int, vbytes: int = 0) -> int:
     return rsp_nbytes(u)
 
 
+# -- round-19 columnar batch codec -------------------------------------------
+#
+# The serving data plane processes requests the way the round does: as
+# COLUMNS, not structs.  A drained socket buffer is k back-to-back
+# single-op records (REQ_MAGIC delimits; a classic one-op frame is a
+# 1-row batch) and decodes in one numpy pass into per-field arrays; a
+# pump's resolutions encode back into one record stream per connection.
+# The per-struct encode/decode above stay as the compat/fuzz ORACLE: for
+# any batch,
+#
+#     encode_request_batch(b)  == b"".join(encode_request(r) for r in b)
+#     encode_response_batch(b) == b"".join(encode_response(r) for r in b)
+#
+# byte-for-byte (both payload modes), and decode is the exact inverse —
+# so the response-log walkers (response_extent / committed_uids) and old
+# one-op peers read columnar streams unchanged.  The struct codec's
+# asymmetries are mirrored exactly: heap-mode request encode drops data
+# on gets, heap-mode response encode drops data on non-OK statuses, and
+# fixed-mode encode writes the value column verbatim regardless of
+# kind/status (decode nulls it back, same as the struct path).
+
+from hermes_tpu.transport import codec as _codec
+
+_REQ_KINDS = (K_GET, K_PUT, K_RMW)
+
+
+def _put_col(M: np.ndarray, off: int, arr, dt: str) -> None:
+    """Write a scalar column into byte-matrix ``M`` at byte ``off`` (one
+    contiguous-view reinterpret, the rows_to_words discipline)."""
+    k = M.shape[0]
+    col = np.ascontiguousarray(np.asarray(arr).astype(dt, copy=False))
+    w = col.dtype.itemsize
+    M[:, off: off + w] = col.view(np.uint8).reshape(k, w)
+
+
+def _get_col(M: np.ndarray, off: int, dt: str) -> np.ndarray:
+    """Read a scalar column out of byte-matrix ``M`` at byte ``off``."""
+    k = M.shape[0]
+    w = np.dtype(dt).itemsize
+    return np.ascontiguousarray(M[:, off: off + w]).view(dt).reshape(k)
+
+
+@dataclasses.dataclass
+class ReqBatch:
+    """Columnar view of k single-op requests (one column per wire field;
+    heap mode swaps the fixed ``value`` matrix for a ``vlen``/``voff``
+    pair addressing one shared payload ``blob``, -1 = no tail)."""
+
+    kind: np.ndarray          # (k,) uint8 — K_GET / K_PUT / K_RMW
+    req_id: np.ndarray        # (k,) uint32
+    tenant: np.ndarray        # (k,) uint16
+    trace: np.ndarray         # (k,) uint16 — 0 = not sampled (round-18)
+    deadline_us: np.ndarray   # (k,) uint32 — relative; 0 = none
+    key: np.ndarray           # (k,) int64
+    value: Optional[np.ndarray] = None  # fixed mode: (k, u) int32
+    vlen: Optional[np.ndarray] = None   # heap mode: (k,) int64; -1 = none
+    voff: Optional[np.ndarray] = None   # heap mode: (k,) offsets into blob
+    blob: bytes = b""                   # heap mode: shared payload pool
+
+    def __len__(self) -> int:
+        return int(self.kind.shape[0])
+
+    def row_data(self, i: int) -> Optional[bytes]:
+        """Heap payload bytes of row ``i`` (None when absent)."""
+        if self.vlen is None or self.vlen[i] < 0:
+            return None
+        o = int(self.voff[i]) if self.voff is not None else 0
+        return self.blob[o: o + int(self.vlen[i])]
+
+    def select(self, idx) -> "ReqBatch":
+        """Row-gather a sub-batch (shares the heap blob)."""
+        idx = np.asarray(idx)
+        return ReqBatch(
+            kind=self.kind[idx], req_id=self.req_id[idx],
+            tenant=self.tenant[idx], trace=self.trace[idx],
+            deadline_us=self.deadline_us[idx], key=self.key[idx],
+            value=None if self.value is None else self.value[idx],
+            vlen=None if self.vlen is None else self.vlen[idx],
+            voff=None if self.voff is None else self.voff[idx],
+            blob=self.blob)
+
+    def to_requests(self) -> List[Request]:
+        """Struct rows (the oracle direction) — mirrors decode_request's
+        nulling rules (gets carry no value/data)."""
+        out = []
+        for i in range(len(self)):
+            kname = _KIND_NAMES[int(self.kind[i])]
+            value = data = None
+            if kname != "get":
+                if self.value is not None:
+                    value = self.value[i].tolist()
+                data = self.row_data(i)
+            out.append(Request(
+                kind=kname, req_id=int(self.req_id[i]),
+                tenant=int(self.tenant[i]), key=int(self.key[i]),
+                deadline_us=int(self.deadline_us[i]),
+                trace=int(self.trace[i]), value=value, data=data))
+        return out
+
+    @staticmethod
+    def from_requests(reqs: List[Request], u: int,
+                      vbytes: int = 0) -> "ReqBatch":
+        """Columnarize struct rows — mirrors encode_request's payload
+        rules (fixed mode writes value verbatim even for gets; heap mode
+        drops data on gets)."""
+        k = len(reqs)
+        b = ReqBatch(
+            kind=np.array([_KIND_CODES[r.kind] for r in reqs], np.uint8),
+            req_id=np.array([r.req_id for r in reqs], np.uint32),
+            tenant=np.array([r.tenant for r in reqs], np.uint16),
+            trace=np.array([r.trace for r in reqs], np.uint16),
+            deadline_us=np.array([r.deadline_us for r in reqs], np.uint32),
+            key=np.array([r.key for r in reqs], np.int64))
+        if vbytes:
+            vlen = np.full(k, -1, np.int64)
+            voff = np.zeros(k, np.int64)
+            parts = []
+            off = 0
+            for i, r in enumerate(reqs):
+                if r.data is not None and r.kind != "get":
+                    raw = bytes(r.data)
+                    vlen[i] = len(raw)
+                    voff[i] = off
+                    parts.append(raw)
+                    off += len(raw)
+            b.vlen, b.voff, b.blob = vlen, voff, b"".join(parts)
+        else:
+            val = np.zeros((k, u), np.int32)
+            for i, r in enumerate(reqs):
+                if r.value is not None:
+                    v = np.asarray(list(r.value), np.int32)
+                    if v.ndim != 1 or v.shape[0] > u:
+                        raise ValueError(f"value must be <= {u} int32 words")
+                    val[i, : v.shape[0]] = v
+            b.value = val
+        return b
+
+
+def _req_heads(b: ReqBatch, width: int) -> np.ndarray:
+    k = len(b)
+    kind = np.asarray(b.kind, np.uint8)
+    if k and not np.isin(kind, _REQ_KINDS).all():
+        bad = int(kind[~np.isin(kind, _REQ_KINDS)][0])
+        raise ValueError(f"unknown wire op kind {bad} in batch")
+    M = np.zeros((k, width), np.uint8)
+    _put_col(M, 0, np.full(k, REQ_MAGIC), "<u2")
+    M[:, 2] = kind
+    _put_col(M, 4, b.req_id, "<u4")
+    _put_col(M, 8, b.tenant, "<u2")
+    _put_col(M, 10, b.trace, "<u2")
+    _put_col(M, 12, b.deadline_us, "<u4")
+    _put_col(M, 16, b.key, "<i8")
+    return M
+
+
+def encode_request_batch(b: ReqBatch, u: int, vbytes: int = 0) -> bytes:
+    """k requests -> one record stream, byte-identical to concatenating
+    ``encode_request`` over the rows (one numpy pass, no per-row Python
+    beyond the heap-mode blob gather)."""
+    k = len(b)
+    if vbytes:
+        vlen = (np.asarray(b.vlen, np.int64) if b.vlen is not None
+                else np.full(k, -1, np.int64))
+        # the struct codec's rule: gets never carry a payload tail
+        vlen = np.where(np.asarray(b.kind, np.uint8) == K_GET, -1, vlen)
+        if k and int(vlen.max(initial=-1)) > vbytes:
+            raise ValueError(f"payload is {int(vlen.max())} bytes > "
+                             f"max_value_bytes={vbytes}")
+        plen = np.maximum(vlen, 0)
+        recs = _REQ.size + 4 + plen
+        offs = np.concatenate(([0], np.cumsum(recs)[:-1])) if k \
+            else np.zeros(0, np.int64)
+        out = np.zeros(int(recs.sum()), np.uint8)
+        H = _req_heads(b, _REQ.size + 4)
+        _put_col(H, _REQ.size,
+                 np.where(vlen < 0, _DLEN_NONE, vlen).astype(np.uint32),
+                 "<u4")
+        _codec.scatter_records(out, offs, H)
+        voff = (np.asarray(b.voff, np.int64) if b.voff is not None
+                else np.zeros(k, np.int64))
+        blob8 = np.frombuffer(b.blob, np.uint8)
+        src = _codec.ragged_gather(blob8, voff, plen)
+        _codec.ragged_scatter(out, offs + _REQ.size + 4, plen, src)
+        return out.tobytes()
+    M = _req_heads(b, req_nbytes(u))
+    val = b.value if b.value is not None else np.zeros((k, u), np.int32)
+    val = np.asarray(val, np.int32)
+    if val.shape != (k, u):
+        raise ValueError(f"value matrix shape {val.shape} != ({k}, {u})")
+    if u:
+        M[:, _REQ.size:] = np.ascontiguousarray(val).view(
+            np.uint8).reshape(k, 4 * u)
+    return M.tobytes()
+
+
+def decode_request_batch(buf: bytes, u: int, vbytes: int = 0) -> ReqBatch:
+    """One drained buffer of k back-to-back request records -> columns
+    (the inverse of ``encode_request_batch``; raises ValueError on torn
+    trailing bytes, bad magic, or an unknown kind — same triage rules as
+    the struct decoder, applied batch-wide)."""
+    buf = bytes(buf)
+    if vbytes:
+        offs, dls = [], []
+        off, hsz = 0, _REQ.size
+        while off < len(buf):
+            if off + hsz + 4 > len(buf):
+                raise ValueError(
+                    f"torn batch: truncated request header at byte {off} "
+                    f"({len(buf) - off} trailing bytes)")
+            (dlen,) = struct.unpack_from("<I", buf, off + hsz)
+            if dlen == _DLEN_NONE:
+                dls.append(-1)
+                end = off + hsz + 4
+            else:
+                if dlen > vbytes:
+                    raise ValueError(f"payload tail declares {dlen} bytes "
+                                     f"(max {vbytes})")
+                if off + hsz + 4 + dlen > len(buf):
+                    raise ValueError(
+                        f"torn batch: payload tail at byte {off} wants "
+                        f"{dlen} bytes, have {len(buf) - off - hsz - 4}")
+                dls.append(dlen)
+                end = off + hsz + 4 + dlen
+            offs.append(off)
+            off = end
+        k = len(offs)
+        offs_a = np.asarray(offs, np.int64)
+        M = _codec.gather_records(np.frombuffer(buf, np.uint8), offs_a,
+                                  hsz + 4)
+        vlen = np.asarray(dls, np.int64)
+        voff = offs_a + hsz + 4
+        b = _decode_req_heads(M)
+        b.vlen, b.voff, b.blob = vlen, voff, buf
+        return b
+    stride = req_nbytes(u)
+    if len(buf) % stride:
+        raise ValueError(
+            f"torn batch: {len(buf)} bytes is not a whole number of "
+            f"{stride}-byte requests ({len(buf) % stride} trailing bytes)")
+    k = len(buf) // stride
+    M = np.frombuffer(buf, np.uint8).reshape(k, stride)
+    b = _decode_req_heads(M)
+    b.value = np.ascontiguousarray(M[:, _REQ.size:]).view(
+        np.int32).reshape(k, u)
+    return b
+
+
+def _decode_req_heads(M: np.ndarray) -> ReqBatch:
+    k = M.shape[0]
+    magic = _get_col(M, 0, "<u2")
+    if k and (magic != REQ_MAGIC).any():
+        i = int(np.nonzero(magic != REQ_MAGIC)[0][0])
+        raise ValueError(f"bad request magic 0x{int(magic[i]):04x} "
+                         f"at row {i}")
+    kind = M[:, 2].copy()
+    if k and not np.isin(kind, _REQ_KINDS).all():
+        bad = int(kind[~np.isin(kind, _REQ_KINDS)][0])
+        raise ValueError(f"unknown wire op kind {bad}")
+    return ReqBatch(
+        kind=kind, req_id=_get_col(M, 4, "<u4"),
+        tenant=_get_col(M, 8, "<u2"), trace=_get_col(M, 10, "<u2"),
+        deadline_us=_get_col(M, 12, "<u4"), key=_get_col(M, 16, "<i8"))
+
+
+@dataclasses.dataclass
+class RspBatch:
+    """Columnar view of k single-op responses (same contract as
+    ``ReqBatch``: byte-identical record stream, shared heap blob)."""
+
+    status: np.ndarray          # (k,) uint8 — S_*
+    reason: np.ndarray          # (k,) uint8 — R_*
+    req_id: np.ndarray          # (k,) uint32
+    found: np.ndarray           # (k,) bool
+    has_uid: np.ndarray         # (k,) bool
+    step: np.ndarray            # (k,) int32
+    retry_after_us: np.ndarray  # (k,) uint32
+    uid: np.ndarray             # (k, 2) int32 — (hi, lo)
+    value: Optional[np.ndarray] = None  # fixed mode: (k, u) int32
+    vlen: Optional[np.ndarray] = None   # heap mode: (k,) int64; -1 = none
+    voff: Optional[np.ndarray] = None
+    blob: bytes = b""
+
+    def __len__(self) -> int:
+        return int(self.status.shape[0])
+
+    def row_data(self, i: int) -> Optional[bytes]:
+        if self.vlen is None or self.vlen[i] < 0:
+            return None
+        o = int(self.voff[i]) if self.voff is not None else 0
+        return self.blob[o: o + int(self.vlen[i])]
+
+    def select(self, idx) -> "RspBatch":
+        idx = np.asarray(idx)
+        return RspBatch(
+            status=self.status[idx], reason=self.reason[idx],
+            req_id=self.req_id[idx], found=self.found[idx],
+            has_uid=self.has_uid[idx], step=self.step[idx],
+            retry_after_us=self.retry_after_us[idx], uid=self.uid[idx],
+            value=None if self.value is None else self.value[idx],
+            vlen=None if self.vlen is None else self.vlen[idx],
+            voff=None if self.voff is None else self.voff[idx],
+            blob=self.blob)
+
+    def to_responses(self) -> List[Response]:
+        """Struct rows — mirrors decode_response's nulling rules (value
+        and data are only surfaced on S_OK)."""
+        out = []
+        for i in range(len(self)):
+            st = int(self.status[i])
+            value = data = None
+            if st == S_OK:
+                if self.value is not None:
+                    value = self.value[i].tolist()
+                data = self.row_data(i)
+            out.append(Response(
+                status=st, reason=int(self.reason[i]),
+                req_id=int(self.req_id[i]), found=bool(self.found[i]),
+                step=int(self.step[i]),
+                retry_after_us=int(self.retry_after_us[i]),
+                uid=((int(self.uid[i, 0]), int(self.uid[i, 1]))
+                     if self.has_uid[i] else None),
+                value=value, data=data))
+        return out
+
+    @staticmethod
+    def from_responses(rsps: List[Response], u: int,
+                       vbytes: int = 0) -> "RspBatch":
+        k = len(rsps)
+        b = RspBatch(
+            status=np.array([r.status for r in rsps], np.uint8),
+            reason=np.array([r.reason for r in rsps], np.uint8),
+            req_id=np.array([r.req_id for r in rsps], np.uint32),
+            found=np.array([r.found for r in rsps], bool),
+            has_uid=np.array([r.uid is not None for r in rsps], bool),
+            step=np.array([r.step for r in rsps], np.int32),
+            retry_after_us=np.array([r.retry_after_us for r in rsps],
+                                    np.uint32),
+            uid=np.array([(r.uid if r.uid is not None else (0, 0))
+                          for r in rsps], np.int32).reshape(k, 2))
+        if vbytes:
+            vlen = np.full(k, -1, np.int64)
+            voff = np.zeros(k, np.int64)
+            parts = []
+            off = 0
+            for i, r in enumerate(rsps):
+                if r.data is not None and r.status == S_OK:
+                    raw = bytes(r.data)
+                    vlen[i] = len(raw)
+                    voff[i] = off
+                    parts.append(raw)
+                    off += len(raw)
+            b.vlen, b.voff, b.blob = vlen, voff, b"".join(parts)
+        else:
+            val = np.zeros((k, u), np.int32)
+            for i, r in enumerate(rsps):
+                if r.value is not None:
+                    v = np.asarray(list(r.value), np.int32)
+                    val[i, : v.shape[0]] = v
+            b.value = val
+        return b
+
+
+def encode_response_batch(b: RspBatch, u: int, vbytes: int = 0) -> bytes:
+    """k responses -> one record stream, byte-identical to concatenating
+    ``encode_response`` over the rows."""
+    k = len(b)
+    status = np.asarray(b.status, np.uint8)
+
+    def heads(width: int) -> np.ndarray:
+        M = np.zeros((k, width), np.uint8)
+        _put_col(M, 0, np.full(k, RSP_MAGIC), "<u2")
+        M[:, 2] = status
+        M[:, 3] = np.asarray(b.reason, np.uint8)
+        _put_col(M, 4, b.req_id, "<u4")
+        M[:, 8] = np.asarray(b.found, bool).astype(np.uint8)
+        M[:, 9] = np.asarray(b.has_uid, bool).astype(np.uint8)
+        _put_col(M, 12, b.step, "<i4")
+        _put_col(M, 16, b.retry_after_us, "<u4")
+        _put_col(M, 20, np.asarray(b.uid, np.int32)[:, 0], "<i4")
+        _put_col(M, 24, np.asarray(b.uid, np.int32)[:, 1], "<i4")
+        return M
+
+    if vbytes:
+        vlen = (np.asarray(b.vlen, np.int64) if b.vlen is not None
+                else np.full(k, -1, np.int64))
+        # the struct codec's rule: only S_OK rows carry a payload tail
+        vlen = np.where(status == S_OK, vlen, -1)
+        if k and int(vlen.max(initial=-1)) > vbytes:
+            raise ValueError(f"payload is {int(vlen.max())} bytes > "
+                             f"max_value_bytes={vbytes}")
+        plen = np.maximum(vlen, 0)
+        recs = _RSP.size + 4 + plen
+        offs = np.concatenate(([0], np.cumsum(recs)[:-1])) if k \
+            else np.zeros(0, np.int64)
+        out = np.zeros(int(recs.sum()), np.uint8)
+        H = heads(_RSP.size + 4)
+        _put_col(H, _RSP.size,
+                 np.where(vlen < 0, _DLEN_NONE, vlen).astype(np.uint32),
+                 "<u4")
+        _codec.scatter_records(out, offs, H)
+        voff = (np.asarray(b.voff, np.int64) if b.voff is not None
+                else np.zeros(k, np.int64))
+        src = _codec.ragged_gather(np.frombuffer(b.blob, np.uint8),
+                                   voff, plen)
+        _codec.ragged_scatter(out, offs + _RSP.size + 4, plen, src)
+        return out.tobytes()
+    M = heads(rsp_nbytes(u))
+    val = b.value if b.value is not None else np.zeros((k, u), np.int32)
+    val = np.asarray(val, np.int32)
+    if val.shape != (k, u):
+        raise ValueError(f"value matrix shape {val.shape} != ({k}, {u})")
+    if u:
+        M[:, _RSP.size:] = np.ascontiguousarray(val).view(
+            np.uint8).reshape(k, 4 * u)
+    return M.tobytes()
+
+
+def decode_response_batch(buf: bytes, u: int, vbytes: int = 0) -> RspBatch:
+    """Inverse of ``encode_response_batch`` (torn/garbage triage rules
+    match the struct decoder, batch-wide)."""
+    buf = bytes(buf)
+    if vbytes:
+        offs, dls = [], []
+        off, hsz = 0, _RSP.size
+        while off < len(buf):
+            if off + hsz + 4 > len(buf):
+                raise ValueError(
+                    f"torn batch: truncated response header at byte {off} "
+                    f"({len(buf) - off} trailing bytes)")
+            (dlen,) = struct.unpack_from("<I", buf, off + hsz)
+            if dlen == _DLEN_NONE:
+                dls.append(-1)
+                end = off + hsz + 4
+            else:
+                if dlen > vbytes:
+                    raise ValueError(f"payload tail declares {dlen} bytes "
+                                     f"(max {vbytes})")
+                if off + hsz + 4 + dlen > len(buf):
+                    raise ValueError(
+                        f"torn batch: payload tail at byte {off} wants "
+                        f"{dlen} bytes, have {len(buf) - off - hsz - 4}")
+                dls.append(dlen)
+                end = off + hsz + 4 + dlen
+            offs.append(off)
+            off = end
+        k = len(offs)
+        offs_a = np.asarray(offs, np.int64)
+        M = _codec.gather_records(np.frombuffer(buf, np.uint8), offs_a,
+                                  hsz + 4)
+        b = _decode_rsp_heads(M)
+        b.vlen = np.asarray(dls, np.int64)
+        b.voff = offs_a + hsz + 4
+        b.blob = buf
+        return b
+    stride = rsp_nbytes(u)
+    if len(buf) % stride:
+        raise ValueError(
+            f"torn batch: {len(buf)} bytes is not a whole number of "
+            f"{stride}-byte responses ({len(buf) % stride} trailing bytes)")
+    k = len(buf) // stride
+    M = np.frombuffer(buf, np.uint8).reshape(k, stride)
+    b = _decode_rsp_heads(M)
+    b.value = np.ascontiguousarray(M[:, _RSP.size:]).view(
+        np.int32).reshape(k, u)
+    return b
+
+
+def _decode_rsp_heads(M: np.ndarray) -> RspBatch:
+    k = M.shape[0]
+    magic = _get_col(M, 0, "<u2")
+    if k and (magic != RSP_MAGIC).any():
+        i = int(np.nonzero(magic != RSP_MAGIC)[0][0])
+        raise ValueError(f"bad response magic 0x{int(magic[i]):04x} "
+                         f"at row {i}")
+    uid = np.stack([_get_col(M, 20, "<i4"), _get_col(M, 24, "<i4")],
+                   axis=1) if k else np.zeros((0, 2), np.int32)
+    return RspBatch(
+        status=M[:, 2].copy(), reason=M[:, 3].copy(),
+        req_id=_get_col(M, 4, "<u4"), found=M[:, 8] != 0,
+        has_uid=M[:, 9] != 0, step=_get_col(M, 12, "<i4"),
+        retry_after_us=_get_col(M, 16, "<u4"), uid=uid)
+
+
 # -- kind/magic dispatch (one decoder entry per direction) -------------------
 
 def encode_any_request(req, u: int, vbytes: int = 0) -> bytes:

@@ -202,3 +202,67 @@ def words_to_bytes(words, length=None) -> bytes:
     if length > len(raw):
         raise ValueError(f"length {length} exceeds the {len(raw)}-byte span")
     return raw[:length]
+
+
+# --------------------------------------------------------------------------
+# Columnar record primitives (round-19).
+#
+# The columnar wire codec (serving/wire.py batch functions) decodes a whole
+# drained socket buffer in one numpy pass.  Fixed-stride record streams are
+# a single reshape; heap-mode streams have variable strides, so the codec
+# needs two primitives: gather K fixed-size headers at arbitrary byte
+# offsets into a (K, H) matrix, and move ragged payload extents between a
+# record stream and one contiguous blob.  Both are pure fancy-index passes
+# over uint8 views — no per-row Python, the rows_to_words discipline
+# applied to record streams.
+# --------------------------------------------------------------------------
+
+
+def _ragged_index(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Flat byte indices addressing ``lens[i]`` consecutive bytes from each
+    ``starts[i]`` — the one index pattern behind ragged gather/scatter.
+    Length-0 rows contribute nothing (np.repeat drops them)."""
+    starts = np.asarray(starts, np.int64)
+    lens = np.asarray(lens, np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return np.zeros(0, np.int64)
+    # position-within-row = global arange minus each row's exclusive cumsum
+    excl = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    return (np.repeat(starts, lens)
+            + (np.arange(total, dtype=np.int64) - np.repeat(excl, lens)))
+
+
+def gather_records(buf8: np.ndarray, offs: np.ndarray, nbytes: int) -> np.ndarray:
+    """(K, nbytes) uint8 matrix of the fixed-size record heads at byte
+    offsets ``offs`` in ``buf8`` — the variable-stride decode primitive
+    (a fixed-stride stream is just ``buf8.reshape(k, stride)``)."""
+    offs = np.asarray(offs, np.int64)
+    if offs.size == 0:
+        return np.zeros((0, nbytes), np.uint8)
+    return buf8[offs[:, None] + np.arange(nbytes, dtype=np.int64)]
+
+
+def scatter_records(out8: np.ndarray, offs: np.ndarray,
+                    mat: np.ndarray) -> None:
+    """Inverse of ``gather_records``: write each row of ``mat`` at its
+    record's byte offset in ``out8`` (in place)."""
+    offs = np.asarray(offs, np.int64)
+    if offs.size == 0:
+        return
+    out8[offs[:, None] + np.arange(mat.shape[1], dtype=np.int64)] = mat
+
+
+def ragged_gather(buf8: np.ndarray, starts: np.ndarray,
+                  lens: np.ndarray) -> np.ndarray:
+    """Concatenate the ragged extents ``buf8[starts[i]:starts[i]+lens[i]]``
+    into one contiguous uint8 blob (one fancy-index pass)."""
+    return buf8[_ragged_index(starts, lens)]
+
+
+def ragged_scatter(out8: np.ndarray, starts: np.ndarray, lens: np.ndarray,
+                   blob8: np.ndarray) -> None:
+    """Inverse of ``ragged_gather``: scatter a contiguous blob back out to
+    ragged extents at ``starts`` (in place)."""
+    idx = _ragged_index(starts, lens)
+    out8[idx] = blob8[:idx.size]

@@ -39,6 +39,34 @@ def _ensure_built(force: bool = False) -> pathlib.Path:
     return _SO
 
 
+def serving_listener(host: str, port: int, reuseport: bool = False,
+                     backlog: int = 128):
+    """Bound+listening TCP socket for the serving RPC servers
+    (round-19).  ``reuseport=True`` sets SO_REUSEPORT before bind so N
+    worker processes can shard accepts on ONE port — the kernel
+    load-balances incoming connections across the listeners.  Raises
+    loudly where the platform has no SO_REUSEPORT rather than silently
+    falling back to a single-listener bind (the second worker would
+    EADDRINUSE anyway, later and more confusingly)."""
+    import socket as _socket
+
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    try:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        if reuseport:
+            if not hasattr(_socket, "SO_REUSEPORT"):
+                raise RuntimeError(
+                    "accept sharding needs SO_REUSEPORT, which this "
+                    "platform's socket module does not expose")
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+        sock.bind((host, port))
+        sock.listen(backlog)
+    except BaseException:
+        sock.close()
+        raise
+    return sock
+
+
 class FramedSocket:
     """Checksummed-frame message boundary over one stream socket
     (round-14, the serving RPC path).  Every message crosses as a

@@ -32,7 +32,14 @@ scripts/run_gates.py — gates run SERIALLY, never beside pytest):
      keep the checker green with ``stale_read == []`` (local reads
      verified against the write history), and rung 2 must keep ALL-hot
      batched reads serving while a batch carrying one cold key (and any
-     scan) sheds R_SHED_READ.
+     scan) sheds R_SHED_READ;
+  6. round-19 columnar plane — the columnar soak satisfies the same
+     envelope (loud, checker green, committed_write_lost == [],
+     byte-identical replay) AND the loopback columnar path sustains the
+     serving-throughput FLOOR: >= 50x the PR-10 scalar closed-loop
+     baseline cell recorded in BENCH_LATENCY.json, cell-vs-cell on this
+     host (the floor cell is carried into GATES_SUMMARY.json by
+     run_gates.py).
 
     env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python scripts/check_serving.py
@@ -365,6 +372,89 @@ def check_read_soak(report: dict) -> None:
                                 scan_shed=True)
 
 
+def check_columnar(report: dict) -> None:
+    """Round-19 columnar leg: (a) the columnar soak at >= 2x capacity
+    satisfies the same envelope — every request loud, checker green,
+    committed_write_lost == [], replay byte-identical; (b) the
+    serving-throughput FLOOR — the loopback columnar path must sustain
+    >= 50x the PR-10 scalar closed-loop baseline cell recorded in
+    BENCH_LATENCY.json on this host (cell-vs-cell)."""
+    from hermes_tpu.serving import measure_capacity
+    from hermes_tpu.serving.soak import (measure_columnar_floor,
+                                         run_columnar_soak)
+    from hermes_tpu.workload.openloop import MixSpec
+
+    spec = MixSpec(name="uniform", tenants=4)
+    cap = measure_capacity(_store("batched", record=False), _scfg(), spec,
+                           n=240, seed=SEED)
+    rate = 2.0 * cap["ops_per_vs"]
+    shas = []
+    for rep in range(2):
+        store = _store("batched")
+        res = run_columnar_soak(store, _scfg(), spec, rate_per_s=rate,
+                                n=500, seed=SEED, deadline_us=DEADLINE_US)
+        if rep == 0:
+            # the columnar plane drains the same 2x-capacity offered
+            # load fast enough that nothing lingers past the deadline —
+            # shed must still engage (refusals loud); the deadline
+            # machinery gets its own constrained-store leg below
+            _assert_envelope(res, "columnar_soak", report)
+            _check_history(store, res)
+            report["columnar_soak"]["capacity_probe"] = cap
+            report["columnar_soak"]["rate_per_vs"] = rate
+        shas.append(res["response_log_sha"])
+    assert shas[0] == shas[1], (
+        f"columnar: same seed replayed to a DIFFERENT response log "
+        f"({shas})")
+    report["columnar_replay_identical"] = True
+
+    # columnar DEADLINE enforcement: throttle the store to one op in
+    # flight so intake backs up past the deadline — expiries must fire
+    # (intake-side S_DEADLINE) while the rest commit, envelope intact
+    res = run_columnar_soak(
+        _store("batched", record=False),
+        _scfg(store_inflight_cap=1, tenant_quota=64, queue_cap=256),
+        spec, rate_per_s=rate, n=300, seed=SEED,
+        deadline_us=DEADLINE_US)
+    _assert_envelope(res, "columnar_deadline_soak", report,
+                     require_shed=False, require_deadline=True)
+
+    # (b) the throughput floor.  The bar is PINNED to the PR-10 scalar
+    # closed-loop cell (the ~350 ops/s figure the round-19 gap was
+    # measured against, as recorded in BENCH_LATENCY.json before this
+    # round).  It is deliberately NOT re-read from the live artifact:
+    # the round-19 pump-lock fix sped the scalar path itself ~10x, and
+    # re-basing the 50x floor on the improved scalar cell would turn a
+    # fixed acceptance bar into a moving target.  The live scalar cell
+    # is still read and reported beside the pinned one for honesty.
+    baseline = 351.8  # PR-10 scalar closed-loop cell (pinned)
+    baseline_src = "pr10_recorded_cell"
+    current_scalar = None
+    bench_path = os.path.join(os.path.dirname(__file__), "..",
+                              "BENCH_LATENCY.json")
+    if os.path.exists(bench_path):
+        with open(bench_path) as f:
+            cells = json.load(f).get("cells", {})
+        cell = cells.get("throughput", {})
+        if cell.get("ops_per_sec") and not cell.get("error"):
+            current_scalar = float(cell["ops_per_sec"])
+    floor = 50.0 * baseline
+    fl = measure_columnar_floor()
+    assert fl["retried"] == 0 or fl["retried"] < fl["ops"], fl
+    assert fl["ops_per_sec"] >= floor, (
+        f"columnar floor MISSED: {fl['ops_per_sec']} ops/s < 50x scalar "
+        f"baseline {baseline} ({floor:.0f}) [{baseline_src}] — {fl}")
+    report["columnar_floor"] = dict(
+        **fl, scalar_baseline_ops_per_sec=baseline,
+        baseline_source=baseline_src, required_ops_per_sec=round(floor, 1),
+        speedup_vs_scalar=round(fl["ops_per_sec"] / baseline, 1))
+    if current_scalar is not None:
+        report["columnar_floor"]["current_scalar_ops_per_sec"] = (
+            current_scalar)
+        report["columnar_floor"]["speedup_vs_current_scalar"] = round(
+            fl["ops_per_sec"] / current_scalar, 1)
+
+
 def main() -> int:
     report: dict = {"gate": "serving"}
     try:
@@ -372,6 +462,7 @@ def main() -> int:
         check_fleet(report)
         check_overload_storm(report)
         check_read_soak(report)
+        check_columnar(report)
     except AssertionError as e:
         report["ok"] = False
         report["error"] = str(e)

@@ -171,6 +171,20 @@ def main() -> int:
               file=sys.stderr, flush=True)
         results.append(r)
 
+    def _gate_cells(r: dict) -> dict:
+        # round-19: the serving gate's columnar-floor cell is a tracked
+        # perf number — carry it into the summary's gates block so a
+        # regression is visible without digging into the full report
+        if r["gate"] != "serving" or not isinstance(r.get("report"), dict):
+            return {}
+        cell = r["report"].get("columnar_floor")
+        if not isinstance(cell, dict):
+            return {}
+        keep = ("ops_per_sec", "required_ops_per_sec",
+                "scalar_baseline_ops_per_sec", "speedup_vs_scalar",
+                "current_scalar_ops_per_sec", "speedup_vs_current_scalar")
+        return {"columnar_floor": {k: cell[k] for k in keep if k in cell}}
+
     summary = dict(
         ok=all(r["ok"] for r in results),
         gates={r["gate"]: dict(ok=r["ok"], seconds=r["seconds"],
@@ -178,7 +192,8 @@ def main() -> int:
                                   else {}),
                                **({"flight_dumps": r["flight_dumps"]}
                                   if not r["ok"] and r.get("flight_dumps")
-                                  else {}))
+                                  else {}),
+                               **_gate_cells(r))
                for r in results},
         total_seconds=round(sum(r["seconds"] for r in results), 2),
         results=results,

@@ -1,0 +1,486 @@
+"""Round-19 columnar serving data plane: the batch codec against its
+per-struct oracle (both directions, both payload modes, adversarial
+edges), batch admission vs the scalar ladder (state-exact), the
+completion-ring frontend's envelope (validity refusals, deadlines, ring
+exhaustion), loopback byte-log walkability, the columnar TCP server,
+and SO_REUSEPORT accept sharding."""
+
+import dataclasses
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import HermesConfig, WorkloadConfig
+from hermes_tpu.kvs import KVS
+from hermes_tpu.serving import (ColumnarClient, ColumnarFrontend,
+                                ColumnarLoopback, ColumnarTcpServer,
+                                ServingConfig, VirtualClock,
+                                verify_columnar, wire)
+from hermes_tpu.serving.admission import AdmissionControl
+from hermes_tpu.serving.server import CompletionRing
+from hermes_tpu.serving.soak import committed_uids, run_columnar_soak
+from hermes_tpu.workload.openloop import MixSpec
+
+
+def _cfg(**over):
+    kw = dict(n_replicas=3, n_keys=64, n_sessions=4, replay_slots=6,
+              ops_per_session=96, value_words=6, pipeline_depth=2,
+              workload=WorkloadConfig(read_frac=0.5, seed=7))
+    kw.update(over)
+    return HermesConfig(**kw)
+
+
+def _scfg(**over):
+    kw = dict(tenant_rate_per_s=1e6, tenant_burst=1e4, tenant_quota=16,
+              queue_cap=64, round_us=1000)
+    kw.update(over)
+    return ServingConfig(**kw)
+
+
+# -- batch codec vs the per-struct oracle ------------------------------------
+
+
+def _random_requests(rng, k, u, vbytes=0, traced=False):
+    out = []
+    for i in range(k):
+        kind = ("get", "put", "rmw")[int(rng.integers(3))]
+        r = wire.Request(
+            kind=kind, req_id=int(rng.integers(1 << 32)),
+            tenant=int(rng.integers(1 << 16)),
+            key=int(rng.integers(-(1 << 40), 1 << 40)),
+            deadline_us=int(rng.integers(1 << 32)),
+            trace=int(rng.integers(1, 1 << 16)) if traced
+            and rng.random() < 0.5 else 0)
+        if vbytes:
+            # adversarial payloads: absent, zero-length, max-length, and
+            # high-bit bytes that would tear a sign-careless decoder
+            roll = rng.random()
+            if kind != "get" and roll < 0.75:
+                n = (0 if roll < 0.15 else
+                     vbytes if roll < 0.3 else int(rng.integers(vbytes + 1)))
+                r.data = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        elif kind != "get":
+            r.value = rng.integers(-(1 << 31), 1 << 31,
+                                   int(rng.integers(u + 1))).tolist()
+        out.append(r)
+    return out
+
+
+def _random_responses(rng, k, u, vbytes=0):
+    out = []
+    statuses = (wire.S_OK, wire.S_RMW_ABORT, wire.S_REJECTED,
+                wire.S_RETRY_AFTER, wire.S_DEADLINE, wire.S_LOST)
+    for i in range(k):
+        st = int(statuses[int(rng.integers(len(statuses)))])
+        r = wire.Response(
+            status=st, req_id=int(rng.integers(1 << 32)),
+            reason=int(rng.integers(6)), found=bool(rng.integers(2)),
+            step=int(rng.integers(-1, 1 << 31)),
+            retry_after_us=int(rng.integers(1 << 32)),
+            uid=((int(rng.integers(-(1 << 31), 1 << 31)),
+                  int(rng.integers(-(1 << 31), 1 << 31)))
+                 if rng.random() < 0.5 else None))
+        if vbytes:
+            if st == wire.S_OK and rng.random() < 0.75:
+                n = int(rng.integers(vbytes + 1))
+                r.data = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        elif rng.random() < 0.75:
+            r.value = rng.integers(-(1 << 31), 1 << 31, u).tolist()
+        out.append(r)
+    return out
+
+
+@pytest.mark.parametrize("vbytes", [0, 24])
+def test_req_batch_codec_byte_identical_to_struct_oracle(vbytes):
+    u, rng = 3, np.random.default_rng(19)
+    for k in (0, 1, 7, 257):
+        reqs = _random_requests(rng, k, u, vbytes, traced=True)
+        oracle = b"".join(wire.encode_request(r, u, vbytes) for r in reqs)
+        b = wire.ReqBatch.from_requests(reqs, u, vbytes)
+        assert wire.encode_request_batch(b, u, vbytes) == oracle
+        # decode inverts: row structs match what the struct decoder sees
+        back = wire.decode_request_batch(oracle, u, vbytes).to_requests()
+        off = 0
+        for r in back:
+            step = len(wire.encode_request(r, u, vbytes))
+            assert r == wire.decode_request(oracle[off: off + step],
+                                            u, vbytes)
+            off += step
+        assert off == len(oracle)
+
+
+@pytest.mark.parametrize("vbytes", [0, 24])
+def test_rsp_batch_codec_byte_identical_to_struct_oracle(vbytes):
+    u, rng = 3, np.random.default_rng(23)
+    for k in (0, 1, 7, 257):
+        rsps = _random_responses(rng, k, u, vbytes)
+        oracle = b"".join(wire.encode_response(r, u, vbytes) for r in rsps)
+        b = wire.RspBatch.from_responses(rsps, u, vbytes)
+        assert wire.encode_response_batch(b, u, vbytes) == oracle
+        back = wire.decode_response_batch(oracle, u, vbytes).to_responses()
+        off = 0
+        for r in back:
+            step = wire.response_extent(oracle, off, u, vbytes)
+            assert r == wire.decode_response(oracle[off: off + step],
+                                             u, vbytes)
+            off += step
+        assert off == len(oracle)
+
+
+def test_batch_codec_torn_and_garbage_are_loud():
+    u = 2
+    reqs = _random_requests(np.random.default_rng(5), 4, u)
+    raw = wire.encode_request_batch(wire.ReqBatch.from_requests(reqs, u), u)
+    with pytest.raises(ValueError, match="torn batch"):
+        wire.decode_request_batch(raw[:-3], u)
+    bad = bytearray(raw)
+    bad[wire.req_nbytes(u)] ^= 0xFF  # second record's magic
+    with pytest.raises(ValueError, match="magic"):
+        wire.decode_request_batch(bytes(bad), u)
+    bad = bytearray(raw)
+    bad[wire.req_nbytes(u) + 2] = 77  # second record's kind
+    with pytest.raises(ValueError, match="kind"):
+        wire.decode_request_batch(bytes(bad), u)
+    # heap mode: truncated header, torn tail, oversize length prefix
+    vb = 16
+    hreqs = _random_requests(np.random.default_rng(6), 4, u, vb)
+    hraw = wire.encode_request_batch(
+        wire.ReqBatch.from_requests(hreqs, u, vb), u, vb)
+    with pytest.raises(ValueError, match="torn batch"):
+        wire.decode_request_batch(hraw[:-1], u, vb)
+    one = wire.encode_request(wire.Request(
+        kind="put", req_id=1, tenant=0, key=0, data=b"abcd"), u, vb)
+    huge = bytearray(one)
+    huge[-8:-4] = (vb + 1).to_bytes(4, "little")  # dlen > vbytes
+    with pytest.raises(ValueError, match="payload tail"):
+        wire.decode_request_batch(bytes(huge), u, vb)
+    # responses share the triage rules
+    rsps = _random_responses(np.random.default_rng(7), 3, u)
+    rraw = wire.encode_response_batch(
+        wire.RspBatch.from_responses(rsps, u), u)
+    with pytest.raises(ValueError, match="torn batch"):
+        wire.decode_response_batch(rraw[:-2], u)
+    rbad = bytearray(rraw)
+    rbad[0] ^= 0xFF
+    with pytest.raises(ValueError, match="magic"):
+        wire.decode_response_batch(bytes(rbad), u)
+
+
+def test_batch_codec_refuses_oversize_payloads_on_encode():
+    u, vb = 2, 8
+    b = wire.ReqBatch.from_requests([wire.Request(
+        kind="put", req_id=1, tenant=0, key=0, data=b"x" * vb)], u, vb)
+    b.vlen = np.array([vb + 1], np.int64)  # lie about the extent
+    with pytest.raises(ValueError, match="max_value_bytes"):
+        wire.encode_request_batch(b, u, vb)
+    with pytest.raises(ValueError, match="int32 words"):
+        wire.ReqBatch.from_requests([wire.Request(
+            kind="put", req_id=1, tenant=0, key=0,
+            value=list(range(u + 1)))], u)
+
+
+# -- batch admission vs the scalar ladder ------------------------------------
+
+
+def test_admit_batch_state_exact_vs_scalar_ladder():
+    """The fuzz contract as a regression test: over random batches the
+    batch ladder must return the same reasons and hints AND leave the
+    same tenant counters and bucket floats as the scalar loop."""
+    rng = np.random.default_rng(41)
+    scfg = _scfg(tenant_quota=5, queue_cap=12, tenant_rate_per_s=50.0,
+                 tenant_burst=6, shed_write_frac=0.5, shed_read_frac=0.8,
+                 hot_keys=(3, 9))
+    a, b = AdmissionControl(scfg), AdmissionControl(scfg)
+    now, q_a, q_b = 0.0, 0, 0
+    for trial in range(40):
+        k = int(rng.integers(0, 9))
+        writes = rng.integers(2, size=k).astype(bool)
+        keys = rng.integers(0, 16, k).astype(np.int64)
+        tenants = rng.integers(0, 3, k)
+        degraded = bool(rng.random() < 0.15)
+        now += float(rng.random() * 0.1)
+        exp_r, exp_w = [], []
+        for i in range(k):
+            rsn, wt = a.admit("put" if writes[i] else "get", int(keys[i]),
+                              int(tenants[i]), now, q_a, degraded)
+            if rsn == wire.R_NONE:
+                a.note_admitted(int(tenants[i]))
+                q_a += 1
+            exp_r.append(rsn), exp_w.append(wt)
+        got_r, got_w = b.admit_batch(writes, keys, tenants, now, q_b,
+                                     degraded)
+        q_b += int((got_r == wire.R_NONE).sum())
+        assert got_r.tolist() == exp_r, f"trial {trial}"
+        assert np.allclose(got_w, exp_w), f"trial {trial}"
+        assert q_a == q_b
+        assert a.counters() == b.counters()
+        for t in a.tenants:
+            ba, bb = a.tenants[t].bucket, b.tenants[t].bucket
+            assert (ba.tokens, ba._t_last) == (bb.tokens, bb._t_last)
+        # drain some inflight so later trials see fresh quota room
+        for t, ts in a.tenants.items():
+            drop = int(rng.integers(0, ts.inflight + 1))
+            for _ in range(drop):
+                a.note_resolved(t, wire.S_OK)
+            if drop:
+                b.note_resolved_batch(np.full(drop, t),
+                                      np.full(drop, wire.S_OK))
+            q_a, q_b = q_a - drop, q_b - drop
+
+
+# -- completion ring + columnar frontend envelope ----------------------------
+
+
+def _batch(kind, keys, req_id0=1, tenant=0, u=4, deadline_us=0, value=None):
+    k = len(keys)
+    return wire.ReqBatch(
+        kind=np.asarray(kind, np.uint8),
+        req_id=np.arange(req_id0, req_id0 + k, dtype=np.uint32),
+        tenant=np.full(k, tenant, np.uint16),
+        trace=np.zeros(k, np.uint16),
+        deadline_us=np.full(k, deadline_us, np.uint32),
+        key=np.asarray(keys, np.int64),
+        value=(np.asarray(value, np.int32) if value is not None
+               else np.zeros((k, u), np.int32)))
+
+
+def test_completion_ring_exhaustion_is_loud_and_release_reuses():
+    ring = CompletionRing(cap=4, u=2, vbytes=0)
+    first = ring.alloc(ring.cap)
+    assert ring.in_use() == ring.cap
+    with pytest.raises(RuntimeError, match="accounting bug"):
+        ring.alloc(1)
+    ring.release(first[:3])
+    again = ring.alloc(3)
+    assert set(again.tolist()) == set(first[:3].tolist())
+    assert (ring.status[again] == 0xFF).all()  # slots come back open
+
+
+def test_columnar_validity_refusals_are_rejected_rows():
+    fe = ColumnarFrontend(KVS(_cfg()), _scfg(), clock=VirtualClock())
+    b = _batch([wire.K_PUT, 9, wire.K_GET], [1, 2, 10_000])
+    out = fe.submit_batch(b)
+    # rows 1 (unknown kind) and 2 (key out of range) refuse immediately,
+    # definitively (S_REJECTED, not retry_after) and in batch row order
+    assert out.req_id.tolist() == [2, 3]
+    assert out.status.tolist() == [wire.S_REJECTED] * 2
+    assert fe.drain()[0]
+    tot = verify_columnar(fe)
+    assert tot["completed"] == 1 and tot["rejected"] == 0  # store-level ctr
+
+
+def test_columnar_deadline_enforced_at_intake_backlog():
+    clock = VirtualClock()
+    fe = ColumnarFrontend(KVS(_cfg()), _scfg(store_inflight_cap=1,
+                                             queue_cap=32),
+                          clock=clock)
+    out = fe.submit_batch(_batch([wire.K_PUT] * 8, list(range(8)),
+                                 deadline_us=1500))
+    assert len(out) == 0  # all admitted
+    emitted = []
+    for _ in range(200):
+        if fe.idle():
+            break
+        emitted.append(fe.pump())
+        clock.advance(0.001)  # one serving round per pump
+    st = np.concatenate([rb.status for d in emitted for rb in d.values()])
+    names = [wire.STATUS_NAMES[int(s)] for s in st]
+    # the cap-1 store serves a trickle; the backlog expires loudly
+    assert names.count("deadline") >= 4
+    assert set(names) <= {"ok", "deadline"}
+    verify_columnar(fe)
+    assert fe.ring.in_use() == 0
+
+
+def test_columnar_quota_refusal_carries_retry_hint():
+    fe = ColumnarFrontend(KVS(_cfg()), _scfg(tenant_quota=3),
+                          clock=VirtualClock())
+    out = fe.submit_batch(_batch([wire.K_PUT] * 6, list(range(6))))
+    assert out.status.tolist() == [wire.S_RETRY_AFTER] * 3
+    assert out.reason.tolist() == [wire.R_QUOTA] * 3
+    assert (out.retry_after_us > 0).all()
+    assert fe.drain()[0]
+    verify_columnar(fe)
+
+
+def test_columnar_heap_payload_roundtrip():
+    fe = ColumnarFrontend(KVS(_cfg(max_value_bytes=32)), _scfg(),
+                          clock=VirtualClock())
+    payload = bytes(range(7))
+    put = wire.ReqBatch(
+        kind=np.array([wire.K_PUT], np.uint8),
+        req_id=np.array([1], np.uint32), tenant=np.zeros(1, np.uint16),
+        trace=np.zeros(1, np.uint16), deadline_us=np.zeros(1, np.uint32),
+        key=np.array([5], np.int64), vlen=np.array([len(payload)], np.int64),
+        voff=np.zeros(1, np.int64), blob=payload)
+    assert len(fe.submit_batch(put)) == 0
+    assert fe.drain()[0]
+    get = dataclasses.replace(put, kind=np.array([wire.K_GET], np.uint8),
+                              req_id=np.array([2], np.uint32),
+                              vlen=np.array([-1], np.int64), blob=b"")
+    assert len(fe.submit_batch(get)) == 0
+    _, emitted = fe.drain()
+    got = [rb for d in emitted for rb in d.values()
+           if 2 in rb.req_id.tolist()]
+    assert got and got[-1].row_data(got[-1].req_id.tolist().index(2)) \
+        == payload
+    verify_columnar(fe)
+
+
+def test_columnar_frontend_refuses_fleet_stores():
+    from hermes_tpu.config import FleetConfig
+    from hermes_tpu.fleet import Fleet
+
+    fleet = Fleet(FleetConfig(groups=2, base=_cfg()))
+    with pytest.raises(ValueError, match="single KVS"):
+        ColumnarFrontend(fleet, _scfg())
+
+
+# -- loopback byte log + soak ------------------------------------------------
+
+
+def test_columnar_loopback_log_walkable_and_soak_replays():
+    shas, logs = [], []
+    for _ in range(2):
+        res = run_columnar_soak(KVS(_cfg()), _scfg(tenant_quota=8),
+                                MixSpec(tenants=2, read_frac=0.4),
+                                rate_per_s=4000.0, n=120, seed=11,
+                                deadline_us=50_000)
+        shas.append(res["response_log_sha"])
+        logs.append((res["_frontend"], res["_server"]))
+    assert shas[0] == shas[1]  # byte-identical replay
+    fe, lb = logs[0]
+    uids = committed_uids(fe, lb)  # the struct walker, record by record
+    # second decoder over the SAME bytes: the whole log is one fixed-
+    # width columnar batch — both decoders must agree on the uids
+    rb = wire.decode_response_batch(lb.response_log(), lb.u)
+    ok_uid = (rb.status == wire.S_OK) & rb.has_uid
+    assert uids == [tuple(row) for row in rb.uid[ok_uid].tolist()]
+    assert uids  # the soak committed writes
+    assert sum(res["statuses"].values()) == 120
+
+
+def test_columnar_soak_refuses_heap_stores():
+    with pytest.raises(ValueError, match="fixed-width"):
+        run_columnar_soak(KVS(_cfg(max_value_bytes=16)), _scfg(),
+                          MixSpec(), rate_per_s=100.0, n=4, seed=1,
+                          deadline_us=0)
+
+
+# -- columnar TCP + accept sharding ------------------------------------------
+
+
+def test_columnar_tcp_server_end_to_end():
+    fe = ColumnarFrontend(KVS(_cfg()), _scfg())
+    server = ColumnarTcpServer(fe)
+    try:
+        cl = ColumnarClient(server.addr, fe.u)
+        val = np.arange(4 * fe.u, dtype=np.int32).reshape(4, fe.u)
+        puts = _batch([wire.K_PUT] * 4, [1, 2, 3, 4], u=fe.u,
+                      req_id0=int(cl.next_ids(4)[0]), value=val)
+        for rsp in cl.call_batch(puts).values():
+            assert rsp.status_name == "ok"
+        gets = _batch([wire.K_GET] * 4, [1, 2, 3, 4], u=fe.u,
+                      req_id0=int(cl.next_ids(4)[0]))
+        got = cl.call_batch(gets)
+        for i, rid in enumerate(gets.req_id.tolist()):
+            assert got[rid].status_name == "ok" and got[rid].found
+            assert got[rid].value == val[i].tolist()
+        cl.close()
+    finally:
+        server.close()
+    assert server.pump_error is None and server.undecodable == 0
+
+
+def test_columnar_tcp_undecodable_batch_tears_down_loudly():
+    fe = ColumnarFrontend(KVS(_cfg()), _scfg())
+    server = ColumnarTcpServer(fe)
+    try:
+        cl = ColumnarClient(server.addr, fe.u)
+        cl.fsock.send(b"\x00" * 10)  # frame-valid garbage
+        assert cl.recv_batch() is None  # loud EOF, not silence
+        cl.close()
+    finally:
+        server.close()
+    assert server.undecodable == 1
+
+
+def test_serving_listener_reuseport_gate():
+    from hermes_tpu.transport.tcp import serving_listener
+
+    a = serving_listener("127.0.0.1", 0, reuseport=True)
+    port = a.getsockname()[1]
+    b = serving_listener("127.0.0.1", port, reuseport=True)
+    a.close(), b.close()
+    plain = serving_listener("127.0.0.1", 0)
+    with pytest.raises(OSError):
+        serving_listener("127.0.0.1", plain.getsockname()[1])
+    plain.close()
+    if not hasattr(socket, "SO_REUSEPORT"):
+        with pytest.raises(RuntimeError, match="SO_REUSEPORT"):
+            serving_listener("127.0.0.1", 0, reuseport=True)
+
+
+def test_accept_sharding_in_process_under_concurrent_clients():
+    """Two reuseport servers (independent stores) on ONE port; eight
+    threaded clients land on whichever the kernel picks — every batch
+    must answer, and the pump/reader lock split must hold up under the
+    concurrency (the round-19 fairness satellite's regression)."""
+    servers = []
+    port = 0
+    try:
+        for _ in range(2):
+            fe = ColumnarFrontend(KVS(_cfg()), _scfg(tenant_quota=64))
+            s = ColumnarTcpServer(fe, port=port, reuseport=True)
+            port = s.addr[1]
+            servers.append(s)
+        errs, done = [], []
+
+        def client(i):
+            try:
+                cl = ColumnarClient(("127.0.0.1", port), servers[0].u)
+                b = _batch([wire.K_PUT] * 8, list(range(8)),
+                           u=servers[0].u, tenant=i,
+                           req_id0=int(cl.next_ids(8)[0]))
+                rsps = cl.call_batch(b)
+                assert len(rsps) == 8
+                assert all(r.status_name in ("ok", "retry_after")
+                           for r in rsps.values())
+                done.append(i)
+                cl.close()
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, repr(e)))
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60.0)
+        assert not errs and len(done) == 8
+    finally:
+        for s in servers:
+            s.close()
+    assert all(s.pump_error is None for s in servers)
+
+
+@pytest.mark.slow
+def test_sharded_worker_processes_serve_and_stop():
+    """Full accept-sharding topology: N spawned worker processes behind
+    one SO_REUSEPORT port (the launch.py --serve-workers path)."""
+    from hermes_tpu.launch import start_serve_workers
+
+    with start_serve_workers(2, cfg=_cfg(n_sessions=8)) as fleet:
+        assert fleet.alive() == 2
+        oks = 0
+        for w in range(3):
+            cl = ColumnarClient(fleet.addr, _cfg().value_words - 2)
+            b = _batch([wire.K_PUT] * 4, [w, w + 1, w + 2, w + 3],
+                       u=_cfg().value_words - 2, tenant=w,
+                       req_id0=int(cl.next_ids(4)[0]))
+            oks += sum(r.status_name == "ok"
+                       for r in cl.call_batch(b).values())
+            cl.close()
+        assert oks == 12
+    assert fleet.alive() == 0

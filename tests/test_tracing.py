@@ -241,6 +241,53 @@ def test_traced_soak_spans_identical_across_engines(cpu_devices):
     assert res_b["response_log_sha"] == res_s["response_log_sha"]
 
 
+def test_columnar_batch_codec_carries_trace_ids_byte_identically():
+    """Round-19: the trace id is a first-class COLUMN — nonzero u16 ids
+    survive the batch codec both directions, and a traced batch's bytes
+    are identical to the per-struct encode of the same rows (old peers
+    read traced columnar streams unchanged)."""
+    u = 3
+    reqs = [wire.Request(kind="put", req_id=1, tenant=0, key=2,
+                         value=[7], trace=777),
+            wire.Request(kind="get", req_id=2, tenant=1, key=3),
+            wire.Request(kind="rmw", req_id=3, tenant=2, key=4,
+                         value=[9], trace=0xFFFF)]
+    oracle = b"".join(wire.encode_request(r, u) for r in reqs)
+    b = wire.ReqBatch.from_requests(reqs, u)
+    assert b.trace.dtype == np.uint16
+    assert wire.encode_request_batch(b, u) == oracle
+    back = wire.decode_request_batch(oracle, u)
+    assert back.trace.tolist() == [777, 0, 0xFFFF]
+    assert [r.trace for r in back.to_requests()] == [777, 0, 0xFFFF]
+
+
+def test_traced_columnar_soak_replays_identically():
+    """The traced COLUMNAR soak: same determinism bar as the scalar
+    traced soak — byte-identical response log AND span stream across
+    two same-seed runs, with fe_resolve spans minted by the serving
+    sampler for rows whose wire trace arrived 0."""
+    from hermes_tpu.serving.soak import run_columnar_soak
+
+    def one():
+        kv = KVS(_cfg(trace_sample=8), backend="batched")
+        obs = kv.rt.attach_obs(Observability())
+        res = run_columnar_soak(
+            kv, ServingConfig(trace_sample=8, trace_seed=7,
+                              round_us=1000),
+            MixSpec(), rate_per_s=20000, n=80, seed=3,
+            deadline_us=200_000)
+        return canonical_span_bytes(obs.records), res
+
+    b1, res1 = one()
+    b2, res2 = one()
+    assert b1 and b1 == b2
+    assert res1["response_log_sha"] == res2["response_log_sha"]
+    lines = [json.loads(ln) for ln in b1.decode().strip().splitlines()]
+    fe = [r for r in lines if r["name"] == "fe_resolve"]
+    assert fe and all(r["trace"] for r in fe)
+    assert all({"tenant", "op", "key", "status"} <= set(r) for r in fe)
+
+
 # -- critical path (synthetic) -----------------------------------------------
 
 
