@@ -31,6 +31,14 @@ the same measure-then-gate pattern as the op census.  CLI:
 
     python -m hermes_tpu.analysis [--engine both] [--split-sort] ...
     python -m hermes_tpu.analysis --kernels   # standalone kernel matrix
+    python -m hermes_tpu.analysis --host      # host concurrency lint
+
+Since ISSUE 18 the package also covers the HOST side of the round: a
+static lock-discipline lint proving the threaded serving/transport tier
+against the declarative guard registry (analysis/hostlint.py over
+hermes_tpu/concurrency.py) and a dynamic lock-order sanitizer
+(analysis/lockgraph.py: ObsLock + held-before graph), gated serially by
+scripts/check_hostlint.py against a committed-empty HOSTLINT_BASELINE.
 """
 
 from __future__ import annotations
@@ -46,6 +54,10 @@ from hermes_tpu.analysis.passes import (  # noqa: F401
 from hermes_tpu.analysis.diffcheck import (  # noqa: F401
     KernelCell, analyze_kernel, diff_check, kernel_cells,
     run_kernel_matrix)
+from hermes_tpu.analysis.hostlint import (  # noqa: F401
+    lint_package, lint_source)
+from hermes_tpu.analysis.lockgraph import (  # noqa: F401
+    LockGraph, ObsLock)
 
 GATING = (ERROR, WARN)  # severities that fail the CI gate
 

@@ -93,6 +93,36 @@ def _kernels_main(args) -> int:
     return 0 if ok else 1
 
 
+def _host_main(args) -> int:
+    """``--host``: the host concurrency lint (hostlint.py) — prove the
+    threaded serving/transport tier against the guard registry.  One
+    JSON summary line, exit 1 iff any ERROR finding; the baselined CI
+    gate is scripts/check_hostlint.py."""
+    from hermes_tpu import analysis as ana
+    from hermes_tpu.analysis import hostlint
+
+    rep = hostlint.lint_package()
+    errs = [f for f in rep["findings"] if f.severity == ana.ERROR]
+    warns = [f for f in rep["findings"] if f.severity == ana.WARN]
+    infos = [f for f in rep["findings"] if f.severity == ana.INFO]
+    if not args.json:
+        proved = " ".join(f"{k}={v}" for k, v in rep["proved"].items())
+        print(f"== host: {rep['n_eqns']} files, proved [{proved}], "
+              f"{len(errs)} error / {len(warns)} warn / {len(infos)} "
+              f"info", file=sys.stderr)
+        for f in rep["findings"]:
+            tag = f" (audit: {f.audit})" if f.audit else ""
+            print(f"  [{f.severity:<5}] {f.pass_name}/{f.code} "
+                  f"{f.site} in {f.fn} x{f.count}{tag}\n"
+                  f"          {f.message}", file=sys.stderr)
+    if args.out:
+        ana.export_findings(args.out, [rep], extra={"config": "host"})
+    print(json.dumps(dict(
+        config="host", engines=["host"], files=rep["n_eqns"],
+        errors=len(errs), warnings=len(warns), infos=len(infos))))
+    return 1 if errs else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hermes_tpu.analysis",
@@ -124,10 +154,18 @@ def main(argv=None) -> int:
                     "(seeded interpret-mode runs vs abstract cells)")
     ap.add_argument("--draws", type=int, default=3,
                     help="sanitizer draws per kernel cell (--kernels)")
+    ap.add_argument("--host", action="store_true",
+                    help="run ONLY the host concurrency lint: the "
+                    "whole package statically proved against the "
+                    "guard registry (hermes_tpu/concurrency.py) — "
+                    "guarded-attr, blocking-under-lock, lock-order "
+                    "cycles, thread ownership")
     args = ap.parse_args(argv)
 
     from hermes_tpu import analysis as ana
 
+    if args.host:
+        return _host_main(args)
     if args.kernels:
         return _kernels_main(args)
 

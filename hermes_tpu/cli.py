@@ -220,6 +220,14 @@ def build_parser() -> argparse.ArgumentParser:
                     "state, framed RPC over localhost TCP) and print one "
                     "JSON line with p50/p99 vs the 28 ms dispatch-loop "
                     "figure")
+    ap.add_argument("--locklint", action="store_true",
+                    help="run the serving drive under the dynamic "
+                    "lock-order sanitizer (HERMES_LOCKLINT=1: every "
+                    "serving-tier lock becomes an instrumented ObsLock, "
+                    "analysis/lockgraph.py) and append the held-before "
+                    "graph report — per-lock acquires/contention/"
+                    "hold-p99, edge count, any potential-deadlock "
+                    "cycles — to the JSON summary line")
     ap.add_argument("--profile-out", type=str, default=None,
                     metavar="PROFILE_JSONL",
                     help="write the run config's round op census + cost-model"
@@ -323,9 +331,21 @@ def _run_serve(args, cfg) -> int:
         v = kvs.rt.check(max_keys=args.check_keys)
         summary["checked_ok"] = bool(v.ok)
         ok = ok and v.ok
+    if args.locklint:
+        ok = _append_locklint(summary) and ok
     summary["ok"] = bool(ok)
     print(json.dumps(summary, default=str))
     return 0 if ok else 1
+
+
+def _append_locklint(summary: dict) -> bool:
+    """Attach the dynamic lock sanitizer's held-before graph report to a
+    quickstart summary; a cycle (potential deadlock) fails the run."""
+    from hermes_tpu.analysis import lockgraph
+
+    rep = lockgraph.global_graph().report()
+    summary["locklint"] = rep
+    return not rep["cycles"]
 
 
 #: --value-bytes --check: post-compaction utilization floor (live bytes /
@@ -497,6 +517,8 @@ def _run_bench_latency(args, cfg) -> int:
     # a cell that lost its server or part of its answers is NOT a pass,
     # however good the answered-prefix percentiles look
     cell["ok"] = bool(cell["improves_dispatch_loop"]) and cell["error"] is None
+    if args.locklint:
+        cell["ok"] = _append_locklint(cell) and cell["ok"]
     print(json.dumps(cell, default=str))
     return 0 if cell["ok"] else 1
 
@@ -563,6 +585,12 @@ def _run_drill(args, cfg, mesh) -> int:
 def main(argv=None) -> int:
     ap = build_parser()
     args = ap.parse_args(argv)
+    if args.locklint:
+        # must land before any serving/transport object mints its locks
+        # (concurrency.make_lock reads the switch at mint time)
+        import os
+
+        os.environ["HERMES_LOCKLINT"] = "1"
     if args.chain_writes and args.arb_mode != "sort":
         ap.error("--chain-writes needs --arb-mode sort")
     if args.mega_round and args.arb_mode != "sort":

@@ -22,6 +22,7 @@ at the caller's chosen poll interval.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import IO, Dict, List, Optional, Union
 
@@ -101,20 +102,45 @@ def percentile_from_counts(counts: np.ndarray, q: float) -> Optional[int]:
 class MetricsRegistry:
     """Named metric registry with get-or-create accessors.  A name maps to
     exactly one metric object for the registry's lifetime; asking for the
-    same name with a different type is a bug and raises."""
+    same name with a different type is a bug and raises.
+
+    The name->metric MAP is lock-guarded (round-20): serving-tier threads
+    get-or-create concurrently, and an unlocked dict insert during a
+    snapshot iteration raises RuntimeError (or mints two objects for one
+    name).  Metric VALUES stay lock-free by design — int adds under the
+    GIL, the zero-device-cost contract above."""
 
     def __init__(self):
+        # a PLAIN threading.Lock, NEVER concurrency.make_lock: the
+        # registry is the sink the lock sanitizer itself feeds
+        # (lockgraph.ObsLock reports hold-time series INTO a registry);
+        # instrumenting this lock would recurse the sanitizer into its
+        # own sink and self-deadlock.  See concurrency.REGISTRY's
+        # MetricsRegistry entry.
+        self._lock = threading.Lock()
         self._metrics: Dict[str, Union[Counter, Gauge, Histogram,
                                        Series]] = {}
 
     def _get(self, name: str, cls, **kw):
-        m = self._metrics.get(name)
-        if m is None:
-            m = self._metrics[name] = cls(name, **kw)
-        elif not isinstance(m, cls):
-            raise TypeError(
-                f"metric {name!r} is a {type(m).__name__}, not {cls.__name__}")
-        return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} is a {type(m).__name__}, "
+                    f"not {cls.__name__}")
+            return m
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def _items(self) -> list:
+        """Sorted (name, metric) snapshot — iteration currency for the
+        exporters, so a concurrent get-or-create never invalidates it."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get(name, Counter, help=help)
@@ -133,13 +159,14 @@ class MetricsRegistry:
         return self._get(name, Series, capacity=capacity, help=help)
 
     def __contains__(self, name: str) -> bool:
-        return name in self._metrics
+        with self._lock:
+            return name in self._metrics
 
     def snapshot(self) -> dict:
         """Flat JSON-ready view: scalars verbatim; histograms as counts plus
         derived p50/p99 (None-omitted, matching stats.summarize)."""
         out: dict = {}
-        for name, m in sorted(self._metrics.items()):
+        for name, m in self._items():
             if isinstance(m, Series):
                 continue  # full history exports via series_snapshot()
             if isinstance(m, Histogram):
@@ -156,7 +183,7 @@ class MetricsRegistry:
         """JSON-ready view of every time series: name -> parallel x/v
         arrays (the ``kind="series"`` record Observability exports)."""
         return {name: m.snapshot()
-                for name, m in sorted(self._metrics.items())
+                for name, m in self._items()
                 if isinstance(m, Series)}
 
 
@@ -164,7 +191,7 @@ def prometheus_text(reg: MetricsRegistry) -> str:
     """Prometheus text-exposition snapshot (counters/gauges as samples,
     histograms as cumulative ``_bucket`` series + ``_count``)."""
     lines: List[str] = []
-    for name, m in sorted(reg._metrics.items()):
+    for name, m in reg._items():
         if isinstance(m, Series):
             continue  # rings have no Prometheus shape; JSONL-only
         if m.help:

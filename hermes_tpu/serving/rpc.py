@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from hermes_tpu.concurrency import make_lock
 from hermes_tpu.serving import wire
 from hermes_tpu.serving.server import Frontend
 
@@ -99,13 +100,16 @@ class TcpRpcServer:
         self.u = frontend.u
         self.vbytes = frontend.vbytes
         self._FramedSocket = FramedSocket
-        self._lock = threading.Lock()
+        # minted via make_lock: HERMES_LOCKLINT=1 swaps in the
+        # instrumented ObsLock (analysis/lockgraph.py) so soaks double
+        # as lock-order sanitizer runs; plain threading.Lock otherwise
+        self._lock = make_lock("TcpRpcServer._lock")
         # round-19 lock-fairness split: ``_lock`` guards the Frontend
         # itself (submit/pump — held for a full store round at a time);
         # ``_map_lock`` guards only the iid<->connection bookkeeping, so
         # the pump's per-response map pops and the readers' iid minting
         # never extend the frontend critical section
-        self._map_lock = threading.Lock()
+        self._map_lock = make_lock("TcpRpcServer._map_lock")
         # client req_ids are only unique PER CONNECTION (wire.py): the
         # server re-mints each into a globally unique internal id before
         # submit, and maps it back on send — two connections using the
@@ -121,12 +125,14 @@ class TcpRpcServer:
         self._conns: List = []
         self._listener = socket.create_server((host, port))
         self.addr = self._listener.getsockname()
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
-        t = threading.Thread(target=self._pump_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        # register BOTH threads before starting either: the accept loop
+        # prunes/extends _threads (under _map_lock), so a start-then-
+        # append would race the pump thread's registration away
+        accept_t = threading.Thread(target=self._accept_loop, daemon=True)
+        pump_t = threading.Thread(target=self._pump_loop, daemon=True)
+        self._threads.extend((accept_t, pump_t))
+        accept_t.start()
+        pump_t.start()
 
     # -- server side ---------------------------------------------------------
 
@@ -158,24 +164,29 @@ class TcpRpcServer:
             fsock = self._FramedSocket(
                 sock, expect_lens=wire.plausible_request_len(self.u,
                                                          self.vbytes))
-            self._conns.append(fsock)
             t = threading.Thread(target=self._reader_loop, args=(fsock,),
                                  daemon=True)
+            # register conn + thread (and prune finished readers so a
+            # long-lived server's list doesn't grow with every
+            # connection ever made) BEFORE start, under _map_lock:
+            # close() snapshots both lists under the same lock
+            with self._map_lock:
+                self._conns.append(fsock)
+                self._threads = [th for th in self._threads
+                                 if th.is_alive()]
+                self._threads.append(t)
             t.start()
-            # prune finished reader threads so a long-lived server's
-            # thread list doesn't grow with every connection ever made
-            self._threads = [th for th in self._threads if th.is_alive()]
-            self._threads.append(t)
 
     def _reader_loop(self, fsock) -> None:
         try:
             self._reader_body(fsock)
         finally:
             fsock.close()
-            try:
-                self._conns.remove(fsock)
-            except ValueError:
-                pass
+            with self._map_lock:
+                try:
+                    self._conns.remove(fsock)
+                except ValueError:
+                    pass
 
     def _reader_body(self, fsock) -> None:
         while not self._stop.is_set():
@@ -212,7 +223,8 @@ class TcpRpcServer:
                     # serializes itself, so the pump thread's concurrent
                     # sends on this socket can't splice frames.
                     rid = wire.peek_req_id(raw)
-                    self.undecodable += 1
+                    with self._map_lock:
+                        self.undecodable += 1
                     if rid is not None:
                         try:
                             fsock.send(wire.encode_response(
@@ -289,7 +301,9 @@ class TcpRpcServer:
                 # stream so clients see EOF now.
                 self.pump_error = e
                 self._stop.set()
-                for fsock in list(self._conns):
+                with self._map_lock:
+                    conns = list(self._conns)
+                for fsock in conns:
                     fsock.close()
                 raise
             # sends OUTSIDE the lock: a stalled client blocks this send
@@ -308,11 +322,17 @@ class TcpRpcServer:
             self._listener.close()
         except OSError:
             pass
+        # snapshot under _map_lock, close/join OUTSIDE it: joining a
+        # reader while holding the lock its exit path needs would
+        # deadlock close() against the threads it is waiting out
+        with self._map_lock:
+            conns = list(self._conns)
+            threads = list(self._threads)
         # close every accepted connection: reader threads blocked in
         # fsock.recv() only exit when their socket dies
-        for fsock in list(self._conns):
+        for fsock in conns:
             fsock.close()
-        for t in list(self._threads):
+        for t in threads:
             t.join(timeout=2.0)
 
 
@@ -397,8 +417,9 @@ class ColumnarTcpServer:
         self.u = frontend.u
         self.vbytes = frontend.vbytes
         self._FramedSocket = FramedSocket
-        self._lock = threading.Lock()      # frontend critical section
-        self._map_lock = threading.Lock()  # conn-id bookkeeping only
+        # make_lock: ObsLock under HERMES_LOCKLINT=1, plain Lock otherwise
+        self._lock = make_lock("ColumnarTcpServer._lock")
+        self._map_lock = make_lock("ColumnarTcpServer._map_lock")
         self._next_cid = 1
         self._sock_of: Dict[int, object] = {}
         self.undecodable = 0
@@ -409,12 +430,12 @@ class ColumnarTcpServer:
         self._conns: List = []
         self._listener = serving_listener(host, port, reuseport=reuseport)
         self.addr = self._listener.getsockname()
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
-        t = threading.Thread(target=self._pump_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        # register both threads before starting either (see TcpRpcServer)
+        accept_t = threading.Thread(target=self._accept_loop, daemon=True)
+        pump_t = threading.Thread(target=self._pump_loop, daemon=True)
+        self._threads.extend((accept_t, pump_t))
+        accept_t.start()
+        pump_t.start()
 
     def _accept_loop(self) -> None:
         import struct as _struct
@@ -437,12 +458,15 @@ class ColumnarTcpServer:
             with self._map_lock:
                 cid, self._next_cid = self._next_cid, self._next_cid + 1
                 self._sock_of[cid] = fsock
-            self._conns.append(fsock)
+                self._conns.append(fsock)
             t = threading.Thread(target=self._reader_loop,
                                  args=(fsock, cid), daemon=True)
+            # registered before start (see TcpRpcServer._accept_loop)
+            with self._map_lock:
+                self._threads = [th for th in self._threads
+                                 if th.is_alive()]
+                self._threads.append(t)
             t.start()
-            self._threads = [th for th in self._threads if th.is_alive()]
-            self._threads.append(t)
 
     def _reader_loop(self, fsock, cid: int) -> None:
         try:
@@ -451,10 +475,10 @@ class ColumnarTcpServer:
             fsock.close()
             with self._map_lock:
                 self._sock_of.pop(cid, None)
-            try:
-                self._conns.remove(fsock)
-            except ValueError:
-                pass
+                try:
+                    self._conns.remove(fsock)
+                except ValueError:
+                    pass
 
     def _reader_body(self, fsock, cid: int) -> None:
         while not self._stop.is_set():
@@ -488,7 +512,8 @@ class ColumnarTcpServer:
                     # no per-row identity to refuse on, so tear the
                     # stream down LOUDLY (client sees EOF now, not a
                     # timeout later)
-                    self.undecodable += 1
+                    with self._map_lock:
+                        self.undecodable += 1
                     return
             refusals = []
             with self._lock:
@@ -523,7 +548,9 @@ class ColumnarTcpServer:
                 # loudly, close every stream so clients see EOF now
                 self.pump_error = e
                 self._stop.set()
-                for fsock in list(self._conns):
+                with self._map_lock:
+                    conns = list(self._conns)
+                for fsock in conns:
                     fsock.close()
                 raise
             # publish OUTSIDE the frontend lock: one encode + one send
@@ -541,9 +568,14 @@ class ColumnarTcpServer:
             self._listener.close()
         except OSError:
             pass
-        for fsock in list(self._conns):
+        # snapshot under _map_lock, close/join outside it (see
+        # TcpRpcServer.close)
+        with self._map_lock:
+            conns = list(self._conns)
+            threads = list(self._threads)
+        for fsock in conns:
             fsock.close()
-        for t in list(self._threads):
+        for t in threads:
             t.join(timeout=2.0)
 
 

@@ -18,6 +18,8 @@ import threading
 
 import numpy as np
 
+from hermes_tpu.concurrency import make_lock
+
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
 _SO = _NATIVE_DIR / "libhermes_tcp.so"
 _SRC = _NATIVE_DIR / "tcp_transport.cpp"
@@ -101,11 +103,17 @@ class FramedSocket:
         else:
             lens = frozenset(expect_lens)
             self._plausible = lens.__contains__
-        self._send_lock = threading.Lock()
+        # make_lock: instrumented under HERMES_LOCKLINT=1 (sanitizer
+        # soaks), plain threading.Lock otherwise
+        self._send_lock = make_lock("FramedSocket._send_lock")
 
     def send(self, payload: bytes) -> None:
         frame = self._codec.frame_pack(np.frombuffer(
             bytes(payload), np.uint8))
+        # sendall UNDER the lock is deliberate (a BlockingAudit in
+        # concurrency.REGISTRY): the lock exists precisely to keep
+        # whole frames atomic on the stream, and SO_SNDTIMEO bounds
+        # the stall a non-reading peer can impose
         with self._send_lock:
             self.sock.sendall(frame.tobytes())
 

@@ -43,6 +43,13 @@ import time
 sys.path.insert(0, ".")
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# round-20: instrumentation must not measure instrumentation — the lock
+# sanitizer (HERMES_LOCKLINT=1 swaps serving locks for ObsLock, feeding
+# lock_* hold-time series into any attached registry) would inflate the
+# traced leg against the untraced one.  Force it OFF here regardless of
+# the caller's env; build_traced_runner additionally asserts no lock_*
+# metric ever reaches the traced registry.
+os.environ["HERMES_LOCKLINT"] = "0"
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -100,8 +107,10 @@ def build_traced_runner(trace_sample: int, n_ops: int):
         workload=WorkloadConfig(read_frac=0.5, seed=0),
     )
     kv = KVS(cfg, backend="batched")
+    obs = None
     if trace_sample:
-        kv.rt.attach_obs(Observability())
+        obs = Observability()
+        kv.rt.attach_obs(obs)
 
     def burst():
         futs = []
@@ -117,6 +126,15 @@ def build_traced_runner(trace_sample: int, n_ops: int):
                 for k in ("n_read", "n_write", "n_rmw", "n_abort")}
 
     burst()  # warm: compile + host caches
+    if obs is not None:
+        from hermes_tpu.analysis.lockgraph import LOCK_METRIC_PREFIX
+
+        leaked = [n for n in obs.registry.names()
+                  if n.startswith(LOCK_METRIC_PREFIX)]
+        assert not leaked, (
+            f"lock sanitizer series leaked into the overhead gate's "
+            f"traced registry: {leaked} — HERMES_LOCKLINT must stay off "
+            f"here (instrumentation measuring instrumentation)")
     return burst, counts
 
 

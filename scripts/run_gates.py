@@ -1,7 +1,7 @@
 """One serial runner for every CI gate (round-11 satellite).
 
-The ten gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
-netchaos, fleet, serving, heap — MUST run serially and never beside a pytest run: the
+The eleven gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
+netchaos, fleet, serving, heap, hostlint — MUST run serially and never beside a pytest run: the
 obs-overhead gate measures per-round wall time against an ablation
 baseline and is contention-sensitive (a parallel pytest's CPU load turns a
 behavior-identical change into a spurious overhead failure).  That rule
@@ -51,6 +51,7 @@ GATES = (
     ("fleet", "check_fleet.py"),
     ("serving", "check_serving.py"),
     ("heap", "check_heap.py"),
+    ("hostlint", "check_hostlint.py"),
 )
 
 
@@ -172,18 +173,31 @@ def main() -> int:
         results.append(r)
 
     def _gate_cells(r: dict) -> dict:
+        if not isinstance(r.get("report"), dict):
+            return {}
         # round-19: the serving gate's columnar-floor cell is a tracked
         # perf number — carry it into the summary's gates block so a
         # regression is visible without digging into the full report
-        if r["gate"] != "serving" or not isinstance(r.get("report"), dict):
-            return {}
-        cell = r["report"].get("columnar_floor")
-        if not isinstance(cell, dict):
-            return {}
-        keep = ("ops_per_sec", "required_ops_per_sec",
-                "scalar_baseline_ops_per_sec", "speedup_vs_scalar",
-                "current_scalar_ops_per_sec", "speedup_vs_current_scalar")
-        return {"columnar_floor": {k: cell[k] for k in keep if k in cell}}
+        if r["gate"] == "serving":
+            cell = r["report"].get("columnar_floor")
+            if not isinstance(cell, dict):
+                return {}
+            keep = ("ops_per_sec", "required_ops_per_sec",
+                    "scalar_baseline_ops_per_sec", "speedup_vs_scalar",
+                    "current_scalar_ops_per_sec",
+                    "speedup_vs_current_scalar")
+            return {"columnar_floor": {k: cell[k]
+                                       for k in keep if k in cell}}
+        # round-20: the hostlint gate's per-leg timing + verdicts
+        if r["gate"] == "hostlint":
+            legs = r["report"].get("legs")
+            if not isinstance(legs, dict):
+                return {}
+            return {"legs": {name: dict(ok=leg.get("ok"),
+                                        seconds=leg.get("seconds"))
+                             for name, leg in legs.items()
+                             if isinstance(leg, dict)}}
+        return {}
 
     summary = dict(
         ok=all(r["ok"] for r in results),

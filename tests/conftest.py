@@ -148,6 +148,10 @@ SLOW_TESTS = {
     "test_migrate_range_moves_extents_byte_exact",
     "test_snapshot_roundtrip_and_torn_heap_red",
     "test_serving_loopback_heap_end_to_end",
+    # round-20 hostlint: the native-sanitizer build+run suite (ASan/UBSan
+    # + TSan compiles of the C++ transport) is minutes of g++; the quick
+    # tier keeps the toolchain-presence test so absence is LOUD
+    "test_native_sanitizer_suite",
 }
 
 
