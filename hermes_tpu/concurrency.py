@@ -180,6 +180,72 @@ REGISTRY: Tuple[ClassGuards, ...] = (
                          "serialization", "*"),),
     ),
     ClassGuards(
+        cls="RespMetaRing", module="hermes_tpu.serving.server",
+        audited=(audited("frontend-serialized: owned by a Frontend/"
+                         "ColumnarFrontend and touched only under its "
+                         "owner's serialization", "*"),),
+    ),
+    ClassGuards(
+        cls="ShmWorker", module="hermes_tpu.serving.ipc",
+        locks=("_ring_lock", "_map_lock"),
+        guards=(
+            Guard("_ring_lock", ("rows_in",)),
+            Guard("_map_lock", ("_next_cid", "_sock_of", "_conns",
+                                "_threads", "undecodable",
+                                "backpressured")),
+        ),
+        audited=(
+            audited("single-thread: only the response-drain thread "
+                    "touches the rsp ring consumer cursor and this "
+                    "counter", "rows_out"),
+            audited("threading.Event is internally synchronized", "_stop"),
+            audited("spsc-by-contract: the request ring's cursor-"
+                    "mutating producer calls all run under _ring_lock; "
+                    "the spec reads outside it are frozen-dataclass "
+                    "immutable", "req_ring", "rsp_ring"),
+        ),
+        thread_owner="_threads",
+        notes="_ring_lock makes the reader threads collectively ONE "
+              "producer on the request ring (the SPSC contract); "
+              "_map_lock is the ColumnarTcpServer-style connection "
+              "bookkeeping split.",
+    ),
+    ClassGuards(
+        cls="StoreOwner", module="hermes_tpu.serving.ipc",
+        audited=(audited("single-threaded by contract: the owner pump "
+                         "thread (OneStoreServer) or the soak driver is "
+                         "the only entrant; ring consumer/producer "
+                         "cursors and counters never see a second "
+                         "thread", "*"),),
+    ),
+    ClassGuards(
+        cls="OneStoreServer", module="hermes_tpu.serving.ipc",
+        audited=(
+            audited("single-writer-publish: set once by the dying pump "
+                    "thread; every other thread only polls it",
+                    "pump_error"),
+            audited("threading.Event is internally synchronized", "_stop"),
+            audited("sequential handoff: the pump thread is the sole "
+                    "mutator while running; close() joins it before "
+                    "touching owner/ring/process state, and the boot "
+                    "path runs before the thread starts", "*"),
+        ),
+        thread_owner="_pump_t",
+        notes="worker shutdown rides SIGTERM, not a shared mp.Event: "
+              "mp.Event.set() handshakes with sleepers and deadlocks "
+              "against a SIGKILLed waiter (the crash path the kill "
+              "soak gates).",
+    ),
+    ClassGuards(
+        cls="SpscColumnRing", module="hermes_tpu.transport.shm",
+        audited=(audited("spsc-by-contract: exactly one producer and "
+                         "one consumer process/thread (callers "
+                         "serialize their own side — ShmWorker._ring_"
+                         "lock); the cross-process handshake is the "
+                         "begin/end/ack generation protocol, not a "
+                         "lock", "*"),),
+    ),
+    ClassGuards(
         cls="FramedSocket", module="hermes_tpu.transport.tcp",
         locks=("_send_lock",),
         audited=(audited("single-reader: recv runs on exactly one thread "

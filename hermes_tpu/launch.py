@@ -220,6 +220,35 @@ def start_serve_workers(n_workers: int, cfg=None, scfg=None,
     return fleet
 
 
+def start_one_store(n_workers: int, cfg=None, scfg=None,
+                    host: str = "127.0.0.1", port: int = 0,
+                    nslots: int = 8, slot_rows: int = 512,
+                    ready_timeout_s: float = 120.0):
+    """Start the round-21 ONE-STORE topology (serving/ipc.py): THIS
+    process owns the single KVS + ColumnarFrontend and the owner pump
+    thread; ``n_workers`` shm front-end processes shard TCP accepts on
+    one SO_REUSEPORT port and feed it over zero-copy columnar rings.
+    Counterpart of ``start_serve_workers`` (per-worker PRIVATE stores):
+    here the device round stays one program at full lane occupancy and
+    only the socket work scales out.  Returns the ``OneStoreServer``
+    handle (``.addr``, ``.alive()``, ``.close()``, context manager)."""
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.serving.ipc import OneStoreServer
+    from hermes_tpu.serving.server import ServingConfig
+
+    if n_workers < 1:
+        raise ValueError("need at least one shm worker")
+    cfg = cfg or HermesConfig(n_replicas=4, n_keys=1 << 10,
+                              n_sessions=64, value_words=6)
+    scfg = scfg or ServingConfig()
+    store = KVS(cfg)
+    return OneStoreServer(store, scfg, host=host, port=port,
+                          n_workers=n_workers, nslots=nslots,
+                          slot_rows=slot_rows,
+                          ready_timeout_s=ready_timeout_s)
+
+
 def _main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--coordinator", type=str, default=None,
@@ -244,7 +273,38 @@ def _main():
                     help="shared serving port (0 = pick a free one)")
     ap.add_argument("--serve-seconds", type=float, default=0.0,
                     help="serve for this long then exit (0 = until ^C)")
+    ap.add_argument("--one-store", action="store_true",
+                    help="with --serve-workers: round-21 topology — the "
+                    "workers are thin shm front-ends (serving/ipc.py) "
+                    "feeding ONE store owned by this process over "
+                    "zero-copy columnar rings, instead of each worker "
+                    "owning a private store")
     args = ap.parse_args()
+
+    if args.serve_workers > 0 and args.one_store:
+        import json
+        import time as _time
+
+        from hermes_tpu.config import HermesConfig
+
+        cfg = HermesConfig(n_replicas=args.replicas or 4, n_keys=args.keys,
+                           n_sessions=args.sessions, value_words=6)
+        srv = start_one_store(args.serve_workers, cfg=cfg,
+                              port=args.serve_port)
+        print(json.dumps({"serving": list(srv.addr),
+                          "workers": args.serve_workers,
+                          "one_store": True}), flush=True)
+        try:
+            if args.serve_seconds > 0:
+                _time.sleep(args.serve_seconds)
+            else:
+                while srv.alive() and srv.pump_error is None:
+                    _time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.close()
+        return
 
     if args.serve_workers > 0:
         import json

@@ -45,6 +45,8 @@ from __future__ import annotations
 import json
 from typing import Iterable, List, Optional
 
+import numpy as np
+
 #: Wire-field capacity: a trace id is a nonzero u16 (wire._REQ's second
 #: pad).  0 = not sampled, so ids live in [1, TRACE_ID_MAX].
 TRACE_ID_MAX = 0xFFFF
@@ -92,6 +94,26 @@ class TraceSampler:
         # fold the top bits into a nonzero u16 id; collisions across a
         # long run are harmless (spans also carry lane/key identity)
         return (h >> 40) % TRACE_ID_MAX + 1
+
+    def sample_array(self, seqs) -> np.ndarray:
+        """Vectorized ``sample`` over a submit-sequence column: one
+        splitmix64 pass in uint64 numpy arithmetic, bit-exact with the
+        scalar path row for row (tests/test_shm_ipc.py proves it) — the
+        columnar front-end's trace mint no longer loops Python per
+        unsampled row (round-21)."""
+        m64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+        with np.errstate(over="ignore"):
+            x = (np.uint64((self.seed * 0x5851F42D4C957F2D)
+                           & 0xFFFFFFFFFFFFFFFF)
+                 + np.asarray(seqs, np.uint64))
+            x = x + np.uint64(_MIX)
+            x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            h = (x ^ (x >> np.uint64(31))) & m64
+            ids = (h >> np.uint64(40)) % np.uint64(TRACE_ID_MAX) \
+                + np.uint64(1)
+        return np.where(h % np.uint64(self.rate), 0,
+                        ids).astype(np.uint16)
 
 
 class OpTracer:

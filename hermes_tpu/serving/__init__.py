@@ -16,10 +16,19 @@ ring instead of per-request futures (server.ColumnarFrontend), and
 drain as one framed encode per connection per pump
 (rpc.ColumnarLoopback / ColumnarTcpServer, with SO_REUSEPORT accept
 sharding across worker processes via launch.start_serve_workers).
+
+Round-21 adds the shared-memory columnar IPC plane (serving/ipc.py
+over transport/shm.py): N front-end worker PROCESSES doing accept +
+frame decode on their own GILs, each feeding ONE device-owning store
+process through zero-copy SPSC columnar shm rings — one merged
+submit_batch + pump per round at full lane occupancy
+(ipc.OneStoreServer, launch.start_one_store, ``--one-store``).
 """
 
 from hermes_tpu.serving import wire
 from hermes_tpu.serving.admission import AdmissionControl, TokenBucket
+from hermes_tpu.serving.ipc import (OneStoreServer, ShmWorker,
+                                    StoreOwner, run_shm_soak)
 from hermes_tpu.serving.rpc import (ColumnarClient, ColumnarLoopback,
                                     ColumnarTcpServer, LoopbackServer,
                                     RpcClient, TcpRpcServer)
@@ -34,5 +43,6 @@ __all__ = [
     "RpcClient", "TcpRpcServer", "ColumnarClient", "ColumnarLoopback",
     "ColumnarTcpServer", "ColumnarFrontend", "Frontend", "ServingConfig",
     "VirtualClock", "verify_columnar", "verify_serving", "committed_uids",
-    "measure_capacity", "run_open_loop",
+    "measure_capacity", "run_open_loop", "OneStoreServer", "ShmWorker",
+    "StoreOwner", "run_shm_soak",
 ]

@@ -45,6 +45,7 @@ import numpy as np
 
 from hermes_tpu.serving import wire
 from hermes_tpu.serving.admission import AdmissionControl
+from hermes_tpu.transport import codec as _codec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +106,72 @@ class VirtualClock:
         self.t += ds
 
 
+class RespMetaRing:
+    """Bounded columnar response-meta history — the round-21 twin of
+    the old per-row ``deque`` of (tenant, status, latency) tuples: a
+    fixed-capacity numpy ring the hot paths append COLUMNS into
+    (``extend`` is one fancy-index write per batch; the scalar
+    ``append`` stays for the row-at-a-time Frontend).  Latency NaN
+    encodes a refusal's absent measurement; iteration yields the exact
+    (tenant, status, latency-or-None) tuples the soak census loops
+    always consumed, oldest first."""
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self.tenant = np.zeros(self.cap, np.int32)
+        self.status = np.zeros(self.cap, np.uint8)
+        self.lat = np.full(self.cap, np.nan)
+        self.n = 0  # total rows ever appended (monotone)
+
+    def append(self, tenant: int, status: int,
+               latency_s: Optional[float]) -> None:
+        i = self.n % self.cap
+        self.tenant[i] = tenant
+        self.status[i] = status
+        self.lat[i] = np.nan if latency_s is None else latency_s
+        self.n += 1
+
+    def extend(self, tenants, statuses, lats=None) -> None:
+        """Column append: ``lats=None`` records NaN for every row (the
+        immediate-refusal shape).  Batches larger than the capacity
+        keep their LAST ``cap`` rows — same semantics as appending row
+        by row into a maxlen deque."""
+        k = int(np.asarray(statuses).shape[0])
+        if not k:
+            return
+        drop = max(0, k - self.cap)
+        idx = (self.n + drop + np.arange(k - drop)) % self.cap
+        self.tenant[idx] = np.asarray(tenants)[drop:]
+        self.status[idx] = np.asarray(statuses)[drop:]
+        self.lat[idx] = (np.nan if lats is None
+                         else np.asarray(lats, float)[drop:])
+        self.n += k
+
+    def _window(self) -> np.ndarray:
+        held = min(self.n, self.cap)
+        return (self.n - held + np.arange(held)) % self.cap
+
+    def __len__(self) -> int:
+        return min(self.n, self.cap)
+
+    def __iter__(self):
+        idx = self._window()
+        for t, s, lc in zip(self.tenant[idx].tolist(),
+                            self.status[idx].tolist(),
+                            self.lat[idx].tolist()):
+            yield (t, s, None if math.isnan(lc) else lc)
+
+    def latencies(self, statuses) -> List[float]:
+        """Measured latencies of rows whose status is in ``statuses``
+        (one vectorized mask, the hot-path replacement for the old
+        list comprehension over tuples)."""
+        idx = self._window()
+        lat = self.lat[idx]
+        m = (np.isin(self.status[idx], np.asarray(list(statuses)))
+             & ~np.isnan(lat))
+        return lat[m].tolist()
+
+
 class _ReadFuture:
     """Future-shaped adapter over a MultiGetResult/FleetReads: done when
     every key answered (locally or via the round-path fallback the pump's
@@ -142,8 +209,7 @@ class Frontend:
         self._pending: Dict[int, dict] = {}   # req_id -> entry (admit order)
         self._abandoned: List[dict] = []      # RPC resolved, store op open
         self._responses: List[wire.Response] = []
-        self._resp_meta: collections.deque = collections.deque(
-            maxlen=self.scfg.resp_meta_cap)   # (tenant, status, latency_s)
+        self._resp_meta = RespMetaRing(self.scfg.resp_meta_cap)
         self._lane_seq: Dict[int, int] = collections.defaultdict(int)
         self.requests = 0
         self.responses = 0
@@ -282,7 +348,7 @@ class Frontend:
         # can collide with another connection's pending internal id)
         if queue:
             self._responses.append(rsp)
-        self._resp_meta.append((tenant, rsp.status, latency_s))
+        self._resp_meta.append(tenant, rsp.status, latency_s)
         self.responses += 1
         return rsp
 
@@ -634,8 +700,7 @@ class Frontend:
                                   wire.S_LOST)) -> List[float]:
         """Admission-to-resolution latency (serving clock, seconds) of
         every ADMITTED op whose terminal status is in ``statuses``."""
-        return [lat for _t, st, lat in self._resp_meta
-                if st in statuses and lat is not None]
+        return self._resp_meta.latencies(statuses)
 
     def counters(self) -> dict:
         per = self.adm.counters()
@@ -703,9 +768,28 @@ class CompletionRing:
         self.step = np.full(size, -1, np.int32)
         self.retry_us = np.zeros(size, np.uint32)
         self.uid = np.zeros((size, 2), np.int32)
-        # payload: fixed word matrix, or (heap mode) a per-slot byte ref
+        # payload: fixed word matrix, or (heap mode) a preallocated byte
+        # ARENA — one (size, vbytes) row per slot plus a length column
+        # (-1 = no payload), so the emit path can assemble a response
+        # blob with codec.ragged_gather instead of a per-row Python
+        # join (round-21; the old per-slot ``bytes`` list is gone)
         self.value = (np.zeros((size, u), np.int32) if not vbytes else None)
-        self.data: List[Optional[bytes]] = [None] * size
+        self.heap = (np.zeros((size, vbytes), np.uint8) if vbytes
+                     else None)
+        self.dlen = (np.full(size, -1, np.int64) if vbytes else None)
+
+    def set_data(self, s: int, b: Optional[bytes]) -> None:
+        """Write one slot's heap payload (None clears)."""
+        if b is None:
+            self.dlen[s] = -1
+            return
+        n = len(b)
+        self.heap[s, :n] = np.frombuffer(b, np.uint8)
+        self.dlen[s] = n
+
+    def get_data(self, s: int) -> Optional[bytes]:
+        n = int(self.dlen[s])
+        return None if n < 0 else self.heap[s, :n].tobytes()
 
     def alloc(self, k: int) -> np.ndarray:
         if k > self.n_free:
@@ -726,8 +810,7 @@ class CompletionRing:
         self.n_free += k
         self.status[slots] = _RING_OPEN
         if self.vbytes:
-            for s in slots.tolist():
-                self.data[s] = None
+            self.dlen[slots] = -1
 
     def in_use(self) -> int:
         return self.cap - self.n_free
@@ -793,8 +876,7 @@ class ColumnarFrontend:
         # the batch twin of the scalar _abandoned list)
         self._open: List[dict] = []
         self._store_inflight = 0
-        self._resp_meta: collections.deque = collections.deque(
-            maxlen=self.scfg.resp_meta_cap)
+        self._resp_meta = RespMetaRing(self.scfg.resp_meta_cap)
         self.requests = 0
         self.responses = 0
         self.shed_level = 0
@@ -848,14 +930,19 @@ class ColumnarFrontend:
 
     # -- intake --------------------------------------------------------------
 
-    def submit_batch(self, batch: wire.ReqBatch,
-                     conn: int = 0) -> wire.RspBatch:
+    def submit_batch(self, batch: wire.ReqBatch, conn=0):
         """Run a whole request batch through admission in one pass.
         Returns the IMMEDIATE refusals (S_REJECTED validity failures and
-        loud S_RETRY_AFTER rows) as an RspBatch in batch row order —
-        possibly empty; admitted rows resolve through later ``pump``
-        calls.  ``conn`` tags admitted rows so the pump can emit one
-        response batch per connection."""
+        loud S_RETRY_AFTER rows) — possibly empty; admitted rows resolve
+        through later ``pump`` calls.  ``conn`` tags admitted rows so
+        the pump can emit one response batch per connection: a scalar
+        tags the whole batch (one transport connection, the round-19
+        contract — refusals return as an RspBatch in batch row order),
+        while an int ndarray tags PER ROW (the round-21 shm merge path,
+        where one owner batch carries every worker's connections —
+        refusals return as {conn: RspBatch}, the same shape ``pump``
+        emits)."""
+        vec_conn = isinstance(conn, np.ndarray)
         now = self.clock()
         k = len(batch)
         self.requests += k
@@ -891,16 +978,18 @@ class ColumnarFrontend:
         ai = vi[~refused]
         if ai.size:
             # trace mint: adopt client-sampled wire ids, else sample on
-            # the monotone request index (same indices the scalar loop
-            # would use for these rows)
+            # the monotone request index (one vectorized splitmix64
+            # pass, bit-exact with the old per-row loop)
             trace = np.asarray(batch.trace[ai], np.uint16).copy()
             if self._sampler is not None:
                 base = self.requests - k
-                for j in np.nonzero(trace == 0)[0].tolist():
-                    trace[j] = self._sampler.sample(base + int(ai[j]))
+                z = np.nonzero(trace == 0)[0]
+                if z.size:
+                    trace[z] = self._sampler.sample_array(
+                        (base + ai[z]).astype(np.uint64))
             rg = self.ring
             slots = rg.alloc(int(ai.size))
-            rg.conn[slots] = conn
+            rg.conn[slots] = conn[ai] if vec_conn else conn
             rg.client_rid[slots] = batch.req_id[ai]
             rg.tenant[slots] = batch.tenant[ai]
             rg.kind[slots] = kind[ai]
@@ -914,8 +1003,26 @@ class ColumnarFrontend:
             rg.r_admit[slots] = self._rt().step_idx
             rg.status[slots] = _RING_OPEN
             if self.vbytes:
-                for j, s in zip(ai.tolist(), slots.tolist()):
-                    rg.data[s] = batch.row_data(j)
+                # payload tails land in the arena in one ragged pass
+                # (blob extents -> slot rows); gets carry vlen=-1 by
+                # the wire codec's rule, matching old row_data(None)
+                vl = (np.asarray(batch.vlen, np.int64)[ai]
+                      if batch.vlen is not None
+                      else np.full(ai.size, -1, np.int64))
+                vo = (np.asarray(batch.voff, np.int64)[ai]
+                      if batch.voff is not None
+                      else np.zeros(ai.size, np.int64))
+                # clamp defensively: the wire decoder already refuses
+                # dlen > vbytes, but a hand-built batch must not be
+                # able to scatter past its arena row
+                vl = np.minimum(vl, self.vbytes)
+                pl = np.maximum(vl, 0)
+                src = _codec.ragged_gather(
+                    np.frombuffer(batch.blob, np.uint8), vo, pl)
+                _codec.ragged_scatter(
+                    rg.heap.reshape(-1),
+                    slots.astype(np.int64) * self.vbytes, pl, src)
+                rg.dlen[slots] = vl
             else:
                 rg.value[slots] = (batch.value[ai]
                                    if batch.value is not None
@@ -931,9 +1038,7 @@ class ColumnarFrontend:
         di = np.nonzero(done)[0]
         nd = int(di.size)
         self.responses += nd
-        for tt, st in zip(batch.tenant[di].tolist(),
-                          status[di].tolist()):
-            self._resp_meta.append((int(tt), int(st), None))
+        self._resp_meta.extend(batch.tenant[di], status[di])
         rb = wire.RspBatch(
             status=status[di], reason=reason[di],
             req_id=np.asarray(batch.req_id)[di].astype(np.uint32),
@@ -945,7 +1050,13 @@ class ColumnarFrontend:
             rb.vlen = np.full(nd, -1, np.int64)
         else:
             rb.value = np.zeros((nd, self.u), np.int32)
-        return rb
+        if not vec_conn:
+            return rb
+        out: Dict[int, wire.RspBatch] = {}
+        cdi = np.asarray(conn)[di]
+        for cid in np.unique(cdi).tolist():
+            out[int(cid)] = rb.select(np.nonzero(cdi == cid)[0])
+        return out
 
     # -- resolution helpers --------------------------------------------------
 
@@ -963,8 +1074,7 @@ class ColumnarFrontend:
         if rg.value is not None:
             rg.value[slots] = 0
         else:
-            for s in slots.tolist():
-                rg.data[s] = None
+            rg.dlen[slots] = -1
 
     def _finish(self, slots: np.ndarray, now: float,
                 emit: List[np.ndarray]) -> None:
@@ -975,9 +1085,7 @@ class ColumnarFrontend:
         self.adm.note_resolved_batch(rg.tenant[slots], sts)
         self._count("deadline", int((sts == wire.S_DEADLINE).sum()))
         lats = now - rg.t_admit[slots]
-        for tt, st, lat in zip(rg.tenant[slots].tolist(), sts.tolist(),
-                               lats.tolist()):
-            self._resp_meta.append((tt, st, lat))
+        self._resp_meta.extend(rg.tenant[slots], sts, lats)
         self.responses += int(slots.size)
         traced = np.nonzero(rg.trace[slots] != 0)[0]
         if traced.size:
@@ -1003,18 +1111,20 @@ class ColumnarFrontend:
             has_uid=rg.has_uid[slots], step=rg.step[slots],
             retry_after_us=rg.retry_us[slots], uid=rg.uid[slots])
         if self.vbytes:
-            vlen = np.full(slots.size, -1, np.int64)
-            voff = np.zeros(slots.size, np.int64)
-            parts = []
-            off = 0
-            for j, s in enumerate(slots.tolist()):
-                d = rg.data[s]
-                if d is not None and rg.status[s] == wire.S_OK:
-                    vlen[j] = len(d)
-                    voff[j] = off
-                    parts.append(d)
-                    off += len(d)
-            rb.vlen, rb.voff, rb.blob = vlen, voff, b"".join(parts)
+            # one ragged gather straight off the slot arena replaces the
+            # per-row blob join (round-21): only S_OK rows with a
+            # payload contribute extents, same as the old loop
+            have = ((rg.status[slots] == wire.S_OK)
+                    & (rg.dlen[slots] >= 0))
+            vlen = np.where(have, rg.dlen[slots], -1)
+            plen = np.maximum(vlen, 0)
+            voff = np.concatenate(
+                ([0], np.cumsum(plen)[:-1])) if slots.size \
+                else np.zeros(0, np.int64)
+            blob = _codec.ragged_gather(
+                rg.heap.reshape(-1),
+                slots.astype(np.int64) * self.vbytes, plen)
+            rb.vlen, rb.voff, rb.blob = vlen, voff, blob.tobytes()
         else:
             rb.value = rg.value[slots]
         return rb
@@ -1067,7 +1177,7 @@ class ColumnarFrontend:
             slots = (np.concatenate(take) if len(take) > 1 else take[0])
             self._intake_len -= int(slots.size)
             if self.vbytes:
-                vals = [rg.data[s] for s in slots.tolist()]
+                vals = [rg.get_data(s) for s in slots.tolist()]
             else:
                 vals = rg.value[slots]
             bf = self.store.submit_batch(
@@ -1133,7 +1243,7 @@ class ColumnarFrontend:
                     ridx = np.nonzero(res)[0]
                     for j, s, keep in zip(ridx.tolist(), ds.tolist(),
                                           readable.tolist()):
-                        rg.data[s] = bf.data[j] if keep else None
+                        rg.set_data(s, bf.data[j] if keep else None)
                 ob["resolved"] |= res
                 self._finish(ds, now, emit)
             # completion-side deadline on rows the store still holds:
@@ -1210,8 +1320,7 @@ class ColumnarFrontend:
     def latencies(self, statuses=(wire.S_OK, wire.S_RMW_ABORT,
                                   wire.S_DEADLINE, wire.S_REJECTED,
                                   wire.S_LOST)) -> List[float]:
-        return [lat for _t, st, lat in self._resp_meta
-                if st in statuses and lat is not None]
+        return self._resp_meta.latencies(statuses)
 
     def counters(self) -> dict:
         per = self.adm.counters()

@@ -788,19 +788,44 @@ def decode_request_batch(buf: bytes, u: int, vbytes: int = 0) -> ReqBatch:
     return b
 
 
-def _decode_req_heads(M: np.ndarray) -> ReqBatch:
+def check_request_matrix(M: np.ndarray) -> None:
+    """Batch-wide magic + kind triage of a (k, >=_REQ.size) request
+    record matrix, WITHOUT building columns — the shm IPC worker's
+    cheap validation pass before raw records land in ring slots
+    (serving/ipc.py): a front-end process can refuse a garbage stream
+    loudly while leaving the column decode to the store owner.  Raises
+    ValueError with the struct decoder's triage wording."""
     k = M.shape[0]
     magic = _get_col(M, 0, "<u2")
     if k and (magic != REQ_MAGIC).any():
         i = int(np.nonzero(magic != REQ_MAGIC)[0][0])
         raise ValueError(f"bad request magic 0x{int(magic[i]):04x} "
                          f"at row {i}")
-    kind = M[:, 2].copy()
+    kind = M[:, 2]
     if k and not np.isin(kind, _REQ_KINDS).all():
         bad = int(kind[~np.isin(kind, _REQ_KINDS)][0])
         raise ValueError(f"unknown wire op kind {bad}")
+
+
+def decode_request_matrix(M: np.ndarray, u: int) -> ReqBatch:
+    """Fixed-mode column decode of a (k, req_nbytes(u)) record matrix
+    that ALREADY lives in memory as rows — the zero-copy shm path: ring
+    slots hold raw record matrices, the store owner decodes the merged
+    matrix once, no intermediate ``bytes`` round-trip
+    (``decode_request_batch`` is this plus the byte-stream framing)."""
+    if M.shape[1] != req_nbytes(u):
+        raise ValueError(f"record matrix is {M.shape[1]} bytes/row, "
+                         f"want {req_nbytes(u)} for u={u}")
+    b = _decode_req_heads(M)
+    b.value = np.ascontiguousarray(M[:, _REQ.size:]).view(
+        np.int32).reshape(M.shape[0], u)
+    return b
+
+
+def _decode_req_heads(M: np.ndarray) -> ReqBatch:
+    check_request_matrix(M)
     return ReqBatch(
-        kind=kind, req_id=_get_col(M, 4, "<u4"),
+        kind=M[:, 2].copy(), req_id=_get_col(M, 4, "<u4"),
         tenant=_get_col(M, 8, "<u2"), trace=_get_col(M, 10, "<u2"),
         deadline_us=_get_col(M, 12, "<u4"), key=_get_col(M, 16, "<i8"))
 

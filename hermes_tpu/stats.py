@@ -16,7 +16,6 @@ from __future__ import annotations
 
 from typing import IO, Optional
 
-import jax
 import numpy as np
 
 from hermes_tpu.obs.metrics import JsonlExporter, percentile_from_counts
@@ -50,6 +49,12 @@ def summarize(meta, wall_s: Optional[float] = None, steps: Optional[int] = None,
     replica recorded them (faststep under cfg.phase_metrics — the phases
     engine leaves them 0).  ``hists=True`` attaches the raw histogram
     arrays, which scripts/obs_report.py renders."""
+    # jax is imported lazily: this module sits on the serving import path
+    # (soak -> stats) and the shm IPC worker processes (serving/ipc.py)
+    # must come up without paying the jax import — only ``summarize``,
+    # which handles device pytrees, needs it
+    import jax
+
     m = jax.device_get(meta)
 
     def tot(field):

@@ -2,7 +2,7 @@
 seeded, gated failure mode — the front-end must shed loudly, honor
 deadlines, and degrade gracefully rather than wedge.
 
-Five assertions, CPU-smoke sized (joins the eight earlier gates in
+Seven legs, CPU-smoke sized (joins the earlier gates in
 scripts/run_gates.py — gates run SERIALLY, never beside pytest):
 
   1. overload soak, both engines — an open-loop Poisson soak at >= 2x
@@ -39,6 +39,21 @@ scripts/run_gates.py — gates run SERIALLY, never beside pytest):
      serving-throughput FLOOR: >= 50x the PR-10 scalar closed-loop
      baseline cell recorded in BENCH_LATENCY.json, cell-vs-cell on this
      host (the floor cell is carried into GATES_SUMMARY.json by
+     run_gates.py);
+  7. round-21 shm IPC plane — (a) the deterministic one-store soak over
+     REAL shm rings, offered 2x the rings' total slot capacity so the
+     backpressure path must cycle every slot: conservation exact across
+     the ring boundary (verify_columnar), checker green,
+     committed_write_lost == [] against the client-visible uid set,
+     and a byte-identical per-worker response-log replay; (b) the REAL
+     multi-process topology — 2 worker processes sharding accepts on
+     one port feeding ONE store, every batched request answered loudly,
+     frontend conservation exact, then kill -9 of one worker mid-run:
+     the store and the surviving worker keep serving, the dead worker's
+     clients see EOF (never a hang); (c) the recorded one_store floor —
+     BENCH_LATENCY.json's one_store_workers_2 cell must sustain >= 2x
+     the columnar_loopback cell, cell-vs-cell, with honest topology
+     labels (one_store cells carried into GATES_SUMMARY.json by
      run_gates.py).
 
     env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -455,6 +470,162 @@ def check_columnar(report: dict) -> None:
             fl["ops_per_sec"] / current_scalar, 1)
 
 
+def check_shm(report: dict) -> None:
+    """Round-21 shm leg (docstring item 7): the one-store IPC plane —
+    ring-soak conservation + replay, the real-process topology with a
+    kill -9 sub-leg, and the recorded one_store throughput floor."""
+    import numpy as np
+
+    from hermes_tpu.checker import linearizability as lin
+    from hermes_tpu.config import HermesConfig
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.serving import wire
+    from hermes_tpu.serving.ipc import OneStoreServer, run_shm_soak
+
+    # (a) deterministic soak over REAL shm rings: 2 workers, 4 slots of
+    # 64 rows each per ring (256-row capacity), 512 ops per worker — 2x
+    # the ring capacity, so every slot is claimed, committed, polled and
+    # acked at least twice and the ring-full skip path must engage
+    kw = dict(n_workers=2, ops_per_worker=512, batch=64, nslots=4,
+              seed=SEED)
+    runs = [run_shm_soak(**kw) for _ in range(2)]
+    a = runs[0]
+    assert a["ok"] and a["checker_ok"], a
+    assert a["worker_log_sha"] == runs[1]["worker_log_sha"], (
+        "shm soak replayed to DIFFERENT per-worker response logs "
+        f"({a['worker_log_sha']} vs {runs[1]['worker_log_sha']})")
+    assert a["ipc"] == runs[1]["ipc"] \
+        and a["verify"] == runs[1]["verify"], (
+        "shm soak counters differ across replays")
+    ipc, ver = a["ipc"], a["verify"]
+    assert ipc["rows_in"] == ipc["rows_out"] == 1024, ipc
+    assert ipc["torn_slots"] == 0 and ipc["dead_drop_rows"] == 0, ipc
+    assert ipc["dead_workers"] == [], ipc
+    # conservation across the ring boundary: every row in is a row out,
+    # every request the frontend accepted is resolved, nothing lost
+    assert ver["requests"] == ver["responses"] == 1024, ver
+    assert ver["lost"] == 0, ver
+    assert a["_client_uids"], "shm soak committed nothing the client saw"
+    store = a["_store"]
+    lost = lin.committed_write_lost(a["_client_uids"],
+                                    store.rt.history_ops(),
+                                    store.rt.recorder.aborted_uids)
+    assert not lost, (
+        f"shm soak: committed-and-observed writes contradicted by the "
+        f"history: {lost[:4]}")
+    report["shm_soak"] = {k: v for k, v in a.items()
+                          if not k.startswith("_")}
+    report["shm_replay_identical"] = True
+
+    # (b) the REAL topology: 2 shm worker processes sharding accepts on
+    # one SO_REUSEPORT port, all feeding ONE store.  4 clients push 4096
+    # rows total — 2x the rings' combined 2048-row slot capacity — then
+    # worker 0 is SIGKILLed and the survivors must keep answering while
+    # the dead worker's clients see EOF, loudly, never a hang.
+    import os as _os
+    import signal
+    import time
+
+    def _shm_batch(cl, u, n_keys, rng, tenant, k=64):
+        kind = np.where(rng.random(k) < 0.5, wire.K_GET,
+                        wire.K_PUT).astype(np.uint8)
+        return wire.ReqBatch(
+            kind=kind, req_id=cl.next_ids(k),
+            tenant=np.full(k, tenant, np.uint16),
+            trace=np.zeros(k, np.uint16),
+            deadline_us=np.zeros(k, np.uint32),
+            key=rng.integers(0, n_keys, k).astype(np.int64),
+            value=rng.integers(0, 99, (k, u)).astype(np.int32))
+
+    from hermes_tpu.serving.rpc import ColumnarClient
+
+    cfg = HermesConfig(n_replicas=4, n_keys=1 << 10, n_sessions=64,
+                       value_words=6)
+    scfg = _scfg(tenant_rate_per_s=1e9, tenant_burst=1e9,
+                 tenant_quota=1 << 20, queue_cap=4096)
+    store = KVS(cfg)
+    srv = OneStoreServer(store, scfg, n_workers=2, nslots=8,
+                         slot_rows=128)
+    rng = np.random.default_rng(SEED)
+    answered = retried = 0
+    try:
+        assert srv.alive() == 2, "one-store server booted short"
+        clients = [ColumnarClient(srv.addr, srv.fe.u) for _ in range(4)]
+        for _ in range(16):  # 4 clients x 16 batches x 64 = 4096 rows
+            for ci, cl in enumerate(clients):
+                out = cl.call_batch(
+                    _shm_batch(cl, srv.fe.u, cfg.n_keys, rng, ci))
+                assert len(out) == 64, "one-store round trip dropped rows"
+                for r in out.values():
+                    assert r.status in (wire.S_OK, wire.S_RETRY_AFTER), r
+                    answered += 1
+                    retried += r.status == wire.S_RETRY_AFTER
+        # the kill sub-leg
+        _os.kill(srv.procs[0].pid, signal.SIGKILL)
+        srv.procs[0].join(5)
+        assert srv.alive() == 1, "SIGKILL left the worker alive"
+        time.sleep(0.5)
+        survived = eof = 0
+        for ci, cl in enumerate(clients):
+            try:
+                out = cl.call_batch(
+                    _shm_batch(cl, srv.fe.u, cfg.n_keys, rng, ci))
+                assert len(out) == 64
+                survived += 1
+            except (ConnectionError, OSError):
+                eof += 1
+        assert survived >= 1 and survived + eof == 4, (
+            f"worker kill: {survived} survived + {eof} EOF != 4 clients")
+        assert srv.pump_error is None, srv.pump_error
+        for cl in clients:
+            cl.close()
+    finally:
+        srv.close()
+    assert srv.owner.dead[0] and not srv.owner.dead[1], (
+        "owner did not tombstone exactly the killed worker")
+    assert srv.fe.requests == srv.fe.responses, (
+        f"one-store conservation broke across the worker kill: "
+        f"{srv.fe.requests} requests vs {srv.fe.responses} responses")
+    report["one_store_topology"] = dict(
+        workers=2, clients=4, rows_answered=answered,
+        retry_after=int(retried), kill_survived=survived, kill_eof=eof,
+        ipc=srv.owner.counters(),
+        requests=srv.fe.requests, responses=srv.fe.responses)
+
+    # (c) the recorded one_store floor, cell-vs-cell on this host: >= 2
+    # worker processes feeding ONE store must sustain >= 2x the single-
+    # process columnar_loopback cell, with honest topology labels
+    bench_path = os.path.join(os.path.dirname(__file__), "..",
+                              "BENCH_LATENCY.json")
+    assert os.path.exists(bench_path), (
+        "BENCH_LATENCY.json missing — run `python bench.py --serve` "
+        "to record the one_store floor cell")
+    with open(bench_path) as f:
+        cells = json.load(f).get("cells", {})
+    lb_cell = cells.get("columnar_loopback", {})
+    os_cell = cells.get("one_store_workers_2", {})
+    assert lb_cell.get("ops_per_sec") and not lb_cell.get("error"), lb_cell
+    assert os_cell.get("ops_per_sec") and not os_cell.get("error"), (
+        f"one_store_workers_2 cell missing or error-carrying: {os_cell}")
+    assert os_cell.get("topology") == "one-store", os_cell
+    for w_cell in ("columnar_workers_2", "columnar_workers_4"):
+        c = cells.get(w_cell)
+        if isinstance(c, dict) and not c.get("error"):
+            assert c.get("topology") == "private-store-per-worker", (
+                f"{w_cell} lost its honesty label: {c}")
+    ratio = float(os_cell["ops_per_sec"]) / float(lb_cell["ops_per_sec"])
+    assert ratio >= 2.0, (
+        f"one_store floor MISSED: one_store_workers_2 "
+        f"{os_cell['ops_per_sec']} ops/s is only {ratio:.2f}x the "
+        f"columnar_loopback cell {lb_cell['ops_per_sec']} (need >= 2x)")
+    report["one_store_floor"] = dict(
+        ops_per_sec=os_cell["ops_per_sec"],
+        loopback_ops_per_sec=lb_cell["ops_per_sec"],
+        speedup_vs_loopback=round(ratio, 2),
+        required_speedup=2.0, workers=os_cell.get("workers"),
+        statuses=os_cell.get("statuses"))
+
+
 def main() -> int:
     report: dict = {"gate": "serving"}
     try:
@@ -463,6 +634,7 @@ def main() -> int:
         check_overload_storm(report)
         check_read_soak(report)
         check_columnar(report)
+        check_shm(report)
     except AssertionError as e:
         report["ok"] = False
         report["error"] = str(e)

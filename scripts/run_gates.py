@@ -179,15 +179,34 @@ def main() -> int:
         # perf number — carry it into the summary's gates block so a
         # regression is visible without digging into the full report
         if r["gate"] == "serving":
+            out = {}
             cell = r["report"].get("columnar_floor")
-            if not isinstance(cell, dict):
-                return {}
-            keep = ("ops_per_sec", "required_ops_per_sec",
-                    "scalar_baseline_ops_per_sec", "speedup_vs_scalar",
-                    "current_scalar_ops_per_sec",
-                    "speedup_vs_current_scalar")
-            return {"columnar_floor": {k: cell[k]
-                                       for k in keep if k in cell}}
+            if isinstance(cell, dict):
+                keep = ("ops_per_sec", "required_ops_per_sec",
+                        "scalar_baseline_ops_per_sec", "speedup_vs_scalar",
+                        "current_scalar_ops_per_sec",
+                        "speedup_vs_current_scalar")
+                out["columnar_floor"] = {k: cell[k]
+                                         for k in keep if k in cell}
+            # round-21: the shm leg's one-store floor (>= 2 worker
+            # processes feeding ONE store vs the single-process loopback
+            # cell) and the replay/kill verdicts are tracked numbers too
+            cell = r["report"].get("one_store_floor")
+            if isinstance(cell, dict):
+                keep = ("ops_per_sec", "loopback_ops_per_sec",
+                        "speedup_vs_loopback", "required_speedup",
+                        "workers")
+                out["one_store_floor"] = {k: cell[k]
+                                          for k in keep if k in cell}
+            if "shm_replay_identical" in r["report"]:
+                out["shm_replay_identical"] = (
+                    r["report"]["shm_replay_identical"])
+            topo = r["report"].get("one_store_topology")
+            if isinstance(topo, dict):
+                out["one_store_kill_leg"] = dict(
+                    survived=topo.get("kill_survived"),
+                    eof=topo.get("kill_eof"))
+            return out
         # round-20: the hostlint gate's per-leg timing + verdicts
         if r["gate"] == "hostlint":
             legs = r["report"].get("legs")
