@@ -42,6 +42,8 @@ Everything lands on the obs timeline as a ``crash_restart`` event
 
 from __future__ import annotations
 
+import dataclasses
+import time
 import zipfile
 from typing import Optional
 
@@ -140,13 +142,18 @@ def _snapshot_rows_current(rt, replica: int, donor: int,
 
 
 def restart_replica(target, replica: int, donor: Optional[int] = None,
-                    snapshot_path: Optional[str] = None) -> dict:
+                    snapshot_path: Optional[str] = None,
+                    wal_dir: Optional[str] = None) -> dict:
     """Full host-crash + recovery of ``replica`` on a FastRuntime or a KVS
     facade (see module docstring).  ``donor`` defaults to the lowest live,
     unfrozen peer; ``snapshot_path`` opts into snapshot-seeded restore
     (falls back to pure peer transfer when the snapshot is invalid).
-    Returns a summary dict (also emitted as the ``crash_restart`` obs
-    event)."""
+    ``wal_dir`` (round-22) additionally replays the durability log's tail
+    into the rejoined replica's table copy AFTER the join transfer —
+    idempotent catch-up for records the donor already re-validated, real
+    catch-up when the whole cluster restarted from a snapshot and the
+    donor itself came back via ``recover_store``.  Returns a summary dict
+    (also emitted as the ``crash_restart`` obs event)."""
     kvs = None
     if hasattr(target, "rt") and hasattr(target, "index"):  # the KVS facade
         kvs, rt = target, target.rt
@@ -200,8 +207,87 @@ def restart_replica(target, replica: int, donor: Optional[int] = None,
     # re-validates the donor's in-flight keys (runtime.join semantics)
     rt.join(replica, donor)
 
+    # 5. round-22 WAL tail catch-up: replay the durability log into the
+    # rejoined copy only (sharded; the batched table is shared).  Replay
+    # is idempotent by packed ts, so records the donor transfer already
+    # covered are no-ops — this is the fence-until-caught-up step for
+    # snapshot-seeded restores whose log tail outran the snapshot.
+    wal_applied = wal_skipped = None
+    if wal_dir is not None:
+        from hermes_tpu.wal import replay as wal_replay
+
+        scan = wal_replay.read_records(wal_dir, obs=rt.obs)
+        wal_replay.check_headers(scan["headers"], cfg, obs=rt.obs)
+        wal_applied, wal_skipped = wal_replay.apply_records(
+            rt, scan["records"], heap=getattr(kvs, "heap", None),
+            replicas=[replica])
+
     summary = dict(replica=replica, donor=donor, source=source,
                    lost_ops=lost_ops, lost_client_futures=lost_client,
                    rows_current=rows_current)
+    if wal_dir is not None:
+        summary.update(wal_applied=wal_applied, wal_skipped=wal_skipped)
     rt._trace("crash_restart", **summary)
     return summary
+
+
+def recover_store(cfg, wal_dir: Optional[str] = None,
+                  backend: str = "batched", mesh=None,
+                  snapshot_path: Optional[str] = None, record=False,
+                  sparse_keys: bool = False):
+    """Round-22 whole-store recovery: bring a killed store back with ZERO
+    committed writes lost.  The power-cord sequence:
+
+      1. parse + triage the WAL segments FIRST (wal.replay.read_records —
+         a torn tail truncates cleanly, a torn interior refuses loudly
+         with a flight dump; nothing is built on a corrupt log);
+      2. build a fresh KVS on the same config/wal_dir (its log continues
+         the segment sequence numbering);
+      3. restore the last snapshot if given (snapshot.load — verified
+         manifest, all-or-nothing);
+      4. replay the log through the table apply machinery, idempotent by
+         packed ts (records the snapshot covers are no-ops), minting
+         fresh heap refs from the logged extent bytes;
+      5. fence: resume step_idx past every replayed commit step, so the
+         recovered store can never re-mint a replayed round's step;
+      6. re-append the surviving records into the FRESH log and retire
+         the old segments — the new log alone now covers the recovered
+         state, and heap refs in it are the LIVE ones.
+
+    Returns ``(kvs, summary)``."""
+    from hermes_tpu.kvs import KVS
+    from hermes_tpu.wal import replay as wal_replay
+
+    t0 = time.perf_counter()
+    wal_dir = wal_dir if wal_dir is not None else cfg.wal_dir
+    if wal_dir is None:
+        raise ValueError("recover_store needs a wal_dir (argument or "
+                         "cfg.wal_dir)")
+    cfg = dataclasses.replace(cfg, wal_dir=wal_dir)
+    scan = wal_replay.read_records(wal_dir)
+    wal_replay.check_headers(scan["headers"], cfg)
+    kvs = KVS(cfg, backend=backend, mesh=mesh, record=record,
+              sparse_keys=sparse_keys)
+    if snapshot_path is not None:
+        snapshot_lib.load(snapshot_path, kvs)
+    applied, skipped = wal_replay.apply_records(
+        kvs.rt, scan["records"], heap=kvs.heap)
+    max_step = max((int(r["step"].max()) for r in scan["records"]
+                    if r["step"].size), default=-1)
+    kvs.rt.step_idx = max(kvs.rt.step_idx, max_step + 1)
+    kvs.rt._ctl_dirty = True
+    for rec in scan["records"]:
+        kvs.wal.append_round(rec["round_idx"], rec["step"], rec["key"],
+                             rec["ver"], rec["fc"], rec["wv"],
+                             rec["lens"], rec["blob"])
+    kvs.wal.sync()
+    kvs.wal.retire_segments(scan["segments"])
+    summary = dict(records=sum(int(r["key"].shape[0])
+                               for r in scan["records"]),
+                   applied=applied, skipped=skipped,
+                   torn_tail=bool(scan["torn_tail"]),
+                   old_segments=len(scan["segments"]),
+                   resume_step=int(kvs.rt.step_idx),
+                   seconds=round(time.perf_counter() - t0, 3))
+    kvs.rt._trace("wal_recover", **summary)
+    return kvs, summary

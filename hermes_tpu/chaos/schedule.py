@@ -59,7 +59,13 @@ EVENT_KINDS = ("freeze", "thaw", "remove", "join", "crash_restart",
                # round-14 overload adversary: multiply the attached load
                # shaper's open-loop arrival rate by x for a window — the
                # serving front-end's first-class, seeded failure mode
-               "overload", "overload_clear")
+               "overload", "overload_clear",
+               # round-22 durability adversary: SIGKILL the WHOLE store
+               # process mid-soak (no flush, no close — the kill -9 shape
+               # the WAL exists for).  Carried by an attached callable
+               # (the gate's soak child kills itself; the parent recovers
+               # via chaos.recovery.recover_store)
+               "powercut")
 
 # round-11 verb -> FaultingTransport wire op.  The legacy net_* verbs keep
 # their NetChaos routing (sim-transport schedule windows) but fall back to
@@ -361,7 +367,13 @@ class ChaosRunner:
     with ``snapshot_every`` > 0 the runner refreshes the snapshot itself
     at that cadence (fast engines, quiescent boundaries only — the KVS
     save requires no in-flight client ops, so the runner snapshots the
-    RUNTIME under the facade)."""
+    RUNTIME under the facade).
+    ``powercut``: the round-22 whole-process kill carrier — a callable
+    ``powercut(step)`` that SIGKILLs the store process (in the durability
+    gate's soak child: ``os.kill(os.getpid(), signal.SIGKILL)``).  It is
+    expected NOT to return; schedules with powercut lines are refused at
+    construction when no carrier is attached, same contract as the wire
+    verbs."""
 
     def __init__(self, target, schedule: Schedule,
                  spec: Optional[ChaosSpec] = None,
@@ -369,6 +381,7 @@ class ChaosRunner:
                  wire=None,
                  load=None,
                  snapshot_path: Optional[str] = None,
+                 powercut: Optional[Callable[[int], None]] = None,
                  on_step: Optional[Callable[[int], None]] = None):
         self.kvs = target if (hasattr(target, "rt")
                               and hasattr(target, "index")) else None
@@ -384,6 +397,8 @@ class ChaosRunner:
         # anything with set_rate_x) the overload verbs act on
         self.load = load
         self._overload_until: Optional[int] = None
+        # round-22: the whole-process kill carrier (see class docstring)
+        self.powercut = powercut
         self.snapshot_path = snapshot_path
         self.on_step = on_step
         self.log: List[dict] = []
@@ -422,7 +437,16 @@ class ChaosRunner:
         part_lines = [e for e in self.schedule if e.kind == "partition"]
         over_lines = [e for e in self.schedule
                       if e.kind in ("overload", "overload_clear")]
+        cut_lines = [e for e in self.schedule if e.kind == "powercut"]
         name = self._transport_name()
+        if cut_lines and self.powercut is None:
+            ls = ", ".join(e.format() for e in cut_lines[:3])
+            raise ValueError(
+                f"schedule contains powercut events ({ls}) but no kill "
+                "carrier is attached: a powercut SIGKILLs the WHOLE store "
+                "process, which only a harness can arrange — pass "
+                "ChaosRunner(..., powercut=<callable(step)>) (the "
+                "durability gate's soak child kills its own pid)")
         if over_lines and self.load is None:
             ls = ", ".join(e.format() for e in over_lines[:3])
             raise ValueError(
@@ -608,6 +632,15 @@ class ChaosRunner:
             self._overload_until = None
             rt._trace("overload_clear")
             self._note(step, "overload_clear")
+        elif e.kind == "powercut":
+            # note + trace BEFORE the carrier fires: it SIGKILLs this
+            # process and does not return, so this log line (and whatever
+            # the trace fsyncs) is all the forensic record the parent gets
+            self._note(step, "powercut")
+            rt._trace("powercut", step=step)
+            self.powercut(step)
+            # a mock carrier (tests) may return; nothing to clean up —
+            # the real one never reaches here
 
     def _expire_overload(self, step: int) -> None:
         """Close an overload window whose ``until`` elapsed (explicit

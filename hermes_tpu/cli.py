@@ -228,6 +228,19 @@ def build_parser() -> argparse.ArgumentParser:
                     "graph report — per-lock acquires/contention/"
                     "hold-p99, edge count, any potential-deadlock "
                     "cycles — to the JSON summary line")
+    ap.add_argument("--wal-dir", type=str, default=None, metavar="DIR",
+                    help="enable the round-22 durability tier: append "
+                    "every committed write to a CRC-framed write-ahead "
+                    "extent+commit log under DIR (created if missing); "
+                    "recover a killed store with "
+                    "chaos.recovery.recover_store")
+    ap.add_argument("--wal-sync", choices=["commit", "round", "off"],
+                    default="commit",
+                    help="WAL durability mode (with --wal-dir): 'commit' "
+                    "resolves a write to the client only after its group-"
+                    "commit fsync (the zero-loss contract); 'round' and "
+                    "'off' resolve immediately and LABEL completions "
+                    "'<mode>:not-fsynced-at-resolve'")
     ap.add_argument("--profile-out", type=str, default=None,
                     metavar="PROFILE_JSONL",
                     help="write the run config's round op census + cost-model"
@@ -766,6 +779,8 @@ def main(argv=None) -> int:
         op_retry_limit=args.op_retries,
         min_healthy_for_writes=args.degraded_floor,
         trace_sample=args.trace_sample,
+        wal_dir=args.wal_dir,
+        wal_sync=args.wal_sync,
         workload=WorkloadConfig(
             distribution=args.distribution,
             zipf_theta=args.zipf_theta,
@@ -842,7 +857,20 @@ def main(argv=None) -> int:
         # fast backends use the columnar recorder + native witness checker
         rt = FastRuntime(cfg, backend=backend, mesh=mesh,
                          record="array" if args.check else False)
+        if cfg.use_wal:
+            # round-22: the raw workload drive taps the WAL straight off
+            # the harvest path (no KVS client layer, so no commit-gated
+            # futures here — the serving paths get those; this drive
+            # logs every committed write and group-commits in the
+            # background, with a final sync before exit)
+            from hermes_tpu.wal import GroupCommitWal
+
+            rt.attach_wal(GroupCommitWal(cfg))
     else:
+        if cfg.use_wal:
+            ap.error("--wal-dir rides the fast engines' harvest path; "
+                     f"the {args.backend!r} backend has no WAL tap "
+                     "(use --backend fast or fast-sharded)")
         rt = Runtime(cfg, backend=args.backend, mesh=mesh, record=args.check)
 
     if args.profile:
@@ -914,6 +942,17 @@ def main(argv=None) -> int:
             import jax
 
             jax.profiler.stop_trace()
+        if getattr(rt, "wal", None) is not None:
+            # round-22: force the final group commit out and stop the
+            # flusher — the drive's last rounds must be on disk before
+            # the summary line claims them committed
+            rt.wal.sync()
+            rec = rt.wal.stats()
+            print(f"wal: {rec['records']} record(s), {rec['fsyncs']} "
+                  f"fsync(s), {rec['bytes']} byte(s), "
+                  f"{rec['segments']} segment(s), sync={rec['sync']}",
+                  file=sys.stderr)
+            rt.wal.close()
     wall = time.perf_counter() - t0
 
     # one Meta readback: the run-log summary carries the raw histograms

@@ -295,6 +295,36 @@ REGISTRY: Tuple[ClassGuards, ...] = (
                          "NOT thread-safe)", "*"),),
     ),
     ClassGuards(
+        cls="GroupCommitWal", module="hermes_tpu.wal.log",
+        locks=("_lock",),
+        guards=(Guard("_lock", ("_buf", "_next_lsn", "_durable_lsn",
+                                "_dirty", "_flush_evt")),),
+        audited=(
+            audited("threading.Event is internally synchronized",
+                    "_stop", "_wake"),
+            audited("flusher-thread-private: the open segment file and "
+                    "its rotation bookkeeping are touched only by the "
+                    "flusher (close() joins it before the final seal)",
+                    "_f", "_seg_path", "_seg_bytes", "_seg_max_step",
+                    "_sealed_steps", "_seg_seq"),
+            audited("single-writer-publish: set once by the dying "
+                    "flusher thread; every other thread only polls it",
+                    "_error"),
+            audited("gil-atomic counters: stats-only, exact durability "
+                    "accounting rides _durable_lsn under _lock",
+                    "records", "rounds", "remaps", "fsyncs", "wal_bytes",
+                    "retired_segments"),
+        ),
+        thread_owner="_flusher_t",
+        notes="the group-commit split: producers only append to _buf "
+              "and bump _next_lsn under _lock; the flusher drains the "
+              "batch under _lock but encodes/writes/fsyncs with the "
+              "lock RELEASED (the whole point — fsync off the hot "
+              "path), then re-acquires to publish _durable_lsn and "
+              "swap the generation Event.  sync() waits on the Event "
+              "outside the lock.",
+    ),
+    ClassGuards(
         cls="LockGraph", module="hermes_tpu.analysis.lockgraph",
         locks=("_graph_lock",),
         guards=(Guard("_graph_lock", ("_edges", "_stats", "_registry")),),

@@ -9,6 +9,7 @@ lanes) is static so a config maps 1:1 to a compiled XLA program.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Literal, Optional, Tuple
 
 from hermes_tpu.core import layouts
@@ -281,6 +282,31 @@ class HermesConfig:
     # and on allocation pressure (kvs.KVS.heap_gc).
     heap_bytes: int = 1 << 22
 
+    # Round-22 durability tier (hermes_tpu/wal): a host-side write-ahead
+    # extent+commit log fed from the harvest path.  ``wal_dir`` names the
+    # segment directory (None disables — the pre-round-22 snapshot-bounded
+    # crash model).  A dedicated flusher thread group-commits records
+    # across rounds with one fsync per batch; ``wal_sync`` picks the
+    # durability contract a client completion carries:
+    #   "commit" — a write's future resolves only after its log record is
+    #              fsync-durable (zero committed writes lost on power cut);
+    #   "round"  — records are written+fsynced by the group-commit flusher
+    #              but completions do NOT wait for it (a crash can lose
+    #              the last dirty window; completions are loudly labeled);
+    #   "off"    — records are written but never fsynced (page-cache
+    #              durability only; loudly labeled).
+    wal_dir: Optional[str] = None
+    wal_sync: Literal["commit", "round", "off"] = "commit"
+    # Segment rotation size: a segment past this many bytes is sealed
+    # (fsynced) and a fresh one opened, so snapshot-save truncation can
+    # drop whole sealed segments behind the snapshot step.
+    wal_segment_bytes: int = 1 << 20
+    # Backpressure bound: with more than this many appended-but-not-yet-
+    # durable records, NEW puts/RMWs are shed loudly at submission
+    # (kind='retry_after' / C_RETRY_AFTER) instead of silently stalling
+    # behind a slow disk.
+    wal_dirty_window: int = 256
+
     # Generate the op stream ON DEVICE from a counter hash instead of
     # gathering pre-generated arrays (SURVEY.md §2 "in-kernel PRNG"):
     # removes the stream-gather ops from the hot round.  Uniform or
@@ -393,6 +419,16 @@ class HermesConfig:
                     f"heap_bytes {self.heap_bytes} cannot hold two "
                     f"max_value_bytes={self.max_value_bytes} extents plus "
                     "the reserved null granule")
+        if self.wal_sync not in ("commit", "round", "off"):
+            raise ValueError("wal_sync must be 'commit', 'round' or 'off'")
+        if self.wal_segment_bytes < 4096:
+            raise ValueError(
+                "wal_segment_bytes must be >= 4096 (a segment must hold "
+                "its own header frame plus at least one record frame)")
+        if self.wal_dirty_window < 1:
+            raise ValueError(
+                "wal_dirty_window must be >= 1 (0 would shed every write; "
+                "disable the WAL with wal_dir=None instead)")
         # Unique write ids are (hi=replica, lo=session*G+op) int32 pairs.
         if self.n_sessions * self.ops_per_session >= 2**31:
             raise ValueError("n_sessions * ops_per_session must fit int32")
@@ -444,6 +480,12 @@ class HermesConfig:
         """Round-17 value-heap switch: variable-length byte values through
         the HBM append log (hermes_tpu/heap)."""
         return self.max_value_bytes > 0
+
+    @property
+    def use_wal(self) -> bool:
+        """Round-22 durability-tier switch: the host-side write-ahead
+        extent+commit log (hermes_tpu/wal)."""
+        return self.wal_dir is not None
 
     @property
     def heap_granules(self) -> int:
@@ -569,6 +611,14 @@ class FleetConfig:
         wl = over.pop("workload", self.base.workload)
         if self.vary_seed:
             wl = dataclasses.replace(wl, seed=wl.seed + g)
-        return dataclasses.replace(self.base, workload=wl, **over)
+        cfg = dataclasses.replace(self.base, workload=wl, **over)
+        # Round-22: each group logs into its own WAL subdirectory — one
+        # group's recovery must never replay another group's records (same
+        # scoping rule as per-group snapshots).  An explicit per-group
+        # wal_dir override wins.
+        if cfg.wal_dir is not None and "wal_dir" not in over:
+            cfg = dataclasses.replace(
+                cfg, wal_dir=os.path.join(cfg.wal_dir, f"group{g:03d}"))
+        return cfg
 
 

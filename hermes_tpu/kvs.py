@@ -59,6 +59,13 @@ C_LOST = -2
 # owner (keyindex.RangeRouter names it).  Distinct from C_LOST: a
 # rejected op definitively did NOT happen; a lost op is a maybe.
 C_REJECTED = -3
+# client-level completion code for updates shed by WAL backpressure
+# (round-22, cfg.wal_dirty_window): the durability log's dirty window is
+# full — the write never entered the store (no history impact, no slot
+# claimed); the client retries after the flusher drains.  Loud shed,
+# never a silent stall behind a slow disk.  Negative on purpose, like
+# its siblings above.
+C_RETRY_AFTER = -4
 
 
 class StuckOpError(RuntimeError):
@@ -88,7 +95,10 @@ class Completion:
 
     # 'get' | 'put' | 'rmw' | 'rmw_abort' | 'lost' (replica crash; op MAY
     # have applied) | 'rejected' (elastic fence/retire; op definitively
-    # did NOT apply — retry against the range's new owner)
+    # did NOT apply — retry against the range's new owner) |
+    # 'retry_after' (round-22 WAL backpressure: the durability log's
+    # dirty window is full; op definitively did NOT apply — retry after
+    # the flusher drains)
     kind: str
     key: int
     value: Optional[List[int]] = None  # payload read (get / rmw read-part)
@@ -112,6 +122,15 @@ class Completion:
     # under its own session token (the serving front-end does exactly
     # this per tenant)
     ts: Optional[Tuple[int, int]] = None
+    # round-22 durability contract this completion was resolved under
+    # (committed updates on a WAL-enabled store only, else None):
+    #   'commit'              — the write's log record was fsync-durable
+    #                           BEFORE this completion resolved;
+    #   'round:not-fsynced-at-resolve' / 'off:not-fsynced-at-resolve'
+    #                         — relaxed modes, loudly labeled: the record
+    #                           was appended but this resolution did not
+    #                           wait for the fsync.
+    durability: Optional[str] = None
 
 
 class Future:
@@ -166,6 +185,10 @@ class BatchFutures:
         # can pin read fences too
         self.tsv = np.zeros(n, np.int64)
         self.tsf = np.zeros(n, np.int32)
+        # round-22: the store's durability label for committed updates
+        # (one per store, not per op — set by submit_batch, surfaced in
+        # completion())
+        self.durability: Optional[str] = None
 
     def __len__(self) -> int:
         return self.code.shape[0]
@@ -187,6 +210,9 @@ class BatchFutures:
         if c == C_REJECTED:
             return Completion(kind="rejected", key=int(self.key[i]),
                               step=int(self.step[i]), found=False)
+        if c == C_RETRY_AFTER:
+            return Completion(kind="retry_after", key=int(self.key[i]),
+                              step=int(self.step[i]), found=False)
         kind = ("rmw_abort" if c == t.C_RMW_ABORT
                 else self._KINDSTR[int(self.kind[i])])
         done = Completion(kind=kind, key=int(self.key[i]),
@@ -197,6 +223,7 @@ class BatchFutures:
         if c in (t.C_WRITE, t.C_RMW):
             done.uid = (int(self.uid[i, 0]), int(self.uid[i, 1]))
             done.ts = (int(self.tsv[i]), int(self.tsf[i]))
+            done.durability = self.durability
         return done
 
     def future(self, i: int) -> Future:
@@ -430,6 +457,27 @@ class KVS:
         else:
             self.heap = None
         self._in_heap_gc = False
+        # round-22 durability tier (hermes_tpu/wal, cfg.wal_dir): the
+        # write-ahead extent+commit log rides the harvest path
+        # (rt.attach_wal -> harvest_comp appends each round's committed
+        # writes).  Under wal_sync='commit' a round's resolution is GATED:
+        # _gated_resolve parks the harvested round as (lsn, args) until
+        # the group-commit flusher reports its log batch durable, so a
+        # client future only ever resolves 'committed' after its record
+        # survives a power cut.  Relaxed modes resolve immediately with a
+        # loud durability label.  A full dirty window sheds NEW updates
+        # with kind='retry_after' (wal_shed counts them) — loud, never a
+        # silent stall.
+        if self.cfg.use_wal:
+            from hermes_tpu.wal import GroupCommitWal
+
+            self.wal: Optional[GroupCommitWal] = GroupCommitWal(self.cfg)
+            self.rt.attach_wal(self.wal, heap=self.heap)
+        else:
+            self.wal = None
+        self._wal_defer: collections.deque = collections.deque()
+        self.wal_shed = 0
+        self._wal_bp = False
         # refs appended for work being STAGED right now (a batch mid-
         # build, a migration mid-transfer): a heap-pressure GC can fire
         # between two appends of the same call, and refs not yet
@@ -487,6 +535,17 @@ class KVS:
             self.shed_writes += 1
             fut = Future()
             fut._result = Completion(kind="rejected", key=int(key),
+                                     found=False)
+            return fut
+        if kind != "get" and self._wal_backpressured():
+            # WAL backpressure (round-22): the durability log's dirty
+            # window is full — shed NEW updates loudly (retry later)
+            # instead of queueing writes whose durability promise cannot
+            # currently be kept.  Same pre-index placement rationale as
+            # the degraded shed above.
+            self.wal_shed += 1
+            fut = Future()
+            fut._result = Completion(kind="retry_after", key=int(key),
                                      found=False)
             return fut
         if self.index is not None:
@@ -549,6 +608,25 @@ class KVS:
             self.rt._trace("degraded" if degraded else "degraded_clear",
                            healthy=len(healthy), floor=floor)
         return degraded
+
+    def _wal_backpressured(self) -> bool:
+        """Round-22 WAL backpressure: more appended-but-not-durable
+        records than cfg.wal_dirty_window.  Transitions land on the obs
+        timeline (``wal_backpressure`` / ``wal_backpressure_clear``);
+        while backpressured the flusher is kicked every probe so the
+        window drains as fast as the disk allows."""
+        if self.wal is None:
+            return False
+        bp = self.wal.backpressured()
+        if bp != self._wal_bp:
+            self._wal_bp = bp
+            self.rt._trace(
+                "wal_backpressure" if bp else "wal_backpressure_clear",
+                dirty=self.wal.dirty_records(),
+                window=self.cfg.wal_dirty_window)
+        if bp:
+            self.wal.kick()
+        return bp
 
     def degraded(self) -> bool:
         """Public view of the quorum-loss degraded mode (round-14: the
@@ -679,6 +757,7 @@ class KVS:
                 "per update op; got values=None with "
                 f"{int((opc != t.OP_READ).sum())} update(s) in the batch")
         bf = BatchFutures(opc.copy(), keys_arr.copy(), u, heap=self.heap)
+        bf.durability = self._wal_label()
         if self._degraded_now():
             # quorum-loss degraded mode (round-11): shed writes loudly
             # BEFORE the sparse-key index mapping — a shed op must not
@@ -688,6 +767,15 @@ class KVS:
                 bf.code[shed] = C_REJECTED
                 bf.found[shed] = False
                 self.shed_writes += int(shed.sum())
+        if self._wal_backpressured():
+            # WAL backpressure (round-22): shed NEW updates loudly with
+            # C_RETRY_AFTER before the index mapping, mirroring the
+            # degraded shed — the durability log cannot absorb them yet
+            shed = (opc != t.OP_READ) & (bf.code == 0)
+            if shed.any():
+                bf.code[shed] = C_RETRY_AFTER
+                bf.found[shed] = False
+                self.wal_shed += int(shed.sum())
         if self.index is not None:
             k64 = keys_arr.astype(np.uint64)
             slots = np.zeros(n, np.int32)
@@ -944,6 +1032,7 @@ class KVS:
                     done.data = self.heap.read(ref) if ref else None
             if c in (t.C_WRITE, t.C_RMW):
                 done.uid = (int(wval[r, s, 0]), int(wval[r, s, 1]))
+                done.durability = self._wal_label()
                 if ver is not None:
                     done.ts = (int(ver[r, s]), int(fc[r, s]))
                     # RYW fence (round-16): this lane's later local reads
@@ -1156,9 +1245,9 @@ class KVS:
         code = np.asarray(comp.code)
         done_mask = self._done_mask(code, np.asarray(comp.key))
         self._retire(done_mask)
-        n = self._resolve(done_mask, code, np.asarray(comp.rval),
-                          np.asarray(comp.wval), self.rt.step_idx - 1,
-                          ver=np.asarray(comp.ver), fc=np.asarray(comp.fc))
+        n = self._gated_resolve(done_mask, code, np.asarray(comp.rval),
+                                np.asarray(comp.wval), self.rt.step_idx - 1,
+                                np.asarray(comp.ver), np.asarray(comp.fc))
         self._watchdog()
         return n
 
@@ -1177,8 +1266,11 @@ class KVS:
         self._sync_stream()
         comp = self.rt.dispatch_round()
         k = self.rt.step_idx - 1
-        # resolve round k-1 while the device runs round k
-        ndone = self.flush()
+        # resolve round k-1 while the device runs round k (non-blocking:
+        # under wal_sync='commit' a round whose log batch is not yet
+        # durable stays parked — the public flush() is what forces the
+        # group commit out)
+        ndone = self._flush_round()
         # intake freed by that resolution stages NOW — inside the
         # device-busy window — for the round-k+1 dispatch (the next call's
         # top-of-step injection pass runs after the sync point below, i.e.
@@ -1195,19 +1287,85 @@ class KVS:
         self._pending = (k, comp, done_mask, code)
         return ndone
 
-    def flush(self) -> int:
-        """Resolve the deferred round's futures (pipelined mode; no-op at
-        depth 1).  Installed as the runtime's ``comp_flush`` hook so
-        rebase/drain boundaries force every in-flight completion out."""
+    def _flush_round(self) -> int:
+        """Harvest the deferred round (pipelined mode; no-op at depth 1)
+        and resolve what durability allows: under wal_sync='commit' the
+        round parks in _wal_defer until its log batch fsyncs — this
+        method NEVER blocks on the disk (it runs on the per-round hot
+        path inside _step_pipelined's device-busy window)."""
         if self._pending is None:
-            return 0
+            return self._drain_wal_defer()
         pk, pcomp, done_mask, code = self._pending
         self._pending = None
         comp_np = self.rt.harvest_comp(pcomp, round_idx=pk)
-        return self._resolve(done_mask, code, np.asarray(comp_np.rval),
-                             np.asarray(comp_np.wval), pk,
-                             ver=np.asarray(comp_np.ver),
-                             fc=np.asarray(comp_np.fc))
+        return self._gated_resolve(done_mask, code,
+                                   np.asarray(comp_np.rval),
+                                   np.asarray(comp_np.wval), pk,
+                                   np.asarray(comp_np.ver),
+                                   np.asarray(comp_np.fc))
+
+    def flush(self) -> int:
+        """Resolve EVERY in-flight completion: the deferred pipelined
+        round, plus — under wal_sync='commit' — a forced group commit so
+        all durability-parked rounds resolve too.  Installed as the
+        runtime's ``comp_flush`` hook so rebase/drain/snapshot boundaries
+        leave nothing unresolved."""
+        n = self._flush_round()
+        if self._wal_defer:
+            n += self._drain_wal_defer(wait=True)
+        return n
+
+    def _gated_resolve(self, done_mask, code, rval, wval, round_idx,
+                       ver, fc) -> int:
+        """Resolve one harvested round now — or, under wal_sync='commit',
+        park it keyed by the round's WAL batch LSN until the group-commit
+        flusher reports that batch durable.  Rounds always resolve in
+        round order (the deque is FIFO and LSNs are monotone)."""
+        wal = self.wal
+        if wal is None or self.cfg.wal_sync != "commit":
+            if wal is not None:
+                wal.kick()  # relaxed modes: fsync soon, just don't wait
+            return self._resolve(done_mask, code, rval, wval, round_idx,
+                                 ver=ver, fc=fc)
+        self._wal_defer.append((self.rt.wal_last_lsn, done_mask, code,
+                                rval, wval, round_idx, ver, fc))
+        wal.kick()
+        return self._drain_wal_defer()
+
+    def _drain_wal_defer(self, wait: bool = False) -> int:
+        """Resolve durability-parked rounds whose log batches are durable;
+        ``wait=True`` (the public flush) forces the group commit first —
+        the fsync wait lands on the obs timeline as a ``wal_sync`` span."""
+        wal = self.wal
+        if wal is None or not self._wal_defer:
+            return 0
+        if wait:
+            target = self._wal_defer[-1][0]
+            obs = self.rt.obs
+            if obs is not None:
+                with obs.tracer.span("wal_sync", lsn=target,
+                                     parked_rounds=len(self._wal_defer)):
+                    wal.sync(target)
+            else:
+                wal.sync(target)
+        n = 0
+        durable = wal.durable_lsn()
+        while self._wal_defer and self._wal_defer[0][0] <= durable:
+            _lsn, done_mask, code, rval, wval, k, ver, fc = (
+                self._wal_defer.popleft())
+            n += self._resolve(done_mask, code, rval, wval, k,
+                               ver=ver, fc=fc)
+        return n
+
+    def _wal_label(self) -> Optional[str]:
+        """The durability label committed updates carry (round-22):
+        'commit' when resolution waited for the fsync, a loud
+        ':not-fsynced-at-resolve' suffix for the relaxed modes."""
+        if self.wal is None:
+            return None
+        mode = self.cfg.wal_sync
+        return ("commit" if mode == "commit"
+                else f"{mode}:not-fsynced-at-resolve")
 
     def run_until(self, futures: Sequence[Future], max_steps: int = 10_000) -> bool:
         """Step until every future resolves (or the step budget runs out)."""
@@ -1589,6 +1747,11 @@ class KVS:
             if nz.any():
                 arr[nz] = ValueHeap.remap(
                     arr[nz].astype(np.int64), old, new).astype(arr.dtype)
+        if self.wal is not None and old.size:
+            # round-22: log the ref rewrite so the un-truncated WAL tail
+            # stays interpretable (bookkeeping — each record's extent
+            # BYTES remain authoritative for replay)
+            self.wal.note_remap(old, new)
         stats = self.heap.stats()
         if rt.obs is not None:
             rt.obs.registry.gauge(

@@ -35,6 +35,35 @@ from hermes_tpu.core import types as t
 from hermes_tpu.workload import ycsb
 
 
+# Process-wide compiled-step cache.  build_fast_* returns a fresh jit
+# wrapper per call, so every FastRuntime used to recompile the round
+# program (~seconds) even when an identical-shape store had already
+# compiled it in this process.  The traced program is a pure function of
+# the config (and mesh, for sharded) — EXCEPT the wal_* fields, which
+# live entirely on the host plane (round-22: the log taps the harvest
+# AFTER the step runs), so two stores differing only in wal dir/mode
+# share one executable.  Keys fall back to no caching when a config or
+# mesh is unhashable rather than ever guessing.
+_STEP_CACHE: dict = {}
+
+
+def _cached_step(cfg: HermesConfig, backend: str, mesh, build):
+    import dataclasses
+    try:
+        key = (backend,
+               dataclasses.replace(cfg, wal_dir=None, wal_sync="commit",
+                                   wal_segment_bytes=1 << 20,
+                                   wal_dirty_window=256),
+               cfg.donate_state, mesh)
+        hash(key)
+    except TypeError:
+        return build()
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = _STEP_CACHE[key] = build()
+    return fn
+
+
 class _ObsHooks:
     """Shared observability surface of both run drivers (hermes_tpu.obs):
     ``attach_obs`` installs the run's Observability context; fault-injection
@@ -49,10 +78,30 @@ class _ObsHooks:
     # emits carries it, so one shared obs sink stays attributable
     # per group (scripts/obs_report.py aggregates fleet-wide)
     group = None
+    # round-22 WAL tap defaults (attach_wal installs; only the fast
+    # drivers' harvest path feeds it)
+    wal = None
+    _wal_heap = None
+    wal_last_lsn = 0
 
     def attach_obs(self, obs):
         self.obs = obs
+        if self.wal is not None:
+            # round-22: late obs attach still feeds the WAL's fsync-
+            # latency + dirty-window series (the KVS builds the log
+            # before any obs context exists)
+            self.wal.obs = obs
         return obs
+
+    def attach_wal(self, wal, heap=None):
+        """Install the round-22 write-ahead log tap: every committed
+        write harvest_comp surfaces is appended to ``wal`` (with its
+        extent bytes read from ``heap`` in heap mode)."""
+        self.wal = wal
+        self._wal_heap = heap
+        if wal.obs is None and self.obs is not None:
+            wal.obs = self.obs
+        return wal
 
     def _trace(self, name: str, **fields) -> None:
         if self.obs is not None:
@@ -457,6 +506,16 @@ class FastRuntime(_ObsHooks, _ElasticResize):
         # scripts/rebase_soak.py) sets this False to poll counters alone;
         # recording/client runs need it True (the default)
         self.fetch_completions = True
+        # round-22 durability tier: an attached GroupCommitWal taps the
+        # harvest stream — every committed write a harvested round
+        # carries is appended (with its heap extent bytes) right after
+        # the recorder sees it, so the log and the recorded history agree
+        # record-for-record.  wal_last_lsn is the LSN of the newest
+        # appended batch; kvs.KVS gates client resolution on it under
+        # wal_sync='commit'.
+        self.wal = None
+        self._wal_heap = None
+        self.wal_last_lsn = 0
         # record: False | True (Python Op recorder) | "array" (columnar
         # recorder + native witness checker, checker/fast.py — bench scale)
         if record == "array":
@@ -471,12 +530,16 @@ class FastRuntime(_ObsHooks, _ElasticResize):
         # tests/test_pipeline.py); cfg.donate_state=False restores the
         # copying program (the bench A/B baseline).
         if backend == "batched":
-            self._step = fst.build_fast_batched(cfg, donate=cfg.donate_state)
+            self._step = _cached_step(
+                cfg, "batched", None,
+                lambda: fst.build_fast_batched(cfg, donate=cfg.donate_state))
         elif backend == "sharded":
             if mesh is None:
                 raise ValueError("sharded backend needs a mesh")
-            self._step = fst.build_fast_sharded(cfg, mesh, rounds=1,
-                                                donate=cfg.donate_state)
+            self._step = _cached_step(
+                cfg, "sharded", mesh,
+                lambda: fst.build_fast_sharded(cfg, mesh, rounds=1,
+                                               donate=cfg.donate_state))
             self.fs, self.stream = fst.place_fast_sharded(cfg, mesh, self.fs, self.stream)
             self.mesh = mesh
         else:
@@ -695,6 +758,18 @@ class FastRuntime(_ObsHooks, _ElasticResize):
             subs = comp_np if multi else (comp_np,)
             for c in subs:
                 self.recorder.record_step(c)
+        if self.wal is not None:
+            # round-22: append AFTER the ver-base re-anchor above, so the
+            # log carries globally-monotone versions (replay subtracts the
+            # target runtime's own ver_base back out)
+            multi = isinstance(comp_np, tuple) and not isinstance(comp_np, st.Completions)
+            subs = comp_np if multi else (comp_np,)
+            for c in subs:
+                lsn = self.wal.append_comp(c, heap=self._wal_heap,
+                                           round_idx=round_idx)
+                if lsn is not None:
+                    self.wal_last_lsn = lsn
+            self.wal.kick()
         return comp_np
 
     def _harvest_one(self):

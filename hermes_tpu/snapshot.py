@@ -207,6 +207,14 @@ def save(path: str, rt) -> None:
         arrays={k: _array_sha256(v) for k, v in arrays.items()},
     )
     _atomic_savez(path, arrays, manifest)
+    wal = getattr(rt, "wal", None)
+    if wal is not None:
+        # round-22: the durable snapshot now covers everything committed
+        # at or before rt.step_idx — sealed WAL segments whose every
+        # record falls behind it are dead weight; drop them (the open
+        # segment and any segment straddling the boundary stay, and
+        # replay stays idempotent for records the snapshot re-covers)
+        wal.truncate_to(int(rt.step_idx))
 
 
 def _atomic_savez(path: str, arrays: dict, manifest: dict) -> None:

@@ -1,0 +1,30 @@
+"""Round-22 durability tier: the host-side write-ahead extent+commit log.
+
+The completion stream already carries every committed write in round
+order (runtime.harvest_comp feeds the recorder from it), so durability is
+a TAP on that stream, not a new protocol path: ``GroupCommitWal`` appends
+``(uid, key, ts=(ver, fc), value-words-or-heap-ref + extent bytes)``
+records in CRC-framed segments (transport/codec.frame_pack — the same
+torn-frame triage the serving wire uses), a dedicated flusher thread
+group-commits them with ONE fsync per batch, and ``replay`` turns the
+segments back into table rows idempotently (by packed timestamp — an
+already-snapshotted record is a no-op).
+
+Public surface:
+  * ``GroupCommitWal``       — the log + flusher (log.py)
+  * ``WalError/WalCorrupt``  — loud refusal types
+  * ``read_records/apply_records`` — recovery half (replay.py)
+"""
+
+from hermes_tpu.wal.log import (  # noqa: F401
+    GroupCommitWal,
+    WalError,
+    K_SEGHDR,
+    K_ROUND,
+    K_REMAP,
+)
+from hermes_tpu.wal.replay import (  # noqa: F401
+    WalCorrupt,
+    read_records,
+    apply_records,
+)

@@ -1,0 +1,212 @@
+"""WAL recovery half: segment reading with torn-frame triage, and the
+idempotent host-side apply path.
+
+Triage contract (the tentpole's loud/quiet split):
+
+  * **torn tail** — the failure is explainable as ONE interrupted append
+    reaching end-of-file in the LAST segment: fewer bytes than a frame
+    header remain, or a valid header's declared payload runs past EOF.
+    That is the expected kill -9 shape; reading truncates cleanly at the
+    last whole record and recovery proceeds with everything before it.
+  * **torn interior / checksum mismatch** — anything else: bad magic with
+    a full header present, a CRC mismatch over a fully-present payload,
+    any failure in a non-last segment, or a record that decodes
+    inconsistently inside a CRC-valid frame.  That is bit rot or a
+    writer bug, NOT a crash shape — the flight recorder dumps (with the
+    offending segment header bytes in the payload) and ``WalCorrupt``
+    raises.  Recovery must never guess past it.
+
+Apply contract: a record applies to a table row iff its packed timestamp
+``pack_pts(ver - ver_base[key], fc)`` is NEWER than the row's current
+``vpts`` — so replaying a record the snapshot already covers is a no-op,
+and replaying the whole log twice is identical to once (idempotent by
+``(uid, ts)``; the uid rides in value words 0-1 and follows the ts).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from hermes_tpu.core import faststep as fst
+from hermes_tpu.core import types as t
+from hermes_tpu.obs.flightrec import FlightRecorder
+from hermes_tpu.transport import codec
+from hermes_tpu.wal import log as wlog
+
+
+class WalCorrupt(RuntimeError):
+    """A WAL segment failed integrity checks in a way a crash cannot
+    explain (torn interior / checksum mismatch / inconsistent record):
+    recovery refuses loudly instead of guessing."""
+
+
+def _refuse(reason: str, obs, path: str, seq: int, offset: int,
+            header: bytes, detail: str) -> None:
+    """Arm the flight recorder (same pattern as the checker-red and
+    StuckOpError triggers), then raise WalCorrupt."""
+    flight = obs.flight if obs is not None else FlightRecorder()
+    flight.auto_dump(reason, extra=dict(
+        segment=os.path.basename(path), seq=seq, offset=offset,
+        header_hex=header.hex(), detail=detail))
+    raise WalCorrupt(
+        f"{reason}: segment {os.path.basename(path)} (seq {seq}) at "
+        f"offset {offset}: {detail} — refusing to replay past it "
+        f"(header bytes {header.hex() or '<eof>'})")
+
+
+def read_records(wal_dir: str, obs=None) -> dict:
+    """Parse every segment in ``wal_dir`` in sequence order.
+
+    Returns ``dict(records, remaps, headers, segments, torn_tail)``:
+    ``records`` are decoded K_ROUND dicts in append order, ``remaps`` the
+    K_REMAP bookkeeping dicts, ``headers`` the per-segment K_SEGHDR
+    JSON dicts, ``segments`` the paths read (recovery retires exactly
+    these after re-appending), ``torn_tail`` whether the last segment
+    ended in a cleanly-truncated partial append."""
+    paths = sorted(
+        (os.path.join(wal_dir, n) for n in os.listdir(wal_dir)
+         if n.startswith("wal-") and n.endswith(".seg")),
+        key=wlog.GroupCommitWal._seq_of) if os.path.isdir(wal_dir) else []
+    records, remaps, headers = [], [], []
+    torn_tail = False
+    for pi, path in enumerate(paths):
+        seq = wlog.GroupCommitWal._seq_of(path)
+        last_seg = pi == len(paths) - 1
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off < len(data):
+            remaining = len(data) - off
+            header = data[off:off + codec.FRAME_OVERHEAD]
+            if remaining < codec.FRAME_OVERHEAD:
+                if last_seg:
+                    torn_tail = True  # interrupted append at EOF
+                    break
+                _refuse("wal_torn_interior", obs, path, seq, off, header,
+                        f"{remaining} trailing bytes (< {codec.FRAME_OVERHEAD}"
+                        "-byte frame header) in a NON-last segment")
+            magic, algo, _pad, length, crc = codec.FRAME_HEADER.unpack(header)
+            if magic != codec.FRAME_MAGIC:
+                _refuse("wal_torn_interior", obs, path, seq, off, header,
+                        f"bad frame magic 0x{magic:04x} with a full header "
+                        "present (appends are sequential, so this is not a "
+                        "torn tail)")
+            end = off + codec.FRAME_OVERHEAD + length
+            if end > len(data):
+                if last_seg:
+                    torn_tail = True  # header landed, payload did not
+                    break
+                _refuse("wal_torn_interior", obs, path, seq, off, header,
+                        f"frame payload ({length} bytes) runs past EOF in a "
+                        "NON-last segment")
+            payload = data[off + codec.FRAME_OVERHEAD:end]
+            got = codec.wire_crc(payload, algo)
+            if got != crc:
+                _refuse("wal_checksum_mismatch", obs, path, seq, off, header,
+                        f"frame checksum mismatch over a fully-present "
+                        f"payload (header 0x{crc:08x} != 0x{got:08x})")
+            try:
+                rec = wlog.decode_record(payload)
+            except wlog.WalError as e:
+                _refuse("wal_record_inconsistent", obs, path, seq, off,
+                        header, str(e))
+            rec["segment"] = path
+            if rec["kind"] == wlog.K_SEGHDR:
+                headers.append(rec["header"])
+            elif rec["kind"] == wlog.K_REMAP:
+                remaps.append(rec)
+            else:
+                records.append(rec)
+            off = end
+    return dict(records=records, remaps=remaps, headers=headers,
+                segments=paths, torn_tail=torn_tail)
+
+
+def check_headers(headers, cfg, obs=None) -> None:
+    """Refuse a log written under a different table shape: replaying it
+    would scatter rows into the wrong slots silently."""
+    for h in headers:
+        bad = [k for k in ("n_keys", "value_words", "n_replicas",
+                           "max_value_bytes")
+               if h.get(k) != getattr(cfg, k)]
+        if bad:
+            flight = obs.flight if obs is not None else FlightRecorder()
+            flight.auto_dump("wal_recovery_refused", extra=dict(
+                header=h, mismatched=bad, expected={
+                    k: getattr(cfg, k) for k in bad}))
+            raise WalCorrupt(
+                f"wal segment seq {h.get('seq')} was written under a "
+                f"different config ({', '.join(bad)} mismatch: segment "
+                f"{ {k: h.get(k) for k in bad} } vs runtime "
+                f"{ {k: getattr(cfg, k) for k in bad} }) — refusing to "
+                "replay it into this table")
+
+
+def apply_records(rt, records, heap=None, replicas=None):
+    """Replay decoded K_ROUND records into ``rt``'s table host-side,
+    idempotently by packed timestamp.  Returns ``(applied, skipped)``
+    record counts.  ``replicas`` restricts the write to those table
+    copies on the sharded engine (restart_replica's rejoined-replica
+    catch-up); None = every copy.  In heap mode each applied record's
+    extent bytes are re-appended into ``heap`` and the row's ref word
+    re-minted (the logged ref is from the dead store's heap)."""
+    cfg = rt.cfg
+    K = cfg.n_keys
+    tbl = rt.fs.table
+    import jax
+    import jax.numpy as jnp
+
+    vpts = np.array(jax.device_get(tbl.vpts))
+    bank = np.array(jax.device_get(tbl.bank))
+    rows32 = codec.rows_to_words(bank)
+    sharded = vpts.shape[0] != K
+    R = vpts.shape[0] // K if sharded else 1
+    copies = list(range(R)) if replicas is None else list(replicas)
+    ver_base = getattr(rt, "_ver_base", None)
+    applied = skipped = 0
+    for rec in records:
+        n = int(rec["key"].shape[0])
+        offs = np.zeros(n + 1, np.int64)
+        np.cumsum(rec["lens"], out=offs[1:])
+        for i in range(n):
+            key = int(rec["key"][i])
+            if not (0 <= key < K):
+                raise WalCorrupt(
+                    f"wal record key {key} outside the table "
+                    f"[0, {K}) — log/config mismatch")
+            gver = int(rec["ver"][i])
+            dver = gver - (int(ver_base[key]) if ver_base is not None else 0)
+            if not (0 < dver < cfg.max_key_versions):
+                raise WalCorrupt(
+                    f"wal record for key {key} re-anchors to device "
+                    f"version {dver} (global {gver}) outside "
+                    f"(0, {cfg.max_key_versions}) — version-era mismatch "
+                    "between the log and this runtime's rebase state")
+            pts = np.int32(fst.pack_pts(dver, int(rec["fc"][i])))
+            rows = ([key] if not sharded
+                    else [r * K + key for r in copies])
+            hit_rows = [row for row in rows if pts > vpts[row]]
+            if not hit_rows:
+                skipped += 1  # snapshot (or a later record) already covers it
+                continue
+            wv = rec["wv"][i].copy()
+            if heap is not None and int(rec["lens"][i]):
+                # mint a FRESH ref for the logged extent bytes — the
+                # logged ref word points into the dead store's heap;
+                # minted only for records that actually apply, so a
+                # replayed-twice log cannot leak heap space
+                ext = rec["blob"][int(offs[i]):int(offs[i + 1])]
+                wv[2] = np.int32(heap.append(ext))
+            sst = np.int32(fst.pack_sst(int(rec["step"][i]), t.VALID))
+            for row in hit_rows:
+                vpts[row] = pts
+                rows32[row, fst.BANK_PTS] = pts
+                rows32[row, fst.BANK_SST] = sst
+                rows32[row, fst.BANK_VAL:] = wv
+            applied += 1
+    tbl = tbl._replace(vpts=jnp.asarray(vpts),
+                       bank=jnp.asarray(codec.words_to_rows(rows32)))
+    rt.fs = rt.fs._replace(table=tbl)
+    return applied, skipped

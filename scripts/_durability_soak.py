@@ -1,0 +1,146 @@
+"""Round-22 durability-soak CHILD: the process scripts/check_durability.py
+kill -9s.
+
+Drives put waves at 2x the store's in-flight capacity against a WAL-backed
+KVS (``wal_sync='commit'``: a future resolves only after its group-commit
+fsync), appends one JSON line per CLIENT-OBSERVED commit to the commits
+file, and dies by its own schedule: a ``powercut`` chaos verb fires
+mid-wave — with ops in flight and the dirty window non-empty — through a
+carrier that SIGKILLs this very process.  No flush, no close, no atexit:
+the exact crash shape the WAL exists for.
+
+The commits file is the parent's witness set: every line is a write some
+client saw resolve ``committed``, so after recovery every line's uid MUST
+appear as a definite committed write in the replayed log
+(checker.linearizability.committed_write_lost == []).  Lines are written
+only AFTER resolution and flushed per wave; lines lost in the kill only
+shrink the checked set (under-approximation — never a false pass).
+
+    python scripts/_durability_soak.py WAL_DIR BACKEND COMMITS_JSONL KILL_WAVE
+
+``KILL_WAVE < 0`` disables the powercut (the wal-overhead leg reuses the
+same drive loop in-process via ``run_waves``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+SEED = 29
+N_REPLICAS = 3
+PAYLOAD_WORDS = 4  # value_words = 2 uid words + payload
+
+
+def soak_cfg(wal_dir, wal_sync="commit"):
+    """ONE config for child and parent: chaos.recovery.recover_store
+    refuses a header mismatch, and the parent's replay must land in an
+    identically-shaped table."""
+    from hermes_tpu.config import HermesConfig, WorkloadConfig
+
+    return HermesConfig(
+        n_replicas=N_REPLICAS, n_keys=128, n_sessions=8, replay_slots=8,
+        value_words=2 + PAYLOAD_WORDS, ops_per_session=64,
+        pipeline_depth=2, wal_dir=wal_dir, wal_sync=wal_sync,
+        workload=WorkloadConfig(seed=SEED),
+    )
+
+
+def build_kvs(wal_dir, backend, wal_sync="commit"):
+    import jax
+    import numpy as np
+
+    from hermes_tpu.kvs import KVS
+
+    mesh = None
+    if backend == "sharded":
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:N_REPLICAS]), ("replica",))
+    return KVS(soak_cfg(wal_dir, wal_sync), backend=backend, mesh=mesh)
+
+
+def run_waves(kvs, waves, on_commit=None, on_wave=None, rng_seed=SEED):
+    """The shared drive loop: per wave, submit 2x-capacity unique-payload
+    puts, optionally interrupt mid-flight (``on_wave`` — the powercut
+    hook), resolve, and report each committed put.  Returns the number of
+    committed writes."""
+    import numpy as np
+
+    rng = np.random.default_rng(rng_seed)
+    cfg = kvs.cfg
+    n = 2 * cfg.n_replicas * cfg.n_sessions  # 2x in-flight capacity
+    committed = 0
+    it = range(waves) if waves >= 0 else itertools.count()
+    for wave in it:
+        keys = rng.integers(0, cfg.n_keys, n)
+        vals = np.empty((n, PAYLOAD_WORDS), np.int32)
+        vals[:, 0] = wave
+        vals[:, 1] = np.arange(n)
+        vals[:, 2:] = rng.integers(0, 1 << 20, (n, PAYLOAD_WORDS - 2))
+        bf = kvs.submit_batch(np.full(n, kvs.PUT, np.int32), keys, vals)
+        for _ in range(3):
+            kvs.step()  # get the wave genuinely in flight ...
+        if on_wave is not None:
+            on_wave(wave)  # ... THEN let the adversary at it
+        assert kvs.run_batch(bf), "soak wave did not resolve"
+        for i in range(n):
+            c = bf.completion(i)
+            if c.kind == "put":
+                committed += 1
+                if on_commit is not None:
+                    on_commit(c, wave)
+            else:
+                assert c.kind == "retry_after", (
+                    f"unexpected completion {c.kind} for a put")
+    return committed
+
+
+def main(argv) -> int:
+    wal_dir, backend, commits_path, kill_wave = (
+        argv[0], argv[1], argv[2], int(argv[3]))
+    kvs = build_kvs(wal_dir, backend)
+    out = open(commits_path, "w")
+
+    def carrier(step):
+        # the client's observations survive; the store's do not — that
+        # asymmetry is exactly what the parent checks
+        out.flush()
+        os.fsync(out.fileno())
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    from hermes_tpu import chaos
+
+    sched = chaos.Schedule([chaos.ChaosEvent(step=kill_wave,
+                                             kind="powercut")])
+    runner = chaos.ChaosRunner(kvs, sched, powercut=carrier)
+
+    def on_commit(c, wave):
+        out.write(json.dumps(dict(uid=list(c.uid), key=c.key,
+                                  ts=list(c.ts), wave=wave,
+                                  durability=c.durability)) + "\n")
+
+    def on_wave(wave):
+        out.flush()
+        runner.tick(wave)  # fires the powercut at kill_wave — no return
+
+    if kill_wave >= 0:
+        run_waves(kvs, -1, on_commit=on_commit, on_wave=on_wave)
+        raise AssertionError("powercut never fired")  # pragma: no cover
+    run_waves(kvs, 4, on_commit=on_commit)
+    out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,204 @@
+"""Round-22 durability gate (CI): kill -9 the store mid-soak, recover,
+lose NOTHING a client was told was committed.
+
+Three legs, CPU-smoke sized (joins the eleven existing gates in
+scripts/run_gates.py as the twelfth):
+
+  1/2. kill_batched / kill_sharded — spawn scripts/_durability_soak.py
+     (put waves at 2x in-flight capacity, ``wal_sync='commit'``); the
+     child's own chaos schedule fires a ``powercut`` verb mid-wave whose
+     carrier SIGKILLs the whole process — in-flight batch, dirty WAL
+     window, no cleanup.  The parent then recovers IN-PROCESS via
+     chaos.recovery.recover_store and asserts:
+       * ``committed_write_lost(committed, ops) == []`` — every write a
+         client saw resolve is a definite committed write in the
+         replayed log (the zero-loss contract, checker-green);
+       * the recovered store SERVES the per-key newest logged value;
+       * recovery wall time stays under RECOVERY_BOUND_S;
+       * the recovered store still accepts and commits new writes.
+  3. wal_overhead — the same drive loop with the WAL on (commit) vs off,
+     writes/s both ways, reported as a measured cell (record-only: the
+     fsync tax is the product, not a regression).
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/check_durability.py
+
+Prints one JSON line (also written to DURABILITY_SOAK.json); exit
+non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import _durability_soak as soak
+
+KILL_WAVE = 4
+RECOVERY_BOUND_S = 90.0
+CHILD_TIMEOUT_S = 420
+
+
+def _read_commits(path):
+    """The child's witness set; a line torn by the SIGKILL only shrinks
+    it (the child flushes per wave, so only the last line can tear)."""
+    committed = []
+    with open(path) as f:
+        for ln in f:
+            try:
+                committed.append(json.loads(ln))
+            except json.JSONDecodeError:
+                break
+    return committed
+
+
+def _log_ops(records):
+    """Every logged write as a definite committed checker op: uid rides
+    in value words 0-1, the (ver, fc) witness in its own columns."""
+    from hermes_tpu.checker.history import Op
+
+    ops = []
+    for rec in records:
+        for i in range(int(rec["key"].shape[0])):
+            step = int(rec["step"][i])
+            ops.append(Op(
+                "w", int(rec["key"][i]), 2 * step, 2 * step + 1,
+                wuid=(int(rec["wv"][i, 0]), int(rec["wv"][i, 1])),
+                ts=(int(rec["ver"][i]), int(rec["fc"][i]))))
+    return ops
+
+
+def check_kill(report: dict, backend: str) -> None:
+    from hermes_tpu.chaos.recovery import recover_store
+    from hermes_tpu.checker.linearizability import committed_write_lost
+    from hermes_tpu.wal import replay as wal_replay
+
+    d = tempfile.mkdtemp(prefix=f"durability_{backend}_")
+    wal_dir = os.path.join(d, "wal")
+    commits = os.path.join(d, "commits.jsonl")
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_durability_soak.py")
+    p = subprocess.run(
+        [sys.executable, script, wal_dir, backend, commits, str(KILL_WAVE)],
+        timeout=CHILD_TIMEOUT_S, capture_output=True, text=True)
+    assert p.returncode == -signal.SIGKILL, (
+        f"{backend}: soak child exited {p.returncode}, want "
+        f"-SIGKILL from its own powercut carrier\n{p.stderr[-2000:]}")
+    committed = _read_commits(commits)
+    assert committed, f"{backend}: child logged no committed writes"
+
+    # parse the dead store's log BEFORE recovery consumes it: these
+    # records are the history the checker cross-examines
+    scan = wal_replay.read_records(wal_dir)
+    ops = _log_ops(scan["records"])
+
+    import jax
+    import numpy as np
+
+    mesh = None
+    if backend == "sharded":
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:soak.N_REPLICAS]), ("replica",))
+    t0 = time.perf_counter()
+    kvs, rsum = recover_store(soak.soak_cfg(wal_dir), backend=backend,
+                              mesh=mesh)
+    recovery_s = time.perf_counter() - t0
+
+    lost = committed_write_lost([tuple(c["uid"]) for c in committed], ops)
+    assert lost == [], (
+        f"{backend}: {len(lost)} committed write(s) LOST across the "
+        f"kill -9 (first: {lost[:5]}) — the durability contract is void")
+    assert recovery_s < RECOVERY_BOUND_S, (
+        f"{backend}: recovery took {recovery_s:.1f}s "
+        f"(bound {RECOVERY_BOUND_S}s)")
+
+    # functional: the recovered store must SERVE each key's newest
+    # logged value, not merely hold rows
+    newest = {}
+    for rec in scan["records"]:
+        for i in range(int(rec["key"].shape[0])):
+            k = int(rec["key"][i])
+            ts = (int(rec["ver"][i]), int(rec["fc"][i]))
+            if k not in newest or ts > newest[k][0]:
+                newest[k] = (ts, rec["wv"][i, 2:].tolist())
+    served = 0
+    for k, (_ts, want) in sorted(newest.items())[:16]:
+        fut = kvs.get(0, 0, k)
+        assert kvs.run_until([fut]), f"{backend}: get({k}) never resolved"
+        c = fut.result()
+        assert c.found and c.value == want, (
+            f"{backend}: recovered store serves {c.value} for key {k}, "
+            f"log says {want}")
+        served += 1
+
+    # and it must still be a store: fresh writes commit durably
+    n_new = soak.run_waves(kvs, 1, rng_seed=soak.SEED + 1)
+    assert n_new > 0, f"{backend}: no post-recovery write committed"
+    kvs.wal.close()
+    report[f"kill_{backend}"] = dict(
+        committed_witnessed=len(committed), log_records=len(ops),
+        committed_write_lost=[], torn_tail=bool(scan["torn_tail"]),
+        applied=rsum["applied"], skipped=rsum["skipped"],
+        recovery_s=round(recovery_s, 3), keys_served=served,
+        post_recovery_commits=n_new)
+
+
+def check_wal_overhead(report: dict) -> None:
+    """Measured cell: writes/s with the WAL on (group-commit fsync per
+    resolved round) vs off.  Record-only — the tax is the product."""
+    d = tempfile.mkdtemp(prefix="durability_overhead_")
+    cells = {}
+    for label, wal_dir in (("wal_off", None),
+                           ("wal_on", os.path.join(d, "wal"))):
+        kvs = soak.build_kvs(wal_dir, "batched")
+        soak.run_waves(kvs, 1)  # warm the jit caches off the clock
+        t0 = time.perf_counter()
+        n = soak.run_waves(kvs, 4, rng_seed=soak.SEED + 2)
+        dt = time.perf_counter() - t0
+        cells[label] = dict(writes=n, seconds=round(dt, 3),
+                            writes_per_s=round(n / dt, 1))
+        if kvs.wal is not None:
+            cells[label]["fsyncs"] = kvs.wal.stats()["fsyncs"]
+            kvs.wal.close()
+    on, off = cells["wal_on"]["writes_per_s"], cells["wal_off"]["writes_per_s"]
+    cells["on_vs_off"] = round(on / off, 3) if off else None
+    report["wal_overhead"] = cells
+
+
+def main() -> int:
+    report: dict = {"gate": "durability"}
+    try:
+        check_kill(report, "batched")
+        check_kill(report, "sharded")
+        check_wal_overhead(report)
+    except AssertionError as e:
+        report["ok"] = False
+        report["error"] = str(e)
+        print(json.dumps(report))
+        return 1
+    report["ok"] = True
+    out = os.path.join(os.path.dirname(__file__), "..",
+                       "DURABILITY_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

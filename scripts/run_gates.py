@@ -1,7 +1,8 @@
 """One serial runner for every CI gate (round-11 satellite).
 
-The eleven gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
-netchaos, fleet, serving, heap, hostlint — MUST run serially and never beside a pytest run: the
+The twelve gates — census, obs-overhead, analysis, pipeline, chaos, elastic,
+netchaos, fleet, serving, heap, hostlint, durability — MUST run serially
+and never beside a pytest run: the
 obs-overhead gate measures per-round wall time against an ablation
 baseline and is contention-sensitive (a parallel pytest's CPU load turns a
 behavior-identical change into a spurious overhead failure).  That rule
@@ -52,6 +53,7 @@ GATES = (
     ("serving", "check_serving.py"),
     ("heap", "check_heap.py"),
     ("hostlint", "check_hostlint.py"),
+    ("durability", "check_durability.py"),
 )
 
 
@@ -216,6 +218,27 @@ def main() -> int:
                                         seconds=leg.get("seconds"))
                              for name, leg in legs.items()
                              if isinstance(leg, dict)}}
+        # round-22: the durability gate's per-leg verdicts — zero-loss +
+        # recovery time per engine, and the measured fsync tax — are
+        # tracked cells
+        if r["gate"] == "durability":
+            out = {}
+            for leg in ("kill_batched", "kill_sharded"):
+                cell = r["report"].get(leg)
+                if isinstance(cell, dict):
+                    out[leg] = dict(
+                        lost=len(cell.get("committed_write_lost", [])),
+                        committed_witnessed=cell.get("committed_witnessed"),
+                        recovery_s=cell.get("recovery_s"))
+            cell = r["report"].get("wal_overhead")
+            if isinstance(cell, dict):
+                out["wal_overhead"] = dict(
+                    on_vs_off=cell.get("on_vs_off"),
+                    wal_on_writes_per_s=(cell.get("wal_on") or {}).get(
+                        "writes_per_s"),
+                    wal_off_writes_per_s=(cell.get("wal_off") or {}).get(
+                        "writes_per_s"))
+            return out
         return {}
 
     summary = dict(

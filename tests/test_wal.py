@@ -1,0 +1,366 @@
+"""Round-22 durability tier (hermes_tpu/wal): crash-point matrix over the
+segment format, replay idempotency across a snapshot boundary, group-commit
+client semantics (labels, backpressure), scoping, and the powercut verb.
+
+The torn-frame triage contract under test (wal/replay.py docstring): a
+failure explainable as ONE interrupted append at EOF in the LAST segment
+truncates cleanly (the kill -9 shape); anything else — interior damage, a
+checksum mismatch over a fully-present payload, any failure in a non-last
+segment — refuses loudly with a flight-recorder dump."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hermes_tpu.config import FleetConfig, HermesConfig, WorkloadConfig
+from hermes_tpu.kvs import KVS
+from hermes_tpu.transport import codec
+from hermes_tpu.wal import GroupCommitWal, WalCorrupt, WalError, replay
+
+
+def _cfg(wal_dir, **kw):
+    base = dict(n_replicas=3, n_keys=256, n_sessions=8, replay_slots=4,
+                value_words=6, replay_age=4, replay_scan_every=4,
+                wal_dir=str(wal_dir) if wal_dir is not None else None,
+                wal_sync="commit")
+    base.update(kw)
+    return HermesConfig(**base)
+
+
+def _write_log(wal_dir, batches=3, per=4, **kw):
+    """A sealed synthetic log: ``batches`` K_ROUND records of ``per``
+    writes each, no KVS/JAX in the loop."""
+    wal = GroupCommitWal(_cfg(wal_dir, **kw))
+    for b in range(batches):
+        keys = np.arange(per, dtype=np.int32) + b * per
+        wv = np.zeros((per, 6), np.int32)
+        wv[:, 0] = 1000 + b  # uid lo
+        wv[:, 1] = np.arange(per)  # uid hi
+        wv[:, 3] = 7 * b + np.arange(per)  # payload
+        wal.append_round(b, np.full(per, b, np.int64), keys,
+                         np.ones(per, np.int64), np.zeros(per, np.int32),
+                         wv, np.zeros(per, np.int32), b"")
+    wal.sync()
+    wal.close()
+    segs = wal.segments()
+    assert len(segs) == 1
+    return segs[0]
+
+
+def _frame_offsets(path):
+    data = open(path, "rb").read()
+    offs, off = [], 0
+    while off < len(data):
+        _m, _a, _p, length, _c = codec.FRAME_HEADER.unpack(
+            data[off:off + codec.FRAME_OVERHEAD])
+        offs.append(off)
+        off += codec.FRAME_OVERHEAD + length
+    return offs, len(data)
+
+
+# ---------------------------------------------------------------------------
+# crash-point matrix: torn tails truncate cleanly, interior damage refuses
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("crash_point", ["mid_record", "mid_frame_header",
+                                         "mid_fsync_window"])
+def test_torn_tail_truncates_cleanly(tmp_path, crash_point):
+    seg = _write_log(tmp_path, batches=3, per=4)
+    offs, size = _frame_offsets(seg)
+    # frame 0 is the K_SEGHDR; frames 1..3 the three record batches
+    assert len(offs) == 4
+    if crash_point == "mid_record":
+        cut = size - 5  # inside the last record's payload
+    elif crash_point == "mid_frame_header":
+        cut = offs[-1] + 3  # only 3 bytes of the last frame header landed
+    else:  # mid_fsync_window: a multi-record batch partially persisted
+        cut = offs[2] + codec.FRAME_OVERHEAD + 2
+    with open(seg, "r+b") as f:
+        f.truncate(cut)
+    scan = replay.read_records(str(tmp_path))
+    assert scan["torn_tail"] is True
+    want = 1 if crash_point == "mid_fsync_window" else 2
+    assert len(scan["records"]) == want
+    # what survived is intact and in append order
+    for b, rec in enumerate(scan["records"]):
+        assert rec["round_idx"] == b
+        assert rec["key"].tolist() == list(range(b * 4, b * 4 + 4))
+
+
+def test_flipped_byte_in_sealed_interior_refuses(tmp_path, monkeypatch):
+    flight_dir = tmp_path / "flight"
+    monkeypatch.setenv("HERMES_FLIGHT_DIR", str(flight_dir))
+    seg = _write_log(tmp_path / "wal", batches=3, per=4)
+    offs, _size = _frame_offsets(seg)
+    with open(seg, "r+b") as f:  # one bit of rot inside frame 1's payload
+        f.seek(offs[1] + codec.FRAME_OVERHEAD + 4)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(WalCorrupt, match="checksum"):
+        replay.read_records(str(tmp_path / "wal"))
+    # the refusal armed the flight recorder with the offending header
+    dumps = glob.glob(str(flight_dir / "flight_*.json"))
+    assert dumps, "refusal did not dump the flight recorder"
+    blob = json.dumps(json.load(open(dumps[-1])))
+    assert "wal_checksum_mismatch" in blob
+    assert os.path.basename(seg) in blob
+    assert "header_hex" in blob
+
+
+def test_torn_interior_nonlast_segment_refuses(tmp_path, monkeypatch):
+    monkeypatch.setenv("HERMES_FLIGHT_DIR", str(tmp_path / "flight"))
+    cfg = _cfg(tmp_path)
+    wal = GroupCommitWal(cfg)
+    wal.append_round(0, np.zeros(2, np.int64), np.arange(2, dtype=np.int32),
+                     np.ones(2, np.int64), np.zeros(2, np.int32),
+                     np.zeros((2, 6), np.int32), np.zeros(2, np.int32), b"")
+    wal.sync()
+    wal.close()
+    # a second store generation continues the sequence in a NEW segment
+    wal2 = GroupCommitWal(cfg)
+    wal2.append_round(1, np.ones(2, np.int64), np.arange(2, dtype=np.int32),
+                      np.full(2, 2, np.int64), np.zeros(2, np.int32),
+                      np.zeros((2, 6), np.int32), np.zeros(2, np.int32), b"")
+    wal2.sync()
+    wal2.close()
+    seg0, seg1 = wal2.segments()
+    with open(seg0, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        f.truncate(f.tell() - 3)  # a tail cut — but NOT in the last segment
+    with pytest.raises(WalCorrupt, match="torn_interior|NON-last"):
+        replay.read_records(str(tmp_path))
+    assert glob.glob(str(tmp_path / "flight" / "flight_*.json"))
+
+
+def test_header_mismatch_recovery_refused(tmp_path, monkeypatch):
+    monkeypatch.setenv("HERMES_FLIGHT_DIR", str(tmp_path / "flight"))
+    _write_log(tmp_path, batches=1, per=2)
+    scan = replay.read_records(str(tmp_path))
+    other = _cfg(tmp_path, n_keys=512)  # not the table this log was cut for
+    with pytest.raises(WalCorrupt, match="different config"):
+        replay.check_headers(scan["headers"], other)
+    dumps = glob.glob(str(tmp_path / "flight" / "flight_*.json"))
+    assert dumps and "wal_recovery_refused" in json.dumps(
+        json.load(open(dumps[-1])))
+
+
+def test_unknown_record_kind_refuses(tmp_path):
+    seg = _write_log(tmp_path, batches=1, per=2)
+    with open(seg, "ab") as f:  # CRC-valid frame around garbage
+        f.write(codec.frame_pack(
+            np.frombuffer(bytes([99]) * 40, np.uint8)).tobytes())
+    with pytest.raises(WalCorrupt, match="inconsistent|unknown"):
+        replay.read_records(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# replay: idempotent, snapshot-boundary-safe, on both recorder kinds
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("record", [True, "array"],
+                         ids=["history-recorder", "columnar-recorder"])
+def test_replay_idempotent_across_snapshot_boundary(tmp_path, record):
+    import jax
+
+    from hermes_tpu import snapshot
+    from hermes_tpu.chaos.recovery import recover_store
+
+    wal_dir = tmp_path / "wal"
+    kvs = KVS(_cfg(wal_dir), record=record)
+    f1 = [kvs.put(0, s, key=10 + s, value=[100 + s, 0, 0, s])
+          for s in range(4)]
+    assert kvs.run_until(f1)
+    snap = str(tmp_path / "snap.npz")
+    snapshot.save(snap, kvs)  # truncates the log behind it (sealed segs)
+    f2 = [kvs.put(1, s, key=20 + s, value=[200 + s, 0, 0, s])
+          for s in range(4)]
+    assert kvs.run_until(f2)
+    kvs.wal.sync()
+    kvs.wal.close()  # stop the flusher; segments stay (kill -9 keeps them)
+
+    kvs2, summary = recover_store(_cfg(wal_dir), snapshot_path=snap,
+                                  record=record)
+    # every logged record either applied or was already covered by the
+    # snapshot (the boundary): nothing refused, nothing double-applied
+    assert summary["applied"] + summary["skipped"] == summary["records"]
+    assert summary["applied"] >= 4  # the post-snapshot tail
+    for s in range(4):
+        g1, g2 = kvs2.get(2, 0, 10 + s), kvs2.get(2, 1, 20 + s)
+        assert kvs2.run_until([g1, g2])
+        assert g1.result().value == [100 + s, 0, 0, s]
+        assert g2.result().value == [200 + s, 0, 0, s]
+
+    # idempotency proper: replaying the recovered store's own log AGAIN
+    # is a pure no-op — same vpts, zero applied
+    before = np.array(jax.device_get(kvs2.rt.fs.table.vpts))
+    kvs2.flush()
+    kvs2.wal.sync()
+    scan = replay.read_records(str(wal_dir))
+    applied, skipped = replay.apply_records(kvs2.rt, scan["records"])
+    assert applied == 0 and skipped == len(
+        [i for r in scan["records"] for i in range(r["key"].shape[0])])
+    after = np.array(jax.device_get(kvs2.rt.fs.table.vpts))
+    np.testing.assert_array_equal(before, after)
+    kvs2.wal.close()
+
+
+def test_recovered_log_stands_alone(tmp_path):
+    """After recovery the OLD segments are retired and the fresh log alone
+    must cover the state: recover from the re-appended log a second time
+    and serve the same values."""
+    from hermes_tpu.chaos.recovery import recover_store
+
+    wal_dir = tmp_path / "wal"
+    kvs = KVS(_cfg(wal_dir))
+    futs = [kvs.put(0, s, key=s, value=[s, s, s, s]) for s in range(6)]
+    assert kvs.run_until(futs)
+    kvs.wal.sync()
+    old_segs = set(kvs.wal.segments())
+    kvs.wal.close()
+
+    kvs2, _ = recover_store(_cfg(wal_dir))
+    assert not (old_segs & set(kvs2.wal.segments())), "old segments survive"
+    kvs2.wal.close()
+    kvs3, summary = recover_store(_cfg(wal_dir))
+    assert summary["applied"] == 6
+    g = kvs3.get(1, 0, 3)
+    assert kvs3.run_until([g])
+    assert g.result().value == [3, 3, 3, 3]
+    kvs3.wal.close()
+
+
+# ---------------------------------------------------------------------------
+# group-commit client semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode,label", [
+    ("commit", "commit"),
+    ("round", "round:not-fsynced-at-resolve"),
+    ("off", "off:not-fsynced-at-resolve"),
+])
+def test_durability_labels(tmp_path, mode, label):
+    kvs = KVS(_cfg(tmp_path / mode, wal_sync=mode))
+    fut = kvs.put(0, 0, key=1, value=[1, 2, 3, 4])
+    assert kvs.run_until([fut])
+    c = fut.result()
+    assert c.kind == "put" and c.durability == label
+    bf = kvs.submit_batch(np.array([KVS.PUT], np.int32),
+                          np.array([2]), np.array([[9, 9, 9, 9]], np.int32))
+    assert kvs.run_batch(bf)
+    assert bf.completion(0).durability == label
+    kvs.wal.close()
+
+
+def test_no_wal_no_label(tmp_path):
+    kvs = KVS(_cfg(None))
+    fut = kvs.put(0, 0, key=1, value=[1, 2, 3, 4])
+    assert kvs.run_until([fut])
+    assert fut.result().durability is None
+
+
+def test_backpressure_sheds_retry_after(tmp_path):
+    # 'round' mode so resolution doesn't park, then kill the flusher: the
+    # dirty window can only grow, and the client surface must shed LOUDLY
+    kvs = KVS(_cfg(tmp_path, wal_sync="round", wal_dirty_window=4))
+    wal = kvs.wal
+    wal._stop.set()
+    wal.kick()
+    wal._flusher_t.join(timeout=10)
+    assert not wal._flusher_t.is_alive()
+    futs = [kvs.put(0, s, key=s, value=[s, 0, 0, 0]) for s in range(8)]
+    assert kvs.run_until(futs)  # round mode: resolves without fsync
+    assert wal.dirty_records() > 4 and wal.backpressured()
+    shed = kvs.put(0, 0, key=99, value=[9, 9, 9, 9])
+    assert shed.result().kind == "retry_after"
+    bf = kvs.submit_batch(np.array([KVS.PUT] * 3, np.int32),
+                          np.arange(3), np.zeros((3, 4), np.int32))
+    kvs.step()
+    assert all(bf.completion(i).kind == "retry_after" for i in range(3))
+    assert kvs.wal_shed >= 4
+    # reads still flow under write backpressure
+    g = kvs.get(1, 1, 0)
+    assert kvs.run_until([g])
+    assert g.result().kind == "get"
+    # and a dead flusher can never fake durability
+    with pytest.raises(WalError, match="dead|failed"):
+        wal.sync(timeout=1.0)
+
+
+def test_fleet_groups_get_scoped_wal_dirs(tmp_path):
+    fcfg = FleetConfig(groups=3, base=_cfg(tmp_path / "fleet"))
+    dirs = [fcfg.group_cfg(g).wal_dir for g in range(3)]
+    assert dirs == [str(tmp_path / "fleet" / f"group{g:03d}")
+                    for g in range(3)]
+    assert len(set(dirs)) == 3
+    assert FleetConfig(groups=2, base=_cfg(None)).group_cfg(0).wal_dir is None
+
+
+# ---------------------------------------------------------------------------
+# the powercut chaos verb
+# ---------------------------------------------------------------------------
+
+def test_powercut_requires_carrier(tmp_path):
+    from hermes_tpu import chaos
+    from hermes_tpu.runtime import FastRuntime
+
+    rt = FastRuntime(_cfg(None))
+    sched = chaos.Schedule([chaos.ChaosEvent(step=2, kind="powercut")])
+    with pytest.raises(ValueError, match="powercut"):
+        chaos.ChaosRunner(rt, sched)
+
+    fired = []
+    runner = chaos.ChaosRunner(rt, sched, powercut=fired.append)
+    for s in range(4):
+        runner.tick(s)
+    assert fired == [2]
+    assert [e["kind"] for e in runner.log] == ["powercut"]
+
+
+def test_powercut_parses_in_schedule_text():
+    from hermes_tpu import chaos
+
+    sched = chaos.Schedule.parse("@7 powercut\n")
+    assert len(sched) == 1 and sched.events[0].kind == "powercut"
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="wal_sync"):
+        HermesConfig(wal_dir="/tmp/x", wal_sync="sometimes")
+    with pytest.raises(ValueError, match="wal_segment_bytes"):
+        HermesConfig(wal_dir="/tmp/x", wal_segment_bytes=16)
+    with pytest.raises(ValueError, match="wal_dirty_window"):
+        HermesConfig(wal_dir="/tmp/x", wal_dirty_window=0)
+    assert HermesConfig(wal_dir="/tmp/x").use_wal
+    assert not HermesConfig().use_wal
+
+
+def test_segment_rotation_and_truncate(tmp_path):
+    cfg = _cfg(tmp_path, wal_segment_bytes=4096)
+    wal = GroupCommitWal(cfg)
+    per = 16
+    for b in range(40):
+        wv = np.zeros((per, 6), np.int32)
+        wv[:, 3] = b
+        wal.append_round(b, np.full(per, b, np.int64),
+                         np.arange(per, dtype=np.int32),
+                         np.full(per, 1 + b, np.int64),
+                         np.zeros(per, np.int32), wv,
+                         np.zeros(per, np.int32), b"")
+    wal.sync()
+    assert len(wal.segments()) > 1, "rotation never fired"
+    # truncating behind the last batch drops every SEALED segment whose
+    # records all committed at or before it; the open segment stays
+    wal.truncate_to(39)
+    segs = wal.segments()
+    assert len(segs) >= 1
+    scan = replay.read_records(str(tmp_path))
+    assert scan["records"], "truncate must never empty the live log"
+    wal.close()
